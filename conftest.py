@@ -1,0 +1,13 @@
+# Root conftest: configure JAX for CPU-hosted multi-device testing BEFORE jax imports.
+#
+# Tests run on a virtual 8-device CPU mesh so the sharding/collective code paths
+# (parallel/) are exercised without TPU hardware, mirroring the strategy described in
+# SURVEY.md §4 ("single-process multi-device tests on CPU").
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,131 @@
+"""Property tests for the SO(3) math core (reference: test/utils/test_mathutils.py,
+but with asserted tolerances instead of printed averages — SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.ops import lie
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _random_rotations(key, batch):
+    w = jax.random.normal(key, (batch, 3))
+    return lie.expm_so3(w)
+
+
+def test_hat_vee_roundtrip():
+    v = jax.random.normal(KEY, (17, 3))
+    assert jnp.allclose(lie.vee(lie.hat(v)), v)
+    # hat(v) x = v cross x
+    x = jax.random.normal(jax.random.PRNGKey(1), (17, 3))
+    lhs = jnp.einsum("bij,bj->bi", lie.hat(v), x)
+    assert jnp.allclose(lhs, jnp.cross(v, x), atol=1e-6)
+
+
+def test_hat_square_matches_product():
+    u = jax.random.normal(KEY, (11, 3))
+    v = jax.random.normal(jax.random.PRNGKey(2), (11, 3))
+    assert jnp.allclose(lie.hat_square(u, v), lie.hat(u) @ lie.hat(v), atol=1e-5)
+
+
+def test_expm_orthonormal():
+    R = _random_rotations(KEY, 64)
+    eye = jnp.broadcast_to(jnp.eye(3), R.shape)
+    err = jnp.abs(jnp.swapaxes(R, -1, -2) @ R - eye).max()
+    assert err < 1e-5
+    det = jnp.linalg.det(R)
+    assert jnp.abs(det - 1.0).max() < 1e-5
+
+
+def test_expm_small_angle_smooth():
+    w = jnp.array([[0.0, 0.0, 0.0], [1e-9, 0.0, 0.0], [1e-7, 1e-8, 0.0]])
+    R = lie.expm_so3(w)
+    assert jnp.all(jnp.isfinite(R))
+    assert jnp.allclose(R[0], jnp.eye(3))
+    # Gradient must be finite through zero.
+    g = jax.grad(lambda w_: lie.expm_so3(w_).sum())(jnp.zeros(3))
+    assert jnp.all(jnp.isfinite(g))
+
+
+def test_expm_matches_scipy():
+    from scipy.spatial.transform import Rotation
+
+    w = np.asarray(jax.random.normal(KEY, (32, 3)))
+    R_jax = np.asarray(lie.expm_so3(jnp.asarray(w)))
+    R_ref = Rotation.from_rotvec(w).as_matrix()
+    assert np.abs(R_jax - R_ref).max() < 1e-5
+
+
+def test_log_exp_roundtrip():
+    w = jax.random.normal(KEY, (32, 3)) * 0.9
+    w2 = lie.log_so3(lie.expm_so3(w))
+    assert jnp.abs(w - w2).max() < 1e-4
+
+
+def test_polar_project_newton_schulz():
+    R = _random_rotations(KEY, 16)
+    # Perturb off the manifold (the integrator-drift regime).
+    noise = 1e-3 * jax.random.normal(jax.random.PRNGKey(3), R.shape)
+    P = lie.polar_project(R + noise)
+    eye = jnp.broadcast_to(jnp.eye(3), P.shape)
+    assert jnp.abs(jnp.swapaxes(P, -1, -2) @ P - eye).max() < 1e-5
+    # Matches the SVD polar factor (the reference's scipy.linalg.polar).
+    P_svd = lie.polar_project_svd(R + noise)
+    assert jnp.abs(P - P_svd).max() < 1e-4
+
+
+def test_polar_project_idempotent():
+    R = _random_rotations(KEY, 8)
+    assert jnp.abs(lie.polar_project(R) - R).max() < 1e-5
+
+
+def test_rotation_a_to_b():
+    key1, key2 = jax.random.split(KEY)
+    a = jax.random.normal(key1, (32, 3))
+    a = a / jnp.linalg.norm(a, axis=-1, keepdims=True)
+    b = jax.random.normal(key2, (32, 3))
+    b = b / jnp.linalg.norm(b, axis=-1, keepdims=True)
+    R = lie.rotation_a_to_b(a, b)
+    assert jnp.abs(jnp.einsum("bij,bj->bi", R, a) - b).max() < 1e-5
+    assert jnp.abs(jnp.linalg.det(R) - 1.0).max() < 1e-5
+    eye = jnp.broadcast_to(jnp.eye(3), R.shape)
+    assert jnp.abs(jnp.swapaxes(R, -1, -2) @ R - eye).max() < 1e-5
+
+
+def test_rotation_a_to_b_antipodal():
+    a = jnp.array([0.0, 0.0, 1.0])
+    R = lie.rotation_a_to_b(a, -a)
+    assert jnp.abs(R @ a + a).max() < 1e-6
+    assert jnp.abs(jnp.linalg.det(R) - 1.0) < 1e-5
+    # Antipodal along e1 exercises the second fallback.
+    a = jnp.array([1.0, 0.0, 0.0])
+    R = lie.rotation_a_to_b(a, -a)
+    assert jnp.abs(R @ a + a).max() < 1e-6
+
+
+def test_rotation_from_z():
+    q = lie.random_cone_vector(KEY, jnp.pi / 3, (64,))
+    R = lie.rotation_from_z(q)
+    assert jnp.abs(R[..., :, 2] - q).max() < 1e-6
+    eye = jnp.broadcast_to(jnp.eye(3), R.shape)
+    assert jnp.abs(jnp.swapaxes(R, -1, -2) @ R - eye).max() < 2e-5
+    # Zero yaw in ZYX convention: R[1, 0] == 0.
+    assert jnp.abs(R[..., 1, 0]).max() < 1e-6
+
+
+def test_random_cone_vector_membership():
+    theta = 0.4
+    v = lie.random_cone_vector(KEY, theta, (5000,))
+    assert jnp.abs(jnp.linalg.norm(v, axis=-1) - 1.0).max() < 1e-5
+    angles = jnp.arccos(jnp.clip(v[..., 2], -1, 1))
+    assert angles.max() <= theta + 1e-5
+
+
+@pytest.mark.parametrize("fn", [lie.hat, lie.expm_so3])
+def test_jit_and_vmap_compose(fn):
+    v = jax.random.normal(KEY, (4, 5, 3))
+    out = jax.jit(jax.vmap(jax.vmap(fn)))(v)
+    assert out.shape[:2] == (4, 5)

@@ -1,0 +1,27 @@
+"""tpu-aerial-transport: a TPU-native (JAX/XLA) framework for distributed
+multi-quadrotor aerial payload transportation.
+
+Brand-new implementation of the capabilities of
+``AkshayThiru/distributed-aerial-transportation`` (see SURVEY.md), re-designed for
+TPU: pytree system models, a batched conic-QP solver with closed-form SOC
+projections, vmapped per-agent distributed MPC (consensus-ADMM and dual
+decomposition) with mesh all-reduces, a closed-form JAX collision environment, and
+end-to-end jit-compiled receding-horizon rollouts.
+"""
+
+import os as _os
+
+import jax as _jax
+
+# The compute in this framework is dominated by small (3x3 .. ~64x64) matmuls inside
+# rigid-body dynamics and the conic-QP solver, where bf16 mantissa loss directly
+# corrupts physics and KKT residuals while buying no MXU throughput (the tiles are far
+# below the 128x128 systolic array). Default to full-f32 matmuls; override with
+# TAT_MATMUL_PRECISION=default to restore JAX's platform default.
+if _os.environ.get("TAT_MATMUL_PRECISION", "highest") != "default":
+    _jax.config.update(
+        "jax_default_matmul_precision",
+        _os.environ.get("TAT_MATMUL_PRECISION", "highest"),
+    )
+
+__version__ = "0.1.0"

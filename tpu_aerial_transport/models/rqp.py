@@ -1,0 +1,265 @@
+"""Rigid-quadrotor-payload (RQP) system model — the primary model.
+
+TPU-native re-design of reference ``system/rigid_quadrotor_payload.py`` (dynamics
+docstring at :151-163): ``n`` quadrotors rigidly attached to a shared payload at body
+points ``r_i``; each quadrotor keeps an independent attitude ``R_i`` and contributes
+scalar thrust ``f_i`` along its body z-axis plus a body moment ``M_i``.
+
+Differences from the reference (deliberate, TPU-first):
+- Structure-of-arrays pytrees with the **agent axis leading** (``R: (n, 3, 3)``,
+  ``w: (n, 3)``, ``r: (n, 3)``) so ``vmap``/sharding over agents is a leading-axis
+  operation; the reference uses trailing-axis ``(3, 3, n)`` numpy arrays.
+- Pure functions of ``(params, state) -> state`` instead of mutating classes, so the
+  whole physics step jit-compiles and composes with ``lax.scan`` rollouts.
+- SO(3) projection (reference: scipy polar via SVD every 20 steps,
+  ``rigid_quadrotor_payload.py:121-148``) uses the matmul-only Newton-Schulz
+  iteration from :mod:`tpu_aerial_transport.ops.lie`, selected by a step counter
+  carried in the state pytree.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from tpu_aerial_transport.ops import lie
+
+GRAVITY = 9.80665  # scipy.constants.g, [m/s^2].
+
+# Reference `_INTEGRATION_STEPS_PER_ROTATION_PROJECTION = 20`
+# (rigid_quadrotor_payload.py:14).
+PROJECTION_PERIOD = 20
+
+# Reference RQPCollision constants (rigid_quadrotor_payload.py:37,301-310).
+QUADROTOR_RADIUS = 0.3  # [m].
+MAX_DECELERATION = GRAVITY / 5.0  # [m/s^2].
+
+
+@struct.dataclass
+class RQPParams:
+    """System parameters (reference ``RQPParameters``, :48-84). Agent axis leads."""
+
+    m: jnp.ndarray  # (n,) quadrotor masses [kg].
+    J: jnp.ndarray  # (n, 3, 3) quadrotor inertias.
+    ml: jnp.ndarray  # () payload mass.
+    Jl: jnp.ndarray  # (3, 3) payload inertia (body frame).
+    r: jnp.ndarray  # (n, 3) attachment points (payload body frame).
+    # Derived (precomputed in rqp_params()):
+    mT: jnp.ndarray  # () total mass.
+    x_com: jnp.ndarray  # (3,) CoM offset in payload body frame.
+    r_com: jnp.ndarray  # (n, 3) attachments relative to CoM.
+    JT: jnp.ndarray  # (3, 3) composite inertia about CoM.
+    JT_inv: jnp.ndarray  # (3, 3).
+    J_inv: jnp.ndarray  # (n, 3, 3).
+
+    @property
+    def n(self) -> int:
+        return self.r.shape[-2]
+
+
+def rqp_params(m, J, ml, Jl, r, dtype=jnp.float32) -> RQPParams:
+    """Build :class:`RQPParams` with derived quantities.
+
+    Mirrors reference ``RQPParameters.__init__`` (:59-84): total mass, CoM offset
+    ``x_com = sum_i m_i r_i / mT``, CoM-relative attachments, composite inertia
+    ``JT = Jl - ml hat^2(x_com) - sum_i m_i hat^2(r_com_i)``.
+    """
+    m = jnp.asarray(m, dtype)
+    J = jnp.asarray(J, dtype)
+    ml = jnp.asarray(ml, dtype)
+    Jl = jnp.asarray(Jl, dtype)
+    r = jnp.asarray(r, dtype)
+    n = r.shape[0]
+    assert m.shape == (n,) and J.shape == (n, 3, 3) and Jl.shape == (3, 3)
+
+    mT = jnp.sum(m) + ml
+    x_com = jnp.sum(r * m[:, None], axis=0) / mT
+    r_com = r - x_com
+    JT = (
+        Jl
+        - ml * lie.hat_square(x_com, x_com)
+        - jnp.sum(m[:, None, None] * lie.hat_square(r_com, r_com), axis=0)
+    )
+    return RQPParams(
+        m=m,
+        J=J,
+        ml=ml,
+        Jl=Jl,
+        r=r,
+        mT=mT,
+        x_com=x_com,
+        r_com=r_com,
+        JT=JT,
+        JT_inv=jnp.linalg.inv(JT),
+        J_inv=jnp.linalg.inv(J),
+    )
+
+
+@struct.dataclass
+class RQPState:
+    """System state (reference ``RQPState``, :87-148). Agent axis leads."""
+
+    R: jnp.ndarray  # (n, 3, 3) quadrotor rotations.
+    w: jnp.ndarray  # (n, 3) quadrotor body angular velocities.
+    xl: jnp.ndarray  # (3,) payload position.
+    vl: jnp.ndarray  # (3,) payload velocity.
+    Rl: jnp.ndarray  # (3, 3) payload rotation.
+    wl: jnp.ndarray  # (3,) payload body angular velocity.
+    step: jnp.ndarray  # () int32 counter for periodic SO(3) re-projection.
+
+    @property
+    def n(self) -> int:
+        return self.w.shape[-2]
+
+
+def rqp_state(R, w, xl, vl, Rl, wl, dtype=jnp.float32) -> RQPState:
+    """Build a state, projecting rotations onto SO(3) (reference ctor behavior).
+
+    Uses the SVD polar factor here: this is a host-side, setup-time constructor that
+    must handle arbitrary user input (Newton-Schulz only converges for singular
+    values in (0, sqrt(3)) and is reserved for in-loop drift correction).
+    """
+    return RQPState(
+        R=lie.polar_project_svd(jnp.asarray(R, dtype)),
+        w=jnp.asarray(w, dtype),
+        xl=jnp.asarray(xl, dtype),
+        vl=jnp.asarray(vl, dtype),
+        Rl=lie.polar_project_svd(jnp.asarray(Rl, dtype)),
+        wl=jnp.asarray(wl, dtype),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def rqp_identity_state(n: int, dtype=jnp.float32) -> RQPState:
+    """Identity attitudes, zero velocities at the origin (reference setup.py:109)."""
+    eye = jnp.broadcast_to(jnp.eye(3, dtype=dtype), (n, 3, 3))
+    z3 = jnp.zeros(3, dtype)
+    return RQPState(
+        R=eye,
+        w=jnp.zeros((n, 3), dtype),
+        xl=z3,
+        vl=z3,
+        Rl=jnp.eye(3, dtype=dtype),
+        wl=z3,
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def forward_dynamics(params: RQPParams, state: RQPState, wrench):
+    """Accelerations from quadrotor inputs (reference ``RQPDynamics.forward_dynamics``,
+    :173-222).
+
+    ``wrench = (f, M)`` with ``f (n,)`` scalar thrusts (along each quad's body z, in
+    world frame via ``R_i e3``) and ``M (n, 3)`` body moments.
+    Returns ``(dw (n, 3), dvl (3,), dwl (3,))``.
+    """
+    f, M = wrench
+    gravity = jnp.array([0.0, 0.0, -GRAVITY], dtype=state.xl.dtype)
+
+    # Per-quad Euler equation: dw_i = J_i^{-1} (M_i - w_i x J_i w_i).
+    Jw = jnp.einsum("nij,nj->ni", params.J, state.w)
+    dw = jnp.einsum("nij,nj->ni", params.J_inv, M - jnp.cross(state.w, Jw))
+
+    # CoM translation: dv_com = sum_i f_i R_i e3 / mT + g.
+    quad_force = state.R[..., :, 2] * f[..., None]  # (n, 3) world-frame thrusts.
+    dv_com = jnp.sum(quad_force, axis=0) / params.mT + gravity
+
+    # Composite rotation: dwl = JT^{-1} (sum_i r_com_i x Rl^T F_i - wl x JT wl).
+    force_body = quad_force @ state.Rl  # rows = Rl^T F_i.
+    net_moment = jnp.sum(jnp.cross(params.r_com, force_body), axis=0)
+    JTwl = params.JT @ state.wl
+    dwl = params.JT_inv @ (net_moment - jnp.cross(state.wl, JTwl))
+
+    # Payload-point kinematic correction:
+    # dvl = dv_com - Rl (hat(wl)^2 + hat(dwl)) x_com.
+    corr = (lie.hat_square(state.wl, state.wl) + lie.hat(dwl)) @ params.x_com
+    dvl = dv_com - state.Rl @ corr
+    return dw, dvl, dwl
+
+
+def integrate_state(
+    state: RQPState, acc, dt, project_every: int = PROJECTION_PERIOD
+) -> RQPState:
+    """Semi-implicit trapezoidal manifold integrator (reference ``RQPState.integrate``,
+    :129-148): rotations via ``R exp3((w + dw dt/2) dt)``, positions via trapezoid,
+    Newton-Schulz SO(3) re-projection every ``project_every`` steps."""
+    dw, dvl, dwl = acc
+    R = state.R @ lie.expm_so3((state.w + dw * (dt / 2)) * dt)
+    w = state.w + dw * dt
+    xl = state.xl + state.vl * dt + dvl * (dt**2 / 2)
+    vl = state.vl + dvl * dt
+    Rl = state.Rl @ lie.expm_so3((state.wl + dwl * (dt / 2)) * dt)
+    wl = state.wl + dwl * dt
+
+    step = state.step + 1
+    project = step >= project_every
+    # Projection is a handful of 3x3 matmuls; compute unconditionally and select, which
+    # is cheaper than lax.cond under vmap (where cond lowers to select anyway).
+    R = jnp.where(project, lie.polar_project(R), R)
+    Rl = jnp.where(project, lie.polar_project(Rl), Rl)
+    step = jnp.where(project, 0, step)
+    return state.replace(R=R, w=w, xl=xl, vl=vl, Rl=Rl, wl=wl, step=step)
+
+
+def integrate(
+    params: RQPParams,
+    state: RQPState,
+    wrench,
+    dt,
+    project_every: int = PROJECTION_PERIOD,
+) -> RQPState:
+    """Forward dynamics + state integration (reference ``RQPDynamics.integrate``)."""
+    return integrate_state(
+        state, forward_dynamics(params, state, wrench), dt, project_every
+    )
+
+
+def inverse_dynamics_error(state: RQPState, params: RQPParams, wrench, acc):
+    """Residual norm of the full (per-quad + payload) Newton-Euler equations — the
+    test oracle (reference ``RQPDynamics.inverse_dynamics_error``, :224-269): for a
+    consistent ``(state, wrench, acc)`` triple the residual is ~machine epsilon."""
+    f, M = wrench
+    dw, dvl, dwl = acc
+    gravity = jnp.array([0.0, 0.0, -GRAVITY], dtype=state.xl.dtype)
+
+    # Quadrotor CoM accelerations from payload kinematics.
+    kin = (lie.hat_square(state.wl, state.wl) + lie.hat(dwl)) @ params.r.T  # (3, n)
+    dv_quad = dvl[:, None] + state.Rl @ kin  # (3, n)
+    dv_quad = dv_quad.T  # (n, 3)
+    quad_force = state.R[..., :, 2] * f[..., None]
+    internal_force = (
+        quad_force + gravity * params.m[:, None] - params.m[:, None] * dv_quad
+    )
+    com_acc_err = jnp.linalg.norm(
+        params.ml * dvl - params.ml * gravity - jnp.sum(internal_force, axis=0)
+    )
+    load_moment = jnp.sum(jnp.cross(params.r, internal_force @ state.Rl), axis=0)
+    Jlwl = params.Jl @ state.wl
+    com_ang_err = jnp.linalg.norm(
+        params.Jl @ dwl + jnp.cross(state.wl, Jlwl) - load_moment
+    )
+    Jw = jnp.einsum("nij,nj->ni", params.J, state.w)
+    quad_ang_res = jnp.einsum("nij,nj->ni", params.J, dw) + jnp.cross(state.w, Jw) - M
+    quad_ang_err_sq = jnp.sum(quad_ang_res**2)
+    return jnp.sqrt(com_acc_err**2 + com_ang_err**2 + quad_ang_err_sq)
+
+
+class RQPCollision:
+    """Host-side collision metadata (reference ``RQPCollision``, :279-310): payload
+    hull vertices for visualization plus the bounding-sphere collision radius and max
+    braking deceleration consumed by the controllers' collision CBFs."""
+
+    def __init__(self, payload_vertices, payload_mesh_vertices):
+        payload_vertices = np.asarray(payload_vertices, np.float64)
+        payload_mesh_vertices = np.asarray(payload_mesh_vertices, np.float64)
+        assert payload_vertices.shape[1] == 3
+        self.payload_vertices = payload_vertices
+        self.payload_mesh_vertices = payload_mesh_vertices
+        self.quadrotor_radius = QUADROTOR_RADIUS
+        self.collision_radius = float(
+            np.max(np.linalg.norm(payload_mesh_vertices, axis=1))
+            + QUADROTOR_RADIUS
+            + 0.1
+        )
+        self.max_deceleration = MAX_DECELERATION

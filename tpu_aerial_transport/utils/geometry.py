@@ -1,0 +1,43 @@
+"""Host-side geometry helpers (reference ``utils/geometry_utils.py``).
+
+These run at setup/visualization time only — never inside the compiled path — so they
+use numpy/scipy directly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.spatial import ConvexHull
+
+
+def faces_from_vertex_rep(vertices: np.ndarray) -> np.ndarray:
+    """Convex-hull faces (index triplets) from a (m, 3) vertex array."""
+    assert vertices.ndim == 2 and vertices.shape[1] == 3
+    hull = ConvexHull(vertices)
+    return hull.simplices
+
+
+def mesh_from_halfspace_rep(A: np.ndarray, b: np.ndarray):
+    """H-rep ``{x : A x <= b}`` -> (vertices, faces).
+
+    The reference uses the ``polytope`` package for vertex enumeration; that package
+    is not available here, so we enumerate vertices directly: every intersection of 3
+    hyperplanes that satisfies all inequalities is a candidate vertex (fine for the
+    small polytopes this is used for — tests and payload meshes).
+    """
+    assert A.ndim == 2 and A.shape[1] == 3
+    m = A.shape[0]
+    verts = []
+    for i in range(m):
+        for j in range(i + 1, m):
+            for k in range(j + 1, m):
+                M = A[[i, j, k]]
+                if abs(np.linalg.det(M)) < 1e-10:
+                    continue
+                x = np.linalg.solve(M, b[[i, j, k]])
+                if np.all(A @ x <= b + 1e-8):
+                    verts.append(x)
+    if not verts:
+        raise ValueError("empty polytope")
+    verts = np.unique(np.round(np.array(verts), 10), axis=0)
+    return verts, faces_from_vertex_rep(verts)

@@ -1,0 +1,16 @@
+"""Aggregate statistics helpers (reference ``utils/math_utils.py:63-73``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def compute_aggregate_statistics(a, axis: int = 0):
+    """Return ``(min, max, avg, std)`` of ``a`` along ``axis``."""
+    a = jnp.asarray(a)
+    return (
+        jnp.min(a, axis=axis),
+        jnp.max(a, axis=axis),
+        jnp.mean(a, axis=axis),
+        jnp.std(a, axis=axis),
+    )
