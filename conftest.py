@@ -5,7 +5,10 @@
 # SURVEY.md §4 ("single-process multi-device tests on CPU").
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment selects a TPU platform (e.g.
+# JAX_PLATFORMS=axon): the test suite needs 8 virtual devices for the collective
+# code paths, and the driver benchmarks on real TPU separately via bench.py.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,122 @@
+"""Property tests for the RP and PMRL system models (reference test/system/*)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_aerial_transport.models import pmrl, rp
+from tpu_aerial_transport.ops import lie
+
+
+def _rp_params(n=3):
+    ang = 2 * jnp.pi * jnp.arange(n) / n
+    r = jnp.stack([jnp.cos(ang), jnp.sin(ang), jnp.zeros(n)], axis=-1) * 0.4
+    Jl = jnp.diag(jnp.array([2.1e-2, 1.87e-2, 3.97e-2]))
+    return rp.rp_params(0.225, Jl, r)
+
+
+def _rp_random_state(key):
+    ks = jax.random.split(key, 4)
+    return rp.rp_state(
+        xl=jax.random.normal(ks[0], (3,)),
+        vl=jax.random.normal(ks[1], (3,)),
+        Rl=lie.expm_so3(jax.random.normal(ks[2], (3,)) * 0.5),
+        wl=jax.random.normal(ks[3], (3,)),
+    )
+
+
+@pytest.mark.parametrize("n", [3, 5])
+def test_rp_inverse_dynamics_residual(n):
+    params = _rp_params(n)
+    for seed in range(5):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 2)
+        state = _rp_random_state(ks[0])
+        f = jax.random.normal(ks[1], (n, 3))
+        acc = rp.forward_dynamics(params, state, f)
+        err = rp.inverse_dynamics_error(state, params, f, acc)
+        assert float(err) < 1e-4
+
+
+def test_rp_hover_equilibrium():
+    """Equal vertical forces summing to ml*g with symmetric attachments -> zero acc."""
+    n = 3
+    params = _rp_params(n)
+    state = rp.rp_identity_state()
+    f = jnp.tile(jnp.array([0.0, 0.0, float(params.ml) * rp.GRAVITY / n]), (n, 1))
+    dvl, dwl = rp.forward_dynamics(params, state, f)
+    assert jnp.abs(dvl).max() < 1e-5
+    assert jnp.abs(dwl).max() < 1e-5
+
+
+def test_rp_integrator_orthonormality():
+    params = _rp_params(3)
+    state = _rp_random_state(jax.random.PRNGKey(3))
+    f = jnp.zeros((3, 3))
+
+    def body(s, _):
+        return rp.integrate(params, s, f, 1e-3), None
+
+    final, _ = jax.lax.scan(body, state, None, length=500)
+    assert jnp.abs(final.Rl.T @ final.Rl - jnp.eye(3)).max() < 1e-4
+
+
+# ---------------------------------------------------------------------------- PMRL
+
+
+def _pmrl_params(n=3):
+    ang = 2 * jnp.pi * jnp.arange(n) / n
+    r = jnp.stack([jnp.cos(ang), jnp.sin(ang), jnp.zeros(n)], axis=-1) * 0.4
+    Jl = jnp.diag(jnp.array([2.1e-2, 1.87e-2, 3.97e-2]))
+    m = jnp.full((n,), 0.5)
+    L = jnp.full((n,), 1.0)
+    return pmrl.pmrl_params(m, 0.225, Jl, r, L)
+
+
+def _pmrl_random_state(key, n=3):
+    ks = jax.random.split(key, 6)
+    q = lie.random_cone_vector(ks[0], 0.6, (n,))  # links pointing upward-ish
+    dq = 0.3 * jax.random.normal(ks[1], (n, 3))
+    return pmrl.pmrl_state(
+        q=q,
+        dq=dq,
+        xl=jax.random.normal(ks[2], (3,)),
+        vl=jax.random.normal(ks[3], (3,)),
+        Rl=lie.expm_so3(jax.random.normal(ks[4], (3,)) * 0.3),
+        wl=jax.random.normal(ks[5], (3,)),
+    )
+
+
+@pytest.mark.parametrize("n", [3, 6])
+def test_pmrl_inverse_dynamics_residual(n):
+    """Validates the implicit SPD tension solve (reference test_pmrldynamics.py)."""
+    params = _pmrl_params(n)
+    for seed in range(5):
+        ks = jax.random.split(jax.random.PRNGKey(seed + 10), 2)
+        state = _pmrl_random_state(ks[0], n)
+        f = jax.random.normal(ks[1], (n, 3)) * 2.0
+        acc, T = pmrl.forward_dynamics(params, state, f)
+        err = pmrl.inverse_dynamics_error(state, params, f, T, acc)
+        assert float(err) < 5e-4, f"residual {err} at seed {seed}"
+
+
+def test_pmrl_state_projection_invariants():
+    state = _pmrl_random_state(jax.random.PRNGKey(0))
+    assert jnp.abs(jnp.linalg.norm(state.q, axis=-1) - 1.0).max() < 1e-6
+    assert jnp.abs(jnp.sum(state.q * state.dq, axis=-1)).max() < 1e-6
+
+
+def test_pmrl_integrator_keeps_manifolds():
+    n = 3
+    params = _pmrl_params(n)
+    state = _pmrl_random_state(jax.random.PRNGKey(2), n)
+    # Roughly supporting thrusts along the links.
+    f = state.q * 2.0
+
+    def body(s, _):
+        return pmrl.integrate(params, s, f, 1e-3), None
+
+    final, _ = jax.lax.scan(body, state, None, length=1000)
+    assert jnp.abs(jnp.linalg.norm(final.q, axis=-1) - 1.0).max() < 1e-5
+    assert jnp.abs(jnp.sum(final.q * final.dq, axis=-1)).max() < 1e-4
+    assert jnp.abs(final.Rl.T @ final.Rl - jnp.eye(3)).max() < 1e-4
+    assert jnp.all(jnp.isfinite(final.xl))

@@ -1,0 +1,176 @@
+"""Tests for the batched conic-QP solver (ops/socp.py) — KKT residuals and
+agreement with independent oracles (equality-KKT closed form, scipy SLSQP),
+the gate from SURVEY.md §7 stage 3."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.ops import socp
+
+
+def test_project_soc_cases():
+    # Inside: unchanged.
+    z = jnp.array([2.0, 1.0, 0.5])
+    assert jnp.allclose(socp.project_soc(z), z)
+    # Polar cone: zero.
+    z = jnp.array([-2.0, 1.0, 0.5])
+    assert jnp.allclose(socp.project_soc(z), 0.0)
+    # Outside: projection satisfies ||v|| == t and is idempotent.
+    z = jnp.array([0.5, 3.0, -4.0])
+    p = socp.project_soc(z)
+    assert jnp.abs(jnp.linalg.norm(p[1:]) - p[0]) < 1e-6
+    assert jnp.allclose(socp.project_soc(p), p, atol=1e-6)
+    # Batched.
+    zb = jnp.stack([z, z, z])
+    assert socp.project_soc(zb).shape == (3, 3)
+
+
+def _random_qp(key, nv=8, n_eq=3, n_ineq=6):
+    ks = jax.random.split(key, 5)
+    L = jax.random.normal(ks[0], (nv, nv)) * 0.5
+    P = L @ L.T + 0.5 * jnp.eye(nv)
+    q = jax.random.normal(ks[1], (nv,))
+    A_eq = jax.random.normal(ks[2], (n_eq, nv))
+    b_eq = jax.random.normal(ks[3], (n_eq,)) * 0.3
+    A_in = jax.random.normal(ks[4], (n_ineq, nv))
+    # A_in x <= 1 (feasible near origin).
+    A = jnp.concatenate([A_eq, A_in], axis=0)
+    lb = jnp.concatenate([b_eq, jnp.full((n_ineq,), -socp.INF)])
+    ub = jnp.concatenate([b_eq, jnp.ones((n_ineq,))])
+    return P, q, A, lb, ub
+
+
+def test_equality_qp_matches_kkt_closed_form():
+    """Pure equality QP has a closed-form KKT solution to compare against."""
+    key = jax.random.PRNGKey(0)
+    P, q, A, lb, ub = _random_qp(key, nv=8, n_eq=4, n_ineq=0)
+    A_eq, b_eq = A, lb
+    sol = socp.solve_socp(P, q, A, lb, ub, n_box=4, iters=400)
+    # KKT: [P A^T; A 0] [x; nu] = [-q; b].
+    nv, ne = 8, 4
+    K = jnp.block([[P, A_eq.T], [A_eq, jnp.zeros((ne, ne))]])
+    rhs = jnp.concatenate([-q, b_eq])
+    xnu = jnp.linalg.solve(K, rhs)
+    assert jnp.abs(sol.x - xnu[:nv]).max() < 1e-3
+    assert float(sol.prim_res) < 1e-4
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_qp_matches_scipy(seed):
+    from scipy.optimize import minimize
+
+    P, q, A, lb, ub = _random_qp(jax.random.PRNGKey(seed), nv=8, n_eq=3, n_ineq=6)
+    sol = socp.solve_socp(P, q, A, lb, ub, n_box=9, iters=800)
+    Pn, qn, An = np.asarray(P, np.float64), np.asarray(q, np.float64), np.asarray(A, np.float64)
+    cons = [
+        {"type": "eq", "fun": lambda x: An[:3] @ x - np.asarray(lb[:3])},
+        {"type": "ineq", "fun": lambda x: np.asarray(ub[3:]) - An[3:] @ x},
+    ]
+    ref = minimize(
+        lambda x: 0.5 * x @ Pn @ x + qn @ x,
+        np.zeros(8),
+        jac=lambda x: Pn @ x + qn,
+        constraints=cons,
+        method="SLSQP",
+        options={"maxiter": 200, "ftol": 1e-12},
+    )
+    assert ref.success
+    obj_admm = 0.5 * np.asarray(sol.x) @ Pn @ np.asarray(sol.x) + qn @ np.asarray(sol.x)
+    # Objective agreement (solutions may differ along near-degenerate directions).
+    assert abs(obj_admm - ref.fun) < 2e-3 * max(1.0, abs(ref.fun))
+    assert float(sol.prim_res) < 2e-3
+
+
+def test_socp_projection_problem():
+    """min ||x - p||^2 s.t. x in SOC == closed-form cone projection."""
+    p = jnp.array([0.5, 3.0, -4.0, 1.0])
+    nv = 4
+    P = 2 * jnp.eye(nv)
+    q = -2.0 * p
+    A = jnp.eye(nv)  # A x = x must lie in SOC(4).
+    lb = ub = jnp.zeros((0,))
+    sol = socp.solve_socp(P, q, A, lb, ub, n_box=0, soc_dims=(4,), iters=400)
+    assert jnp.abs(sol.x - socp.project_soc(p)).max() < 1e-3
+
+
+def test_mixed_box_soc_kkt():
+    """Thrust-cone-shaped instance: min ||f - f0||^2, f_z >= fz_min,
+    ||f|| <= sec(30 deg) f_z  (the per-agent actuation set from
+    control/rqp_centralized.py:185-190)."""
+    f0 = jnp.array([3.0, 0.5, 2.0])
+    sec30 = 1.0 / jnp.cos(jnp.pi / 6)
+    P = 2 * jnp.eye(3)
+    q = -2 * f0
+    # Rows: [e3 (box, f_z >= 0.3)] + SOC block [sec30 * f_z; f].
+    A = jnp.concatenate(
+        [
+            jnp.array([[0.0, 0.0, 1.0]]),
+            jnp.array([[0.0, 0.0, float(sec30)]]),
+            jnp.eye(3),
+        ],
+        axis=0,
+    )
+    lb = jnp.array([0.3])
+    ub = jnp.array([socp.INF])
+    sol = socp.solve_socp(P, q, A, lb, ub, n_box=1, soc_dims=(4,), iters=600)
+    f = sol.x
+    # Feasible.
+    assert f[2] >= 0.3 - 1e-4
+    assert jnp.linalg.norm(f) <= sec30 * f[2] + 1e-3
+    # KKT residuals small.
+    stat, prim, comp = socp.kkt_residuals(P, q, A, lb, ub, 1, (4,), sol)
+    assert float(prim) < 1e-3
+    assert float(stat) < 1e-2
+    # Oracle: scipy on the smooth reformulation.
+    from scipy.optimize import minimize
+
+    f0n = np.asarray(f0, np.float64)
+    ref = minimize(
+        lambda x: np.sum((x - f0n) ** 2),
+        np.array([0.0, 0.0, 1.0]),
+        constraints=[
+            {"type": "ineq", "fun": lambda x: x[2] - 0.3},
+            {
+                "type": "ineq",
+                "fun": lambda x: (float(sec30) * x[2]) ** 2 - x @ x,
+            },
+        ],
+        method="SLSQP",
+        options={"maxiter": 200, "ftol": 1e-12},
+    )
+    assert np.abs(np.asarray(f) - ref.x).max() < 5e-3
+
+
+def test_warm_start_accelerates():
+    P, q, A, lb, ub = _random_qp(jax.random.PRNGKey(7))
+    sol = socp.solve_socp(P, q, A, lb, ub, n_box=9, iters=800)
+    # Perturb q slightly, warm-start: few iterations reach tight residuals.
+    q2 = q + 0.01
+    warm = socp.solve_socp(P, q2, A, lb, ub, n_box=9, iters=50, warm=sol)
+    cold = socp.solve_socp(P, q2, A, lb, ub, n_box=9, iters=50)
+    assert float(warm.prim_res) <= float(cold.prim_res) + 1e-6
+
+
+def test_vmap_batch_of_qps():
+    keys = jax.random.split(jax.random.PRNGKey(3), 16)
+    Ps, qs, As, lbs, ubs = jax.vmap(_random_qp)(keys)
+
+    batched = jax.vmap(
+        lambda P, q, A, lb, ub: socp.solve_socp(
+            P, q, A, lb, ub, n_box=9, iters=300
+        )
+    )
+    sols = batched(Ps, qs, As, lbs, ubs)
+    assert sols.x.shape == (16, 8)
+    assert float(jnp.max(sols.prim_res)) < 5e-3
+
+
+def test_early_exit_matches_fixed():
+    P, q, A, lb, ub = _random_qp(jax.random.PRNGKey(11))
+    fixed = socp.solve_socp(P, q, A, lb, ub, n_box=9, iters=1000)
+    early = socp.solve_socp(
+        P, q, A, lb, ub, n_box=9, iters=1000, check_every=50, tol=1e-4
+    )
+    assert jnp.abs(fixed.x - early.x).max() < 5e-3

@@ -1,0 +1,329 @@
+"""Centralized optimal controller for the RQP model: one conic QP per control step
+with CBF safety rows, solved by the batched ADMM solver.
+
+TPU-native re-design of reference ``control/rqp_centralized.py``
+(``RQPCentralizedController``). Same optimization problem (docstring :28-44), built
+as explicit ``(P, q, A, lb, ub, shift)`` matrices in one pure JAX function instead
+of a cvxpy parametrized problem re-canonicalized on the host:
+
+  decision  x = [dv_com (3) | dvl (3) | dwl (3) | f_1..f_n (3 each)]
+  cost      k_f ||sum f - mT g e3||^2 + k_m ||sum hat(r_com_i) Rl^T f_i||^2
+            + k_feq ||f - f_eq||^2 + k_dvl (||dvl||^2 - 2 dvl_des . dvl)
+            + k_dwl (||dwl||^2 - 2 dwl_des . dwl)                     (:396-425)
+  s.t.      linearized dynamics + CoM->payload kinematics equalities  (:340-356)
+            f_z >= min_fz; ||f_i|| <= sec(30deg) f_iz (SOC);
+            ||f_i|| <= max_f (SOC)                                    (:358-365)
+            payload-tilt / |wl| / |vl| CBF rows                       (:367-391)
+            up to n_env_cbfs collision CBF rows  lhs @ dvl >= rhs     (:393-394)
+
+The controller is a pure function ``control(...)`` over pytrees; mutable bits of the
+reference (warm start, previous-solution fallback on solver failure, :427-448)
+become an explicit ``CtrlState`` carried through ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from tpu_aerial_transport.control.types import EnvCBF, SolverStats, inactive_env_cbf
+from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
+from tpu_aerial_transport.ops import lie, socp
+
+
+@struct.dataclass
+class RQPCentralizedConfig:
+    """Controller constants (reference ``_set_controller_constants``, :182-225).
+    All fields are scalars so the config is a trivially shardable pytree."""
+
+    # Constraints.
+    min_fz: float
+    sec_max_f_ang: float
+    max_f: float
+    cos_max_p_ang: float
+    alpha1_p_cbf: float
+    alpha2_p_cbf: float
+    max_wl_sq: float
+    alpha_wl_cbf: float
+    max_vl_sq: float
+    alpha_vl_cbf: float
+    # Env collision CBFs.
+    dist_eps: float
+    vision_radius: float
+    alpha_env_cbf: float
+    max_deceleration: float
+    # Costs.
+    k_f: float
+    k_m: float
+    k_feq: float
+    k_dvl: float
+    k_dwl: float
+    # Static sizes / solver budget.
+    n_env_cbfs: int = struct.field(pytree_node=False, default=10)
+    solver_iters: int = struct.field(pytree_node=False, default=150)
+    solver_tol: float = struct.field(pytree_node=False, default=5e-3)
+    max_f_ang: float = struct.field(pytree_node=False, default=jnp.pi / 6)
+
+
+def make_config(
+    params: RQPParams,
+    collision_radius: float,
+    max_deceleration: float,
+    n_env_cbfs: int = 10,
+    solver_iters: int = 150,
+    max_f_ang: float = float(jnp.pi / 6.0),
+) -> RQPCentralizedConfig:
+    """Defaults from reference :182-225 (RQP: max payload tilt 15 deg)."""
+    n = params.n
+    mTg = float(params.mT) * GRAVITY
+    return RQPCentralizedConfig(
+        min_fz=mTg / (n * 10.0),
+        sec_max_f_ang=float(1.0 / jnp.cos(max_f_ang)),
+        max_f=2.0 * mTg / n,
+        cos_max_p_ang=float(jnp.cos(jnp.pi / 12.0)),
+        alpha1_p_cbf=1.0,
+        alpha2_p_cbf=1.0,
+        max_wl_sq=float((jnp.pi / 6.0) ** 2),
+        alpha_wl_cbf=1.0,
+        max_vl_sq=1.0,
+        alpha_vl_cbf=1.0,
+        dist_eps=0.1,
+        vision_radius=collision_radius + 5.0,
+        alpha_env_cbf=2.0,
+        max_deceleration=max_deceleration,
+        k_f=0.1,
+        k_m=0.1,
+        k_feq=0.1,
+        k_dvl=1.0,
+        k_dwl=1.0,
+        n_env_cbfs=n_env_cbfs,
+        solver_iters=solver_iters,
+        max_f_ang=max_f_ang,
+    )
+
+
+def equilibrium_forces(params: RQPParams) -> jnp.ndarray:
+    """Static equilibrium forces ``f_eq (n, 3)``: vertical thrusts solving the
+    least-squares wrench balance (reference :155-164)."""
+    n = params.n
+    # hat(r_com_i) e3 = r_com_i x e3; rows [1, (r_com_i x e3)_x, (r_com_i x e3)_y].
+    e3 = jnp.array([0.0, 0.0, 1.0], dtype=params.r.dtype)
+    rxe = jnp.cross(params.r_com, e3)  # (n, 3)
+    wrench = jnp.concatenate([jnp.ones((n, 1), params.r.dtype), rxe[:, :2]], axis=1).T
+    rhs = jnp.array([params.mT * GRAVITY, 0.0, 0.0], dtype=params.r.dtype)
+    fz = jnp.linalg.lstsq(wrench, rhs)[0]  # (n,)
+    return jnp.concatenate([jnp.zeros((n, 2), params.r.dtype), fz[:, None]], axis=1)
+
+
+@struct.dataclass
+class CtrlState:
+    """Mutable controller state threaded through the rollout scan: previous
+    solution (failure fallback, :441-444) + solver warm start (:427-434)."""
+
+    prev_f: jnp.ndarray  # (n, 3)
+    warm: socp.SOCPSolution
+
+
+def init_ctrl_state(params: RQPParams, cfg: RQPCentralizedConfig) -> CtrlState:
+    n = params.n
+    n_box = 12 + n + cfg.n_env_cbfs
+    m = n_box + 8 * n  # box rows + 2n SOC(4) blocks (see _build_qp).
+    f_eq = equilibrium_forces(params)
+    x0 = jnp.concatenate([jnp.zeros(9, f_eq.dtype), f_eq.reshape(-1)])
+    warm = socp.SOCPSolution(
+        x=x0,
+        y=jnp.zeros((m,), f_eq.dtype),
+        z=jnp.zeros((m,), f_eq.dtype),
+        prim_res=jnp.zeros((), f_eq.dtype),
+        dual_res=jnp.zeros((), f_eq.dtype),
+    )
+    return CtrlState(prev_f=f_eq, warm=warm)
+
+
+def _build_qp(
+    params: RQPParams,
+    cfg: RQPCentralizedConfig,
+    f_eq: jnp.ndarray,
+    state: RQPState,
+    acc_des,
+    env_cbf: EnvCBF,
+):
+    """Assemble ``(P, q, A, lb, ub, shift)`` for the current state. Pure, jittable.
+
+    Variable layout: [dv_com 0:3 | dvl 3:6 | dwl 6:9 | f 9:9+3n] (agent-major).
+    Box rows: [dyn-trans 3 | dyn-rot 3 | kin 3 | fz_min n | tilt 1 | wl 1 | vl 1 |
+    env k]; then per agent two SOC(4) blocks (thrust cone, norm cap).
+    """
+    n = params.n
+    dtype = state.xl.dtype
+    nv = 9 + 3 * n
+    dvl_des, dwl_des = acc_des
+    e3 = jnp.array([0.0, 0.0, 1.0], dtype=dtype)
+    Rl = state.Rl
+
+    # --- Cost.
+    P = jnp.zeros((nv, nv), dtype)
+    q = jnp.zeros((nv,), dtype)
+    # k_dvl, k_dwl blocks.
+    P = P.at[3:6, 3:6].add(2.0 * cfg.k_dvl * jnp.eye(3, dtype=dtype))
+    q = q.at[3:6].add(-2.0 * cfg.k_dvl * dvl_des)
+    P = P.at[6:9, 6:9].add(2.0 * cfg.k_dwl * jnp.eye(3, dtype=dtype))
+    q = q.at[6:9].add(-2.0 * cfg.k_dwl * dwl_des)
+    # Force blocks: S = [I .. I] (3, 3n); G_i = hat(r_com_i) Rl^T (3, 3n).
+    S = jnp.tile(jnp.eye(3, dtype=dtype), (1, n))
+    G = jnp.concatenate(
+        [lie.hat(params.r_com[i]) @ Rl.T for i in range(n)], axis=1
+    )  # (3, 3n)
+    Pff = (
+        2.0 * cfg.k_f * (S.T @ S)
+        + 2.0 * cfg.k_m * (G.T @ G)
+        + 2.0 * cfg.k_feq * jnp.eye(3 * n, dtype=dtype)
+    )
+    P = P.at[9:, 9:].add(Pff)
+    q = q.at[9:].add(
+        -2.0 * cfg.k_f * (S.T @ (params.mT * GRAVITY * e3))
+        - 2.0 * cfg.k_feq * f_eq.reshape(-1)
+    )
+
+    # --- Box constraint rows.
+    n_box = 12 + n + cfg.n_env_cbfs
+    A = jnp.zeros((n_box, nv), dtype)
+    lb = jnp.zeros((n_box,), dtype)
+    ub = jnp.zeros((n_box,), dtype)
+
+    # Dynamics translation (rows 0:3): mT dv_com - sum_i f_i = -mT g e3.
+    A = A.at[0:3, 0:3].set(params.mT * jnp.eye(3, dtype=dtype))
+    A = A.at[0:3, 9:].set(-S)
+    rhs = -params.mT * GRAVITY * e3
+    lb = lb.at[0:3].set(rhs)
+    ub = ub.at[0:3].set(rhs)
+
+    # Dynamics rotation (rows 3:6): dwl - sum_i JT_inv hat(r_com_i) Rl^T f_i
+    #   = -JT_inv (wl x JT wl).
+    A = A.at[3:6, 6:9].set(jnp.eye(3, dtype=dtype))
+    A = A.at[3:6, 9:].set(-params.JT_inv @ G)
+    rot_rhs = -params.JT_inv @ jnp.cross(state.wl, params.JT @ state.wl)
+    lb = lb.at[3:6].set(rot_rhs)
+    ub = ub.at[3:6].set(rot_rhs)
+
+    # Kinematics (rows 6:9): dvl - dv_com - Rl hat(x_com) dwl = -Rl hat^2(wl) x_com.
+    R_w_hat = Rl @ lie.hat(state.wl)
+    R_w_hat_sq = Rl @ lie.hat_square(state.wl, state.wl)
+    A = A.at[6:9, 0:3].set(-jnp.eye(3, dtype=dtype))
+    A = A.at[6:9, 3:6].set(jnp.eye(3, dtype=dtype))
+    A = A.at[6:9, 6:9].set(-Rl @ lie.hat(params.x_com))
+    kin_rhs = -R_w_hat_sq @ params.x_com
+    lb = lb.at[6:9].set(kin_rhs)
+    ub = ub.at[6:9].set(kin_rhs)
+
+    # f_z lower bounds (rows 9:9+n).
+    for i in range(n):
+        A = A.at[9 + i, 9 + 3 * i + 2].set(1.0)
+    lb = lb.at[9 : 9 + n].set(cfg.min_fz)
+    ub = ub.at[9 : 9 + n].set(socp.INF)
+
+    # Payload tilt second-order CBF (row 9+n):
+    # -(e3^T Rl hat(e3)) dwl >= -R_w_hat_sq[2,2] - (a1+a2) R_w_hat[2,2]
+    #                           - a1 a2 (Rl[2,2] - cos_max_p_ang).
+    r_tilt = 9 + n
+    A = A.at[r_tilt, 6:9].set(-(Rl[2] @ lie.hat(e3)))
+    tilt_rhs = (
+        -R_w_hat_sq[2, 2]
+        - (cfg.alpha1_p_cbf + cfg.alpha2_p_cbf) * R_w_hat[2, 2]
+        - cfg.alpha1_p_cbf * cfg.alpha2_p_cbf * (Rl[2, 2] - cfg.cos_max_p_ang)
+    )
+    lb = lb.at[r_tilt].set(tilt_rhs)
+    ub = ub.at[r_tilt].set(socp.INF)
+
+    # |wl| CBF (row 10+n): -2 wl . dwl >= -alpha (max_wl^2 - ||wl||^2).
+    r_wl = 10 + n
+    A = A.at[r_wl, 6:9].set(-2.0 * state.wl)
+    lb = lb.at[r_wl].set(
+        -cfg.alpha_wl_cbf * (cfg.max_wl_sq - jnp.dot(state.wl, state.wl))
+    )
+    ub = ub.at[r_wl].set(socp.INF)
+
+    # |vl| CBF (row 11+n): -2 vl . dvl >= -alpha (max_vl^2 - ||vl||^2).
+    r_vl = 11 + n
+    A = A.at[r_vl, 3:6].set(-2.0 * state.vl)
+    lb = lb.at[r_vl].set(
+        -cfg.alpha_vl_cbf * (cfg.max_vl_sq - jnp.dot(state.vl, state.vl))
+    )
+    ub = ub.at[r_vl].set(socp.INF)
+
+    # Env collision CBF rows (12+n : 12+n+k): lhs @ dvl >= rhs.
+    r_env = 12 + n
+    A = A.at[r_env : r_env + cfg.n_env_cbfs, 3:6].set(env_cbf.lhs)
+    lb = lb.at[r_env : r_env + cfg.n_env_cbfs].set(env_cbf.rhs)
+    ub = ub.at[r_env : r_env + cfg.n_env_cbfs].set(socp.INF)
+
+    # --- SOC rows: per agent [sec30 f_z; f] (cone) + [max_f; f] (cap).
+    soc = jnp.zeros((8 * n, nv), dtype)
+    shift_soc = jnp.zeros((8 * n,), dtype)
+    for i in range(n):
+        base = 8 * i
+        fi = 9 + 3 * i
+        soc = soc.at[base, fi + 2].set(cfg.sec_max_f_ang)
+        soc = soc.at[base + 1 : base + 4, fi : fi + 3].set(jnp.eye(3, dtype=dtype))
+        # Norm cap: top element is the constant max_f (enters via shift).
+        shift_soc = shift_soc.at[base + 4].set(cfg.max_f)
+        soc = soc.at[base + 5 : base + 8, fi : fi + 3].set(jnp.eye(3, dtype=dtype))
+
+    A_full = jnp.concatenate([A, soc], axis=0)
+    shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    return P, q, A_full, lb, ub, shift
+
+
+def control(
+    params: RQPParams,
+    cfg: RQPCentralizedConfig,
+    f_eq: jnp.ndarray,
+    ctrl_state: CtrlState,
+    state: RQPState,
+    acc_des,
+    env_cbf: EnvCBF | None = None,
+):
+    """One control step: ``-> (f_des (n, 3), CtrlState, SolverStats)``.
+
+    Mirrors ``RQPCentralizedController.control`` (:436-448): solve the conic QP
+    warm-started from the previous step; if the solve failed to converge, fall back
+    to the previous forces.
+    """
+    n = params.n
+    if env_cbf is None:
+        env_cbf = inactive_env_cbf(
+            cfg.n_env_cbfs, cfg.vision_radius, cfg.dist_eps, cfg.alpha_env_cbf,
+            dtype=state.xl.dtype,
+        )
+    P, q, A, lb, ub, shift = _build_qp(params, cfg, f_eq, state, acc_des, env_cbf)
+    n_box = 12 + n + cfg.n_env_cbfs
+    sol = socp.solve_socp(
+        P, q, A, lb, ub,
+        n_box=n_box,
+        soc_dims=(4,) * (2 * n),
+        iters=cfg.solver_iters,
+        warm=ctrl_state.warm,
+        shift=shift,
+    )
+    f = sol.x[9:].reshape(n, 3)
+    ok = (sol.prim_res < cfg.solver_tol) & jnp.all(jnp.isfinite(sol.x))
+    f_out = jnp.where(ok, f, ctrl_state.prev_f)
+    # On failure keep the previous warm start too — warm-starting from a NaN or
+    # garbage iterate would poison every subsequent solve (the reference recovers
+    # because cvxpy re-solves from scratch; we must recover explicitly).
+    keep = lambda new, old: jnp.where(ok, new, old)
+    warm = socp.SOCPSolution(
+        x=keep(sol.x, ctrl_state.warm.x),
+        y=keep(sol.y, ctrl_state.warm.y),
+        z=keep(sol.z, ctrl_state.warm.z),
+        prim_res=sol.prim_res,
+        dual_res=sol.dual_res,
+    )
+    new_state = CtrlState(prev_f=f_out, warm=warm)
+    stats = SolverStats(
+        iters=jnp.asarray(-1, jnp.int32),
+        solve_res=sol.prim_res,
+        collision=env_cbf.collision,
+        min_env_dist=env_cbf.min_dist,
+    )
+    return f_out, new_state, stats

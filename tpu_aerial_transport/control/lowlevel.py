@@ -1,0 +1,74 @@
+"""Low-level per-quadrotor (thrust, moment) controller for the RQP model.
+
+TPU-native replacement for reference ``control/rqp_centralized.py:457-535``
+(``RQPLowLevelController``): maps desired world-frame force vectors ``f_des (n, 3)``
+to per-quad scalar thrusts + body moments, fully vmapped over the agent axis.
+
+- thrust_i = <f_des_i, R_i e3>                      (reference :527)
+- attitude target: zero-yaw rotation with body z along f_des_i / ||f_des_i||
+  (reference :503-516, 529-530)
+- moment from the PD or sliding-mode SO(3) law with ``wd = dwd = 0`` (the reference
+  notes at :531 that ``wd = state.w`` "causes instability").
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from flax import struct
+
+from tpu_aerial_transport.control import so3_tracking
+from tpu_aerial_transport.models.rqp import RQPParams, RQPState
+from tpu_aerial_transport.ops import lie
+
+
+@struct.dataclass
+class LowLevelController:
+    """Pure-pytree controller config. ``so3_params`` selects the law by type."""
+
+    J: jnp.ndarray  # (n, 3, 3) quad inertias.
+    so3_params: so3_tracking.So3PDParams | so3_tracking.So3SMParams
+
+    def control(self, state: RQPState, f_des: jnp.ndarray):
+        """``f_des (n, 3)`` -> ``(f (n,), M (n, 3))``. Jit/vmap-safe."""
+        return lowlevel_control(self.J, self.so3_params, state, f_des)
+
+
+def make_lowlevel_controller(
+    so3_controller_type: str, params: RQPParams
+) -> LowLevelController:
+    """Factory mirroring ``RQPLowLevelController.__init__`` (gains at :487-497)."""
+    if so3_controller_type == "pd":
+        ll = so3_tracking.So3PDParams(k_R=0.25, k_Omega=0.075)
+    elif so3_controller_type == "sm":
+        ll = so3_tracking.So3SMParams(
+            r=0.5, k_R=1.415, l_R=0.707, k_s=0.113, l_s=0.057
+        )
+    else:
+        raise NotImplementedError(so3_controller_type)
+    return LowLevelController(J=params.J, so3_params=ll)
+
+
+def lowlevel_control(J, so3_params, state: RQPState, f_des):
+    """Batched low-level control step (the body of ``RQPLowLevelController.control``,
+    reference :518-535, without the per-agent Python loop)."""
+    # Scalar thrusts: projection of the desired force on each quad's body z-axis.
+    body_z = state.R[..., :, 2]  # (n, 3) = R_i e3.
+    f = jnp.sum(f_des * body_z, axis=-1)  # (n,)
+
+    # Attitude targets: zero-yaw rotation with z-axis along f_des.
+    qd = f_des / jnp.linalg.norm(f_des, axis=-1, keepdims=True)
+    Rd = lie.rotation_from_z(qd)  # (n, 3, 3)
+
+    wd = jnp.zeros_like(state.w)
+    dwd = jnp.zeros_like(state.w)
+    if isinstance(so3_params, so3_tracking.So3PDParams):
+        M = so3_tracking.so3_pd_tracking_control(
+            state.R, Rd, state.w, wd, dwd, J, so3_params
+        )
+    else:
+        M = so3_tracking.so3_sm_tracking_control(
+            state.R, Rd, state.w, wd, dwd, J, so3_params
+        )
+    return f, M

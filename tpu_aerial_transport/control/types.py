@@ -1,0 +1,48 @@
+"""Shared controller data types (pytrees)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class SolverStats:
+    """Per-control-step statistics (reference ``SolverStatistics``,
+    control/rqp_centralized.py:18-24). ``iters`` is -1 for the centralized solver;
+    distributed solvers report consensus iterations. ``err_seq`` (fixed-length,
+    NaN-padded) carries per-iteration consensus residuals for convergence plots."""
+
+    iters: jnp.ndarray  # () int32.
+    solve_res: jnp.ndarray  # () primal residual of the conic solve.
+    collision: jnp.ndarray  # () bool.
+    min_env_dist: jnp.ndarray  # () float.
+    err_seq: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((0,))
+    )  # (max_iters,) consensus residuals (distributed only).
+
+
+@struct.dataclass
+class EnvCBF:
+    """Environment collision-avoidance CBF rows ``lhs @ dvl >= rhs`` plus the
+    side-channel observability outputs (reference
+    ``_set_collision_avoidance_cbf_parameters``, control/rqp_centralized.py:280-337).
+    Inactive rows are lhs = 0 with rhs < 0 (vacuously satisfied)."""
+
+    lhs: jnp.ndarray  # (k, 3).
+    rhs: jnp.ndarray  # (k,).
+    collision: jnp.ndarray  # () bool.
+    min_dist: jnp.ndarray  # () float.
+
+
+def inactive_env_cbf(
+    n_rows: int, vision_radius: float, dist_eps: float, alpha: float,
+    dtype=jnp.float32,
+) -> EnvCBF:
+    """The no-environment default (reference :281-288)."""
+    return EnvCBF(
+        lhs=jnp.zeros((n_rows, 3), dtype),
+        rhs=jnp.full((n_rows,), -alpha * (vision_radius - dist_eps), dtype),
+        collision=jnp.zeros((), bool),
+        min_dist=jnp.asarray(vision_radius, dtype),
+    )

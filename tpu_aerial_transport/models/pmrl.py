@@ -1,0 +1,194 @@
+"""Point-mass rigid-link (PMRL) system model.
+
+TPU-native re-design of reference ``system/point_mass_rigid_link.py``: ``n``
+point-mass robots attached to payload body points ``r_i`` through massless rigid
+links of length ``L_i``; link directions ``q_i`` live on S^2 and are extra state.
+Robot positions are ``x_i = xl + L_i q_i + Rl r_i``. Dynamics (reference docstring
+:135-146):
+
+    m_i x_i'' = f_i - m_i g e3 - T_i q_i,
+    ml dvl    = sum_i T_i q_i - ml g e3,
+    Jl dwl + wl x Jl wl = sum_i r_i x (T_i Rl^T q_i),
+    q_i . ddq_i = -||dq_i||^2        (sphere constraint, second derivative)
+
+with link tensions ``T in R^n`` solved from an n x n SPD system each step
+(reference :156-208). This is the only model with implicit constraint forces; the
+SPD solve is a batched ``jnp.linalg.solve`` on an n x n matrix (Cholesky-sized for
+n <= O(100) agents, trivially vmappable over scenarios).
+
+Layout: agent axis leading (``q, dq, f: (n, 3)``), pure functions, S^2 projection
+every step + SO(3) projection every 20 (reference :101-132).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from tpu_aerial_transport.ops import lie
+
+GRAVITY = 9.80665
+PROJECTION_PERIOD = 20
+
+
+@struct.dataclass
+class PMRLParams:
+    """Reference ``PMRLParameters`` (point_mass_rigid_link.py:37-64)."""
+
+    m: jnp.ndarray  # (n,) robot masses.
+    ml: jnp.ndarray  # () payload mass.
+    Jl: jnp.ndarray  # (3, 3) payload inertia.
+    r: jnp.ndarray  # (n, 3) link attachment points (payload body frame).
+    L: jnp.ndarray  # (n,) link lengths.
+    Jl_inv: jnp.ndarray  # (3, 3).
+    Jl_inv_factor: jnp.ndarray  # (3, 3) F with F^T F = Jl_inv (for SPD assembly).
+
+    @property
+    def n(self) -> int:
+        return self.r.shape[-2]
+
+
+def pmrl_params(m, ml, Jl, r, L, dtype=jnp.float32) -> PMRLParams:
+    m = jnp.asarray(m, dtype)
+    ml = jnp.asarray(ml, dtype)
+    Jl = jnp.asarray(Jl, dtype)
+    r = jnp.asarray(r, dtype)
+    L = jnp.asarray(L, dtype)
+    n = r.shape[0]
+    assert m.shape == (n,) and L.shape == (n,) and Jl.shape == (3, 3)
+    Jl_inv = jnp.linalg.inv(Jl)
+    # jnp Cholesky is lower (A = C C^T); F = C^T satisfies F^T F = Jl_inv.
+    Jl_inv_factor = jnp.linalg.cholesky(Jl_inv).T
+    return PMRLParams(m=m, ml=ml, Jl=Jl, r=r, L=L, Jl_inv=Jl_inv,
+                      Jl_inv_factor=Jl_inv_factor)
+
+
+@struct.dataclass
+class PMRLState:
+    """Reference ``PMRLState`` (point_mass_rigid_link.py:67-132)."""
+
+    q: jnp.ndarray  # (n, 3) unit link directions (world frame).
+    dq: jnp.ndarray  # (n, 3) tangent velocities, q_i . dq_i = 0.
+    xl: jnp.ndarray  # (3,) payload CoM position.
+    vl: jnp.ndarray  # (3,) payload CoM velocity.
+    Rl: jnp.ndarray  # (3, 3) payload rotation.
+    wl: jnp.ndarray  # (3,) body angular velocity.
+    step: jnp.ndarray  # () int32 projection counter.
+
+
+def _project_q(q, dq):
+    """Normalize q to S^2 and project dq onto the tangent space (reference :101-105)."""
+    q = q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+    dq = dq - q * jnp.sum(q * dq, axis=-1, keepdims=True)
+    return q, dq
+
+
+def pmrl_state(q, dq, xl, vl, Rl, wl, dtype=jnp.float32) -> PMRLState:
+    q, dq = _project_q(jnp.asarray(q, dtype), jnp.asarray(dq, dtype))
+    return PMRLState(
+        q=q,
+        dq=dq,
+        xl=jnp.asarray(xl, dtype),
+        vl=jnp.asarray(vl, dtype),
+        Rl=lie.polar_project_svd(jnp.asarray(Rl, dtype)),
+        wl=jnp.asarray(wl, dtype),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def forward_dynamics(params: PMRLParams, state: PMRLState, f):
+    """``f (n, 3)`` world-frame robot thrusts -> ``((ddq, dvl, dwl), T)``
+    (reference ``PMRLDynamics.forward_dynamics``, point_mass_rigid_link.py:156-208).
+
+    The link tensions T couple all agents through the payload: eliminating the
+    constraint forces yields an SPD system
+    ``[diag(1/m) + (1/ml) q q^T + rcq Jl_inv rcq^T] T = rhs`` where
+    ``rcq_i = r_i x Rl^T q_i``.
+    """
+    dtype = state.xl.dtype
+    gravity = jnp.array([0.0, 0.0, -GRAVITY], dtype=dtype)
+    q, dq, Rl, wl = state.q, state.dq, state.Rl, state.wl
+
+    cor_acc = params.Jl_inv @ jnp.cross(wl, params.Jl @ wl)  # (3,)
+    cor_mat = Rl @ (lie.hat_square(wl, wl) - lie.hat(cor_acc))  # (3, 3)
+    # add_force_i = f_i - cor_mat @ (m_i r_i): applied force net of payload
+    # rotational pseudo-forces transmitted through the attachment.
+    add_force = f - (params.r * params.m[:, None]) @ cor_mat.T  # (n, 3)
+
+    rhs = (
+        jnp.sum(add_force * q, axis=-1)
+        + params.m * params.L * jnp.sum(dq * dq, axis=-1)
+    ) / params.m  # (n,)
+
+    rcq = jnp.cross(params.r, q @ Rl)  # (n, 3); rows r_i x (Rl^T q_i).
+    temp = rcq @ params.Jl_inv_factor.T  # (n, 3); temp temp^T = rcq Jl_inv rcq^T.
+    lhs = (
+        jnp.diag(1.0 / params.m)
+        + (q @ q.T) / params.ml
+        + temp @ temp.T
+    )  # (n, n) SPD.
+    T = jnp.linalg.solve(lhs, rhs)  # (n,) link tensions.
+
+    qT = q.T @ T  # (3,) = sum_i T_i q_i.
+    rcqT = params.Jl_inv @ (rcq.T @ T)  # (3,)
+    mL = (params.m * params.L)[:, None]
+    ddq = (
+        (add_force - q * T[:, None]) / mL
+        - qT / (params.ml * params.L)[:, None]
+        - (params.r / params.L[:, None]) @ (Rl @ lie.hat(rcqT)).T
+    )
+    dvl = qT / params.ml + gravity
+    dwl = rcqT - cor_acc
+    return (ddq, dvl, dwl), T
+
+
+def integrate_state(state: PMRLState, acc, dt,
+                    project_every: int = PROJECTION_PERIOD) -> PMRLState:
+    """Trapezoidal integrator; q re-projected to S^2 every step, Rl to SO(3)
+    every ``project_every`` steps (reference :113-132)."""
+    ddq, dvl, dwl = acc
+    q = state.q + state.dq * dt + ddq * (dt**2 / 2)
+    dq = state.dq + ddq * dt
+    q, dq = _project_q(q, dq)
+    xl = state.xl + state.vl * dt + dvl * (dt**2 / 2)
+    vl = state.vl + dvl * dt
+    Rl = state.Rl @ lie.expm_so3((state.wl + dwl * (dt / 2)) * dt)
+    wl = state.wl + dwl * dt
+    step = state.step + 1
+    project = step >= project_every
+    Rl = jnp.where(project, lie.polar_project(Rl), Rl)
+    step = jnp.where(project, 0, step)
+    return state.replace(q=q, dq=dq, xl=xl, vl=vl, Rl=Rl, wl=wl, step=step)
+
+
+def integrate(params: PMRLParams, state: PMRLState, f, dt,
+              project_every: int = PROJECTION_PERIOD) -> PMRLState:
+    acc, _ = forward_dynamics(params, state, f)
+    return integrate_state(state, acc, dt, project_every)
+
+
+def inverse_dynamics_error(state: PMRLState, params: PMRLParams, f, T, acc):
+    """Residual norm of all four dynamics equations incl. the sphere constraint —
+    the test oracle (reference :210-249); validates the implicit tension solve."""
+    ddq, dvl, dwl = acc
+    gravity = jnp.array([0.0, 0.0, -GRAVITY], dtype=state.xl.dtype)
+    q, Rl, wl = state.q, state.Rl, state.wl
+
+    kin = (lie.hat_square(wl, wl) + lie.hat(dwl)) @ params.r.T  # (3, n)
+    dv_robot = dvl[None, :] + ddq * params.L[:, None] + (Rl @ kin).T  # (n, 3)
+    robot_res = (
+        dv_robot * params.m[:, None]
+        - f
+        - gravity * params.m[:, None]
+        + q * T[:, None]
+    )
+    load_lin_res = params.ml * dvl - q.T @ T - params.ml * gravity
+    rcq = jnp.cross(params.r, q @ Rl)
+    load_ang_res = params.Jl @ dwl + jnp.cross(wl, params.Jl @ wl) - rcq.T @ T
+    sphere_res = jnp.sum(q * ddq, axis=-1) + jnp.sum(state.dq**2, axis=-1)
+    return jnp.sqrt(
+        jnp.sum(robot_res**2)
+        + jnp.sum(load_lin_res**2)
+        + jnp.sum(load_ang_res**2)
+        + jnp.sum(sphere_res**2)
+    )

@@ -1,0 +1,129 @@
+"""Rigid-payload (RP) system model.
+
+TPU-native re-design of reference ``system/rigid_payload.py``: a single rigid payload
+carried by ``n >= 3`` abstract point-force actuators attached at body-frame points
+``r_i`` (no actuator dynamics). Dynamics (reference docstring :92-98):
+
+    ml dvl = sum_i f_i - ml g e3,
+    Jl dwl + wl x Jl wl = sum_i r_i x Rl^T f_i.
+
+Same conventions as :mod:`tpu_aerial_transport.models.rqp`: structure-of-arrays
+pytrees with the agent axis leading (``r, f: (n, 3)``), pure functions, periodic
+Newton-Schulz SO(3) re-projection.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from tpu_aerial_transport.ops import lie
+
+GRAVITY = 9.80665
+PROJECTION_PERIOD = 20  # reference rigid_payload.py:12.
+
+
+@struct.dataclass
+class RPParams:
+    """Reference ``RPParameters`` (rigid_payload.py:33-47), agent axis leading."""
+
+    ml: jnp.ndarray  # () payload mass.
+    Jl: jnp.ndarray  # (3, 3) payload inertia.
+    r: jnp.ndarray  # (n, 3) actuator attachment points (body frame).
+    Jl_inv: jnp.ndarray  # (3, 3).
+
+    @property
+    def n(self) -> int:
+        return self.r.shape[-2]
+
+
+def rp_params(ml, Jl, r, dtype=jnp.float32) -> RPParams:
+    ml = jnp.asarray(ml, dtype)
+    Jl = jnp.asarray(Jl, dtype)
+    r = jnp.asarray(r, dtype)
+    assert Jl.shape == (3, 3) and r.ndim == 2 and r.shape[-1] == 3
+    return RPParams(ml=ml, Jl=Jl, r=r, Jl_inv=jnp.linalg.inv(Jl))
+
+
+@struct.dataclass
+class RPState:
+    """Reference ``RPState`` (rigid_payload.py:50-88)."""
+
+    xl: jnp.ndarray  # (3,) payload position.
+    vl: jnp.ndarray  # (3,) payload velocity.
+    Rl: jnp.ndarray  # (3, 3) payload rotation.
+    wl: jnp.ndarray  # (3,) body angular velocity.
+    step: jnp.ndarray  # () int32 projection counter.
+
+
+def rp_state(xl, vl, Rl, wl, dtype=jnp.float32) -> RPState:
+    return RPState(
+        xl=jnp.asarray(xl, dtype),
+        vl=jnp.asarray(vl, dtype),
+        Rl=lie.polar_project_svd(jnp.asarray(Rl, dtype)),
+        wl=jnp.asarray(wl, dtype),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def rp_identity_state(dtype=jnp.float32) -> RPState:
+    z3 = jnp.zeros(3, dtype)
+    return RPState(xl=z3, vl=z3, Rl=jnp.eye(3, dtype=dtype), wl=z3,
+                   step=jnp.zeros((), jnp.int32))
+
+
+def forward_dynamics(params: RPParams, state: RPState, f):
+    """``f (n, 3)`` world-frame actuator forces -> ``(dvl, dwl)``
+    (reference ``RPDynamics.forward_dynamics``, rigid_payload.py:107-130)."""
+    gravity = jnp.array([0.0, 0.0, -GRAVITY], dtype=state.xl.dtype)
+    dvl = jnp.sum(f, axis=0) / params.ml + gravity
+    f_body = f @ state.Rl  # rows = Rl^T f_i.
+    net_moment = jnp.sum(jnp.cross(params.r, f_body), axis=0)
+    Jlwl = params.Jl @ state.wl
+    dwl = params.Jl_inv @ (net_moment - jnp.cross(state.wl, Jlwl))
+    return dvl, dwl
+
+
+def integrate_state(state: RPState, acc, dt,
+                    project_every: int = PROJECTION_PERIOD) -> RPState:
+    """Semi-implicit trapezoidal manifold integrator (rigid_payload.py:76-88)."""
+    dvl, dwl = acc
+    xl = state.xl + state.vl * dt + dvl * (dt**2 / 2)
+    vl = state.vl + dvl * dt
+    Rl = state.Rl @ lie.expm_so3((state.wl + dwl * (dt / 2)) * dt)
+    wl = state.wl + dwl * dt
+    step = state.step + 1
+    project = step >= project_every
+    Rl = jnp.where(project, lie.polar_project(Rl), Rl)
+    step = jnp.where(project, 0, step)
+    return state.replace(xl=xl, vl=vl, Rl=Rl, wl=wl, step=step)
+
+
+def integrate(params: RPParams, state: RPState, f, dt,
+              project_every: int = PROJECTION_PERIOD) -> RPState:
+    return integrate_state(state, forward_dynamics(params, state, f), dt,
+                           project_every)
+
+
+def inverse_dynamics_error(state: RPState, params: RPParams, f, acc):
+    """Newton-Euler residual norm — the test oracle (rigid_payload.py:132-156)."""
+    dvl, dwl = acc
+    gravity = jnp.array([0.0, 0.0, -GRAVITY], dtype=state.xl.dtype)
+    lin_res = params.ml * dvl - jnp.sum(f, axis=0) - params.ml * gravity
+    f_body = f @ state.Rl
+    net_moment = jnp.sum(jnp.cross(params.r, f_body), axis=0)
+    Jlwl = params.Jl @ state.wl
+    ang_res = params.Jl @ dwl + jnp.cross(state.wl, Jlwl) - net_moment
+    return jnp.sqrt(jnp.sum(lin_res**2) + jnp.sum(ang_res**2))
+
+
+class RPCollision:
+    """Host-side collision metadata (reference ``RPCollision``, rigid_payload.py:164-185)."""
+
+    def __init__(self, payload_vertices, payload_mesh_vertices):
+        self.payload_vertices = np.asarray(payload_vertices, np.float64)
+        self.payload_mesh_vertices = np.asarray(payload_mesh_vertices, np.float64)
+        self.collision_radius = float(
+            np.max(np.linalg.norm(self.payload_mesh_vertices, axis=1)) + 0.1
+        )

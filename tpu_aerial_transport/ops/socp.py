@@ -1,0 +1,250 @@
+"""Batched conic QP/SOCP solver in pure JAX — the TPU-native replacement for
+cvxpy + Clarabel (SURVEY.md §2.9, "the hard core of the port").
+
+Problem form (OSQP-style splitting with a generalized cone):
+
+    minimize    (1/2) x^T P x + q^T x
+    subject to  A x in C,      C = Box(l, u)  x  SOC(d_1) x ... x SOC(d_k)
+
+where the first ``n_box`` rows of ``A`` are box rows (equalities encoded as
+``l == u``) and the remaining rows are second-order-cone blocks
+``{ z : ||z[1:]||_2 <= z[0] }`` of *static* dims ``soc_dims``. This covers every
+problem the reference builds with cvxpy (control/rqp_*.py): quadratic costs, linear
+equalities (dynamics, kinematics), linear inequalities (CBF rows, min-thrust), and
+per-agent SOC constraints (thrust cone, force norm cap).
+
+Solver: ADMM
+
+    x+ = (P + sigma I + A^T diag(rho) A)^{-1} (sigma x - q + A^T diag(rho)(z - y/rho))
+    z+ = Pi_C(alpha A x+ + (1-alpha) z + y / rho)
+    y+ = y + rho (alpha A x+ + (1-alpha) z - z+)
+
+with over-relaxation ``alpha``, per-row penalty (equality rows get
+``rho * EQ_RHO_SCALE``), a single Cholesky factorization per solve (the KKT matrix
+is ~(12+3n)^2 — tiny, so refactoring per control step is cheap and keeps the
+iteration matmul-only for the MXU), and a fixed iteration count under ``lax.scan``
+(fixed shapes; vmappable over agents and Monte-Carlo scenarios; warm-startable by
+passing the previous ``(x, y, z)``).
+
+Design notes vs the reference:
+- cvxpy re-canonicalizes + Clarabel re-factorizes on every ``solve()`` call on the
+  host; here the whole solver is one fused XLA computation, so a vmapped batch of
+  n agent subproblems costs one kernel launch.
+- Clarabel is an interior-point method (high accuracy, ~10 iters, but serial and
+  branchy); ADMM trades per-iteration cost for TPU-friendly structure. The
+  reference's own consensus loop only needs ~1e-2-accurate forces (res_tol = 1e-2 N,
+  control/rqp_cadmm.py:561), well within ADMM's comfort zone.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+EQ_RHO_SCALE = 1e3  # OSQP's rho boost for equality rows.
+INF = 1e20  # "infinity" bound; keeps arithmetic finite in f32... used via clipping.
+
+
+class SOCPSolution(NamedTuple):
+    x: jnp.ndarray  # (nv,) primal solution.
+    y: jnp.ndarray  # (m,) dual solution.
+    z: jnp.ndarray  # (m,) projected constraint values (A x at optimum).
+    prim_res: jnp.ndarray  # () inf-norm of A x - z.
+    dual_res: jnp.ndarray  # () inf-norm of P x + q + A^T y.
+
+
+def project_soc(z: jnp.ndarray) -> jnp.ndarray:
+    """Euclidean projection of ``z = (t, v) (..., d)`` onto the second-order cone
+    ``||v|| <= t`` (closed form; Boyd & Vandenberghe §8.1.1)."""
+    t = z[..., 0]
+    v = z[..., 1:]
+    nv = jnp.linalg.norm(v, axis=-1)
+    # Three regimes: inside (keep), polar cone (zero), outside (radial shrink).
+    inside = nv <= t
+    polar = nv <= -t
+    s = 0.5 * (t + nv)
+    scale = jnp.where(nv > 0, s / jnp.where(nv > 0, nv, 1.0), 0.0)
+    t_out = jnp.where(inside, t, jnp.where(polar, 0.0, s))
+    v_out = jnp.where(
+        inside[..., None],
+        v,
+        jnp.where(polar[..., None], 0.0, scale[..., None] * v),
+    )
+    return jnp.concatenate([t_out[..., None], v_out], axis=-1)
+
+
+def _project_cone(z, lb, ub, n_box: int, soc_dims: Sequence[int], shift=None):
+    """Project the stacked constraint vector onto the translated cone
+    ``{z : z + shift in Box x SOC x ... x SOC}`` (``Pi(z) = Pi_C(z + shift) - shift``).
+
+    ``shift`` carries constant offsets inside SOC blocks (e.g. the force-norm cap
+    ``||f_i|| <= max_f`` has constant top element ``max_f``); box rows encode their
+    offsets in ``lb``/``ub`` and must have zero shift.
+    """
+    if shift is not None:
+        z = z + shift
+    parts = []
+    if n_box:
+        parts.append(jnp.clip(z[..., :n_box], lb, ub))
+    off = n_box
+    # Group equal-dim SOC blocks into one batched projection (static grouping).
+    i = 0
+    dims = list(soc_dims)
+    while i < len(dims):
+        d = dims[i]
+        j = i
+        while j < len(dims) and dims[j] == d:
+            j += 1
+        k = j - i
+        blk = z[..., off : off + k * d].reshape(*z.shape[:-1], k, d)
+        parts.append(project_soc(blk).reshape(*z.shape[:-1], k * d))
+        off += k * d
+        i = j
+    out = jnp.concatenate(parts, axis=-1) if len(parts) > 1 else parts[0]
+    if shift is not None:
+        out = out - shift
+    return out
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_box", "soc_dims", "iters", "check_every", "tol"),
+)
+def solve_socp(
+    P: jnp.ndarray,
+    q: jnp.ndarray,
+    A: jnp.ndarray,
+    lb: jnp.ndarray,
+    ub: jnp.ndarray,
+    *,
+    n_box: int,
+    soc_dims: Sequence[int] = (),
+    iters: int = 200,
+    rho: float = 0.4,
+    sigma: float = 1e-6,
+    alpha: float = 1.6,
+    warm: SOCPSolution | None = None,
+    check_every: int = 0,
+    tol: float = 0.0,
+    shift: jnp.ndarray | None = None,
+    chol: jnp.ndarray | None = None,
+) -> SOCPSolution:
+    """Solve one conic QP. All array args may carry leading batch axes only via
+    ``vmap`` (this function itself is single-instance).
+
+    Args:
+      P: (nv, nv) PSD cost matrix. q: (nv,) linear cost.
+      A: (m, nv) constraint matrix; rows [box (n_box) | soc blocks (sum soc_dims)].
+      lb/ub: (n_box,) box bounds; equalities have lb == ub. Use +-INF for one-sided.
+      n_box / soc_dims: static cone layout.
+      iters: fixed ADMM iteration count (scan length).
+      warm: previous solution to warm-start from (the reference's
+        ``warm_start=True`` semantics, control/rqp_centralized.py:440).
+      check_every/tol: if nonzero, early-exit via ``lax.while_loop`` over chunks of
+        ``check_every`` scanned iterations once inf-norm residuals < tol.
+      shift: optional (m,) constant cone offset — the constraint becomes
+        ``A x + shift in C`` for the SOC rows (box rows must have zero shift).
+      chol: optional precomputed Cholesky factor of the KKT matrix
+        ``P + sigma I + A^T diag(rho_vec) A`` (see :func:`kkt_cholesky`). Callers
+        that re-solve with the same (P, A) but different q — e.g. the C-ADMM
+        consensus loop, where only the dual/consensus linear term moves between
+        iterations — factor once per control step and amortize.
+    """
+    m, nv = A.shape
+    assert m == n_box + sum(soc_dims)
+    dtype = P.dtype
+
+    rho_vec = jnp.full((m,), rho, dtype)
+    if n_box:
+        is_eq = (ub - lb) < 1e-9
+        rho_vec = rho_vec.at[:n_box].set(
+            jnp.where(is_eq, rho * EQ_RHO_SCALE, rho)
+        )
+
+    if chol is None:
+        M = P + sigma * jnp.eye(nv, dtype=dtype) + (A.T * rho_vec) @ A
+        chol = jnp.linalg.cholesky(M)
+
+    def kkt_solve(rhs):
+        t = jax.scipy.linalg.solve_triangular(chol, rhs, lower=True)
+        return jax.scipy.linalg.solve_triangular(chol.T, t, lower=False)
+
+    if warm is None:
+        x0 = jnp.zeros((nv,), dtype)
+        y0 = jnp.zeros((m,), dtype)
+        z0 = jnp.zeros((m,), dtype)
+        z0 = _project_cone(z0, lb, ub, n_box, soc_dims, shift)
+    else:
+        x0, y0, z0 = warm.x, warm.y, warm.z
+
+    def step(carry, _):
+        x, y, z = carry
+        rhs = sigma * x - q + A.T @ (rho_vec * z - y)
+        x_new = kkt_solve(rhs)
+        Ax = A @ x_new
+        Ax_rel = alpha * Ax + (1 - alpha) * z
+        z_new = _project_cone(Ax_rel + y / rho_vec, lb, ub, n_box, soc_dims, shift)
+        y_new = y + rho_vec * (Ax_rel - z_new)
+        return (x_new, y_new, z_new), None
+
+    def run_chunk(carry, k):
+        return lax.scan(step, carry, None, length=k)[0]
+
+    def residuals(carry):
+        x, y, z = carry
+        prim = jnp.max(jnp.abs(A @ x - z))
+        dual = jnp.max(jnp.abs(P @ x + q + A.T @ y))
+        return prim, dual
+
+    if check_every and tol > 0:
+        n_chunks = -(-iters // check_every)
+
+        def cond(s):
+            carry, i = s
+            prim, dual = residuals(carry)
+            return (i < n_chunks) & ((prim > tol) | (dual > tol))
+
+        def body(s):
+            carry, i = s
+            return run_chunk(carry, check_every), i + 1
+
+        carry, _ = lax.while_loop(cond, body, ((x0, y0, z0), 0))
+    else:
+        carry = run_chunk((x0, y0, z0), iters)
+
+    x, y, z = carry
+    prim, dual = residuals(carry)
+    return SOCPSolution(x=x, y=y, z=z, prim_res=prim, dual_res=dual)
+
+
+def make_rho_vec(m: int, n_box: int, lb, ub, rho: float, dtype=jnp.float32):
+    """Per-row ADMM penalty: equality rows (lb == ub) get ``rho * EQ_RHO_SCALE``."""
+    rho_vec = jnp.full((m,), rho, dtype)
+    if n_box:
+        is_eq = (ub - lb) < 1e-9
+        rho_vec = rho_vec.at[:n_box].set(jnp.where(is_eq, rho * EQ_RHO_SCALE, rho))
+    return rho_vec
+
+
+def kkt_cholesky(P, A, rho_vec, sigma: float = 1e-6):
+    """Factor the ADMM KKT matrix once for reuse across many ``solve_socp`` calls
+    with identical (P, A) (pass the result as ``chol=``)."""
+    nv = P.shape[-1]
+    M = P + sigma * jnp.eye(nv, dtype=P.dtype) + (jnp.swapaxes(A, -1, -2) * rho_vec[..., None, :]) @ A
+    return jnp.linalg.cholesky(M)
+
+
+def kkt_residuals(P, q, A, lb, ub, n_box, soc_dims, sol: SOCPSolution, shift=None):
+    """Standalone KKT check used by tests: stationarity, primal feasibility
+    (distance of A x to the cone), and complementary slackness proxy <y, Ax - z>."""
+    x, y = sol.x, sol.y
+    Ax = A @ x
+    proj = _project_cone(Ax, lb, ub, n_box, soc_dims, shift)
+    prim = jnp.max(jnp.abs(Ax - proj))
+    stat = jnp.max(jnp.abs(P @ x + q + A.T @ y))
+    comp = jnp.abs(jnp.dot(y, Ax - proj))
+    return stat, prim, comp
