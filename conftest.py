@@ -14,3 +14,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon site hook (PYTHONPATH=/root/.axon_site) rewrites jax_platforms to
+# "axon,cpu" at import, overriding the env var — override it back at the config
+# level, which wins because backends initialize lazily on first use.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,80 @@
+"""DD vs C-ADMM convergence-rate comparison.
+
+TPU-native counterpart of the reference's disabled-by-default benchmark harness
+``test/control/test_rqpcontrollers.py:101-156`` (``_plot_convergence_rate``):
+sample random desired accelerations, run both distributed solvers with tolerance
+0 and a fixed iteration budget from a cold start, and plot consensus-residual
+vs iteration curves with min/max bands. Here the samples are one ``vmap`` batch
+instead of a sequential Python loop.
+
+Usage: python examples/convergence_rates.py [--samples 100] [--iters 25]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--samples", type=int, default=100)
+    p.add_argument("--iters", type=int, default=25)
+    p.add_argument("-n", type=int, default=3)
+    p.add_argument("--out", default="convergence_rates.png")
+    args = p.parse_args()
+
+    from tpu_aerial_transport.control import cadmm, centralized, dd
+    from tpu_aerial_transport.harness import setup
+    from tpu_aerial_transport.viz import plots
+
+    params, col, state0 = setup.rqp_setup(args.n)
+    f_eq = centralized.equilibrium_forces(params)
+    # Tolerance 0 + fixed budget (the reference sets tol=0, max_iter=25).
+    acfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=args.iters, inner_iters=80, res_tol=0.0,
+    )
+    dcfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=args.iters, inner_iters=80, prim_inf_tol=0.0,
+    )
+
+    keys = jax.random.split(jax.random.PRNGKey(0), args.samples)
+    accs = jax.vmap(lambda k: 0.5 * jax.random.normal(k, (3,)))(keys)
+
+    def cadmm_run(acc):
+        astate = cadmm.init_cadmm_state(params, acfg)
+        _, _, stats = cadmm.control(
+            params, acfg, f_eq, astate, state0, (acc, jnp.zeros(3))
+        )
+        return stats.err_seq
+
+    def dd_run(acc):
+        dstate = dd.init_dd_state(params, dcfg)
+        _, _, stats = dd.control(
+            params, dcfg, f_eq, dstate, state0, (acc, jnp.zeros(3))
+        )
+        return stats.err_seq
+
+    print(f"running {args.samples} samples x {args.iters} iterations ...")
+    cadmm_errs = np.asarray(jax.jit(jax.vmap(cadmm_run))(accs))
+    dd_errs = np.asarray(jax.jit(jax.vmap(dd_run))(accs))
+
+    for label, errs in (("C-ADMM", cadmm_errs), ("DD", dd_errs)):
+        final = errs[:, min(args.iters, errs.shape[1]) - 1]
+        final = final[~np.isnan(final)]
+        print(f"{label}: median residual after {args.iters} iters: "
+              f"{np.median(final):.2e} N")
+
+    plots.plot_convergence_rates(
+        {"C-ADMM": cadmm_errs, "DD": dd_errs}, args.out
+    )
+    print(f"figure saved to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Main simulation entry point: RQP team flying through the forest under
+centralized / C-ADMM / dual-decomposition MPC.
+
+TPU-native counterpart of reference ``example/rqp_example.py:main()``: same
+workload shape (n agents, dt = 1e-3 s, high-level control at 100 Hz, forest env,
+terrain-following reference trajectory), but the whole rollout is one jitted
+two-rate ``lax.scan`` and the controller is selected by CLI flag instead of
+editing the source (the reference's config story, SURVEY.md §5.6).
+
+Usage:
+  python examples/rqp_forest.py --controller centralized -T 10
+  python examples/rqp_forest.py --controller cadmm -n 8 -T 5 --plots
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--controller", default="centralized",
+                   choices=["centralized", "cadmm", "dd"])
+    p.add_argument("-n", type=int, default=3, help="number of quadrotors")
+    p.add_argument("-T", type=float, default=10.0, help="sim horizon [s]")
+    p.add_argument("--dt", type=float, default=1e-3)
+    p.add_argument("--hl-rel-freq", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0, help="forest seed")
+    p.add_argument("--out", default=None, help="npz log path")
+    p.add_argument("--plots", action="store_true", help="save figures")
+    args = p.parse_args()
+
+    from tpu_aerial_transport.control import cadmm, centralized, dd, lowlevel
+    from tpu_aerial_transport.envs import forest as forest_mod
+    from tpu_aerial_transport.harness import rollout as ro
+    from tpu_aerial_transport.harness import setup
+    from tpu_aerial_transport.utils.stats import compute_aggregate_statistics
+
+    params, col, state0 = setup.rqp_setup(args.n)
+    forest = forest_mod.make_forest(seed=args.seed)
+    f_eq = centralized.equilibrium_forces(params)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+    acc_des_fn = ro.make_forest_acc_des(forest)
+    state0 = state0.replace(xl=jnp.array([0.0, 0.0, 1.5], jnp.float32))
+
+    if args.controller == "centralized":
+        cfg = centralized.make_config(
+            params, col.collision_radius, col.max_deceleration
+        )
+        cs0 = centralized.init_ctrl_state(params, cfg)
+
+        def hl(cs, s, acc):
+            env_cbf = forest_mod.collision_cbf_rows(
+                forest, s.xl, s.vl, col.collision_radius, col.max_deceleration,
+                cfg.vision_radius, cfg.dist_eps, cfg.alpha_env_cbf,
+                cfg.n_env_cbfs,
+            )
+            return centralized.control(params, cfg, f_eq, cs, s, acc, env_cbf)
+
+        dist_eps = cfg.dist_eps
+    elif args.controller == "cadmm":
+        cfg = cadmm.make_config(
+            params, col.collision_radius, col.max_deceleration
+        )
+        cs0 = cadmm.init_cadmm_state(params, cfg)
+        hl = lambda cs, s, acc: cadmm.control(
+            params, cfg, f_eq, cs, s, acc, forest
+        )
+        dist_eps = cfg.dist_eps
+    else:
+        cfg = dd.make_config(params, col.collision_radius, col.max_deceleration)
+        cs0 = dd.init_dd_state(params, cfg)
+        hl = lambda cs, s, acc: dd.control(params, cfg, f_eq, cs, s, acc, forest)
+        dist_eps = cfg.base.dist_eps
+
+    n_hl_steps = int(args.T / (args.dt * args.hl_rel_freq))
+    run = jax.jit(
+        lambda s0, c0: ro.rollout(
+            hl, ll.control, params, s0, c0, n_hl_steps=n_hl_steps,
+            hl_rel_freq=args.hl_rel_freq, dt=args.dt, acc_des_fn=acc_des_fn,
+        )
+    )
+    print(f"compiling + running {args.controller}, n={args.n}, "
+          f"{n_hl_steps} MPC steps ...")
+    t0 = time.perf_counter()
+    final, _, logs = run(state0, cs0)
+    jax.block_until_ready(final.xl)
+    dt_wall = time.perf_counter() - t0
+    print(f"done in {dt_wall:.1f} s ({n_hl_steps / dt_wall:.1f} MPC steps/s "
+          f"incl. compile)")
+
+    # Aggregate stats (reference _print_stats, rqp_example.py:62-80).
+    iters = np.asarray(logs.iters)
+    if (iters >= 0).any():
+        mn, mx, avg, std = (float(x) for x in
+                            compute_aggregate_statistics(iters[iters >= 0]))
+        print(f"Solver iterations: min: {mn:5.2f}, max: {mx:5.2f}, "
+              f"avg: {avg:5.2f}, std: {std:5.2f}")
+    print(f"final payload position: {np.asarray(final.xl)}")
+    print(f"min env distance over run: {float(np.min(np.asarray(logs.min_env_dist))):.3f} m "
+          f"(eps = {dist_eps})")
+    print(f"collisions: {int(np.sum(np.asarray(logs.collision)))}")
+
+    log_dict = ro.logs_to_dict(logs, args.n, args.dt, args.hl_rel_freq, forest)
+    if args.out:
+        np.savez(args.out, **{
+            k: v for k, v in log_dict.items() if not isinstance(v, dict)
+        }, **{f"state_{k}": v for k, v in log_dict["state_seq"].items()})
+        print(f"logs saved to {args.out}")
+    if args.plots:
+        from tpu_aerial_transport.viz import plots
+
+        plots.plot_tracking_errors(log_dict, f"tracking_{args.controller}.png")
+        plots.plot_solver_stats(log_dict, f"stats_{args.controller}.png",
+                                dist_eps)
+        plots.plot_xy_trajectory(log_dict, f"xy_{args.controller}.png")
+        print("figures saved")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""C-ADMM distributed controller tests. The key oracle (SURVEY.md §4): the
+distributed solvers optimize the same convex problem as the centralized
+controller, so at consensus their solutions must agree to tolerance."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_aerial_transport.control import cadmm, centralized
+from tpu_aerial_transport.envs import forest as forest_mod
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.ops import lie
+
+
+def _setup(n=3):
+    params, col, state = setup.rqp_setup(n)
+    ccfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=250
+    )
+    acfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=60, inner_iters=80, res_tol=1e-3,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    return params, col, state, ccfg, acfg, f_eq
+
+
+def _random_state(key, n):
+    ks = jax.random.split(key, 4)
+    return rqp.rqp_state(
+        R=lie.expm_so3(0.1 * jax.random.normal(ks[0], (n, 3))),
+        w=0.1 * jax.random.normal(ks[1], (n, 3)),
+        xl=jnp.zeros(3),
+        vl=0.3 * jax.random.normal(ks[2], (3,)),
+        Rl=lie.expm_so3(0.05 * jax.random.normal(ks[3], (3,))),
+        wl=jnp.zeros(3),
+    )
+
+
+def test_cadmm_agrees_with_centralized_no_env():
+    """Random feasible states + targets: C-ADMM consensus forces must match the
+    centralized QP solution (both solve the same problem; the reference's own
+    implicit invariant)."""
+    n = 3
+    params, col, _, ccfg, acfg, f_eq = _setup(n)
+    for seed in range(3):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 2)
+        state = _random_state(ks[0], n)
+        acc_des = (
+            0.5 * jax.random.normal(ks[1], (3,)),
+            jnp.zeros(3),
+        )
+        cs = centralized.init_ctrl_state(params, ccfg)
+        f_cent, _, _ = centralized.control(params, ccfg, f_eq, cs, state, acc_des)
+        astate = cadmm.init_cadmm_state(params, acfg)
+        f_admm, astate, stats = cadmm.control(
+            params, acfg, f_eq, astate, state, acc_des
+        )
+        assert int(stats.iters) < 61, "consensus did not converge"
+        err = float(jnp.abs(f_admm - f_cent).max())
+        assert err < 5e-2, f"seed {seed}: |f_admm - f_cent| = {err}"
+
+
+def test_cadmm_converges_and_warm_start_helps():
+    n = 3
+    params, col, state0, _, acfg, f_eq = _setup(n)
+    acc_des = (jnp.array([0.3, 0.0, 0.0]), jnp.zeros(3))
+    astate = cadmm.init_cadmm_state(params, acfg)
+    f1, astate, stats1 = cadmm.control(params, acfg, f_eq, astate, state0, acc_des)
+    # Re-solving the same problem warm: should converge in very few iterations.
+    f2, astate, stats2 = cadmm.control(params, acfg, f_eq, astate, state0, acc_des)
+    assert int(stats2.iters) <= int(stats1.iters)
+    assert jnp.abs(f1 - f2).max() < 1e-2
+    # err_seq is recorded and decreasing overall.
+    errs = stats1.err_seq[~jnp.isnan(stats1.err_seq)]
+    assert errs.shape[0] == int(stats1.iters)
+
+
+def test_cadmm_with_forest_runs_and_is_safe():
+    n = 3
+    params, col, state0, _, acfg, f_eq = _setup(n)
+    forest = forest_mod.make_forest(seed=0)
+    state0 = state0.replace(
+        xl=jnp.array([5.0, 0.0, 2.0], jnp.float32),
+        vl=jnp.array([0.5, 0.0, 0.0], jnp.float32),
+    )
+    astate = cadmm.init_cadmm_state(params, acfg)
+    acc_des = (jnp.array([0.3, 0.0, 0.0]), jnp.zeros(3))
+    f, astate, stats = jax.jit(
+        lambda a, s: cadmm.control(params, acfg, f_eq, a, s, acc_des, forest)
+    )(astate, state0)
+    assert bool(jnp.all(jnp.isfinite(f)))
+    assert float(stats.min_env_dist) > 0
+    # Per-agent vision cones: the masked env data still yields valid rows.
+    env = cadmm.agent_env_cbfs(params, acfg, forest, state0)
+    assert env.lhs.shape == (n, acfg.n_env_cbfs, 3)
+
+
+def test_cadmm_jit_compiles_under_scan():
+    """The whole distributed control step must compose with lax.scan (rollouts)."""
+    n = 3
+    params, col, state0, _, acfg, f_eq = _setup(n)
+    astate = cadmm.init_cadmm_state(params, acfg)
+    acc_des = (jnp.array([0.2, 0.0, 0.0]), jnp.zeros(3))
+
+    def body(carry, _):
+        astate, state = carry
+        f, astate, _ = cadmm.control(params, acfg, f_eq, astate, state, acc_des)
+        M = jnp.zeros((n, 3))
+        fz = jnp.sum(f * state.R[..., :, 2], axis=-1)
+        state = rqp.integrate(params, state, (fz, M), 1e-2)
+        return (astate, state), f
+
+    (_, final), fs = jax.jit(
+        lambda c: jax.lax.scan(body, c, None, length=5)
+    )((astate, state0))
+    assert bool(jnp.all(jnp.isfinite(fs)))

@@ -1,0 +1,145 @@
+"""Closed-loop integration tests: SO(3) tracking convergence and the full
+centralized-MPC rollout (reference test/utils/test_so3tracking.py and
+test/control/test_rqpcontrollers.py, with asserted bounds)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_aerial_transport.control import centralized, lowlevel, so3_tracking
+from tpu_aerial_transport.envs import forest as forest_mod
+from tpu_aerial_transport.harness import rollout as ro
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.ops import lie
+
+
+def _so3_convergence(law, params):
+    """Integrate rigid-body attitude dynamics under the tracking law toward a
+    fixed random target (the reference's self-contained rotational integrator,
+    test_so3tracking.py:36-47)."""
+    J = jnp.diag(jnp.array([2.32e-3, 2.32e-3, 4e-3]))
+    J_inv = jnp.linalg.inv(J)
+    Rd = lie.expm_so3(jnp.array([0.5, -0.7, 0.3]))
+    wd = jnp.zeros(3)
+    dwd = jnp.zeros(3)
+    dt = 1e-3
+
+    def body(carry, _):
+        R, w = carry
+        M = law(R, Rd, w, wd, dwd, J, params)
+        dw = J_inv @ (M - jnp.cross(w, J @ w))
+        R = R @ lie.expm_so3((w + dw * dt / 2) * dt)
+        w = w + dw * dt
+        R = lie.polar_project(R)
+        e_R = 0.5 * lie.vee(Rd.T @ R - R.T @ Rd)
+        return (R, w), jnp.linalg.norm(e_R)
+
+    R0 = jnp.eye(3)
+    w0 = jnp.zeros(3)
+    (_, _), errs = jax.lax.scan(body, (R0, w0), None, length=4000)
+    return errs
+
+
+def test_so3_pd_convergence():
+    errs = _so3_convergence(
+        so3_tracking.so3_pd_tracking_control, so3_tracking.So3PDParams()
+    )
+    assert float(errs[-1]) < 1e-2
+    assert float(errs[-1]) < float(errs[0])
+
+
+def test_so3_sm_convergence():
+    errs = _so3_convergence(
+        so3_tracking.so3_sm_tracking_control, so3_tracking.So3SMParams()
+    )
+    assert float(errs[-1]) < 1e-2
+
+
+def test_lowlevel_thrust_projection():
+    params, _, state = setup.rqp_setup(3)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+    f_des = jnp.tile(jnp.array([0.0, 0.0, 5.0]), (3, 1))
+    f, M = ll.control(state, f_des)
+    # Identity attitude: thrust = f_des_z, zero attitude error -> zero moment.
+    assert jnp.abs(f - 5.0).max() < 1e-5
+    assert jnp.abs(M).max() < 1e-5
+
+
+def test_centralized_closedloop_hover_to_point():
+    """Centralized MPC + low-level PD must fly the payload from rest to a nearby
+    setpoint with bounded velocity and tilt (the safety CBFs) and settle."""
+    params, col, state0 = setup.rqp_setup(3)
+    cfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=120
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    cs0 = centralized.init_ctrl_state(params, cfg)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+
+    target = jnp.array([1.0, 0.5, 0.3])
+
+    def acc_des_fn(state, t):
+        dvl_des = -1.5 * state.vl - 1.0 * (state.xl - target)
+        nrm = jnp.linalg.norm(dvl_des)
+        dvl_des = jnp.where(nrm > 1.0, dvl_des / jnp.where(nrm > 0, nrm, 1), dvl_des)
+        return (dvl_des, jnp.zeros(3)), target, jnp.zeros(3)
+
+    hl = lambda cs, s, acc: centralized.control(params, cfg, f_eq, cs, s, acc)
+    final, _, logs = jax.jit(
+        lambda s0, c0: ro.rollout(
+            hl, ll.control, params, s0, c0, n_hl_steps=600,
+            acc_des_fn=acc_des_fn,
+        )
+    )(state0, cs0)
+
+    assert bool(jnp.all(jnp.isfinite(final.xl)))
+    # Settles near the target (within 15 cm after 6 s).
+    assert float(jnp.linalg.norm(final.xl - target)) < 0.15
+    # Safety invariants held throughout: |vl| <= 1 (+5% slack), tilt <= 15 deg.
+    assert float(jnp.max(jnp.linalg.norm(logs.vl, axis=-1))) < 1.05
+    cos_tilt = logs.Rl[:, 2, 2]
+    assert float(jnp.min(cos_tilt)) > float(jnp.cos(jnp.pi / 12)) - 0.02
+    # Solver converged throughout.
+    assert float(jnp.max(logs.solve_res)) < 5e-3
+
+
+def test_centralized_forest_rollout_avoids_trees():
+    """Short forest traversal: the collision CBF rows must keep min distance
+    above dist_eps (the reference's safety invariant, SURVEY.md §6)."""
+    params, col, state0 = setup.rqp_setup(3)
+    forest = forest_mod.make_forest(seed=0)
+    cfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=120
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    cs0 = centralized.init_ctrl_state(params, cfg)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+    acc_des_fn = ro.make_forest_acc_des(forest)
+
+    # Start near the forest edge at cruise height, flying in.
+    state0 = state0.replace(
+        xl=jnp.array([2.0, 0.5, 1.5], jnp.float32),
+        vl=jnp.array([0.5, 0.0, 0.0], jnp.float32),
+    )
+
+    def hl(cs, s, acc):
+        env_cbf = forest_mod.collision_cbf_rows(
+            forest, s.xl, s.vl, col.collision_radius, col.max_deceleration,
+            cfg.vision_radius, cfg.dist_eps, cfg.alpha_env_cbf, cfg.n_env_cbfs,
+        )
+        return centralized.control(params, cfg, f_eq, cs, s, acc, env_cbf)
+
+    final, _, logs = jax.jit(
+        lambda s0, c0: ro.rollout(
+            hl, ll.control, params, s0, c0, n_hl_steps=800,
+            acc_des_fn=acc_des_fn,
+        )
+    )(state0, cs0)
+
+    assert bool(jnp.all(jnp.isfinite(final.xl)))
+    assert not bool(jnp.any(logs.collision))
+    # Safety margin: distance stays above dist_eps.
+    assert float(jnp.min(logs.min_env_dist)) > cfg.dist_eps
+    # It actually makes forward progress.
+    assert float(final.xl[0]) > float(state0.xl[0]) + 1.0

@@ -1,0 +1,135 @@
+"""Dual-decomposition controller tests + RP centralized closed-loop test
+(reference test/control/test_rqpcontrollers.py and test_rpcentralized.py)."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_aerial_transport.control import centralized, dd, rp_centralized
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.models import rp as rp_mod
+from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.ops import lie
+
+
+def _setup(n=3):
+    params, col, state = setup.rqp_setup(n)
+    ccfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=250
+    )
+    # Reference stop tolerance is 1e-2 N (rqp_dd.py:609); 5e-3 is reachable with
+    # f32 inner solves, 1e-3 is below their accuracy floor.
+    dcfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=80, inner_iters=80, prim_inf_tol=5e-3,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    return params, col, state, ccfg, dcfg, f_eq
+
+
+def _random_state(key, n):
+    ks = jax.random.split(key, 4)
+    return rqp.rqp_state(
+        R=lie.expm_so3(0.1 * jax.random.normal(ks[0], (n, 3))),
+        w=0.1 * jax.random.normal(ks[1], (n, 3)),
+        xl=jnp.zeros(3),
+        vl=0.3 * jax.random.normal(ks[2], (3,)),
+        Rl=lie.expm_so3(0.05 * jax.random.normal(ks[3], (3,))),
+        wl=jnp.zeros(3),
+    )
+
+
+def test_dd_agrees_with_centralized():
+    """DD consensus forces must match the centralized solution (same convex
+    problem — the reference's implicit cross-solver invariant)."""
+    n = 3
+    params, col, _, ccfg, dcfg, f_eq = _setup(n)
+    for seed in range(3):
+        ks = jax.random.split(jax.random.PRNGKey(seed + 20), 2)
+        state = _random_state(ks[0], n)
+        acc_des = (0.5 * jax.random.normal(ks[1], (3,)), jnp.zeros(3))
+        cs = centralized.init_ctrl_state(params, ccfg)
+        f_cent, _, _ = centralized.control(params, ccfg, f_eq, cs, state, acc_des)
+        ds = dd.init_dd_state(params, dcfg)
+        f_dd, ds, stats = dd.control(params, dcfg, f_eq, ds, state, acc_des)
+        assert int(stats.iters) < 81, "DD did not converge"
+        err = float(jnp.abs(f_dd - f_cent).max())
+        assert err < 5e-2, f"seed {seed}: |f_dd - f_cent| = {err}"
+
+
+def test_dd_warm_start_and_errseq():
+    n = 3
+    params, col, state0, _, dcfg, f_eq = _setup(n)
+    acc_des = (jnp.array([0.3, 0.0, 0.0]), jnp.zeros(3))
+    ds = dd.init_dd_state(params, dcfg)
+    f1, ds, s1 = dd.control(params, dcfg, f_eq, ds, state0, acc_des)
+    f2, ds, s2 = dd.control(params, dcfg, f_eq, ds, state0, acc_des)
+    assert int(s2.iters) <= int(s1.iters)
+    assert jnp.abs(f1 - f2).max() < 1e-2
+    errs = s1.err_seq[~jnp.isnan(s1.err_seq)]
+    assert errs.shape[0] == int(s1.iters)
+
+
+def test_dd_jits():
+    n = 3
+    params, col, state0, _, dcfg, f_eq = _setup(n)
+    ds = dd.init_dd_state(params, dcfg)
+    acc_des = (jnp.array([0.2, 0.0, 0.0]), jnp.zeros(3))
+    f, ds, stats = jax.jit(
+        lambda d, s: dd.control(params, dcfg, f_eq, d, s, acc_des)
+    )(ds, state0)
+    assert bool(jnp.all(jnp.isfinite(f)))
+
+
+def test_rp_centralized_closedloop_circle():
+    """RP centralized QP tracking a circular reference (reference
+    test_rpcentralized.py:14-38): bounded tracking error, safety invariants."""
+    params, col, state0 = setup.rp_setup(3)
+    cfg = rp_centralized.make_config(params, solver_iters=120)
+    f_eq = rp_centralized.equilibrium_forces(params)
+    cs0 = rp_centralized.init_ctrl_state(params, cfg)
+
+    radius, omega = 0.5, 0.4
+
+    def ref(t):
+        x = jnp.stack([
+            radius * jnp.cos(omega * t) - radius,
+            radius * jnp.sin(omega * t),
+            0.1 * t,
+        ])
+        v = jnp.stack([
+            -radius * omega * jnp.sin(omega * t),
+            radius * omega * jnp.cos(omega * t),
+            jnp.asarray(0.1),
+        ])
+        a = jnp.stack([
+            -radius * omega**2 * jnp.cos(omega * t),
+            -radius * omega**2 * jnp.sin(omega * t),
+            jnp.asarray(0.0),
+        ])
+        return x, v, a
+
+    dt = 1e-3
+
+    def body(carry, i):
+        state, cs = carry
+        t = i * dt * 10
+        x_ref, v_ref, a_ref = ref(t)
+        dvl_des = a_ref - 1.5 * (state.vl - v_ref) - 2.0 * (state.xl - x_ref)
+        acc_des = (dvl_des, jnp.zeros(3))
+        f, cs, _ = rp_centralized.control(params, cfg, f_eq, cs, state, acc_des)
+
+        def ll(s, _):
+            return rp_mod.integrate(params, s, f, dt), None
+
+        state, _ = jax.lax.scan(ll, state, None, length=10)
+        x_err = jnp.linalg.norm(state.xl - x_ref)
+        return (state, cs), x_err
+
+    (final, _), errs = jax.jit(
+        lambda c: jax.lax.scan(body, c, jnp.arange(800))
+    )((state0, cs0))
+    assert bool(jnp.all(jnp.isfinite(final.xl)))
+    # After the transient, tracking error stays bounded.
+    assert float(jnp.max(errs[300:])) < 0.3
+    # Tilt stays within the 30 deg CBF bound.
+    assert float(final.Rl[2, 2]) > float(jnp.cos(jnp.pi / 6)) - 0.02

@@ -1,0 +1,154 @@
+"""Tests for the JAX forest environment: distance oracle comparisons (numpy f64
+brute force stands in for hppfcl, which is not available — SURVEY.md §7 stage 5),
+generation invariants, vision-cone masking, and CBF row construction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.envs import forest as fo
+
+
+def _np_point_cyl(p, c, R, H):
+    d_rad = np.linalg.norm(p[:2] - c[:2]) - R
+    d_ax = abs(p[2] - c[2]) - H
+    if d_rad <= 0 and d_ax <= 0:
+        return max(d_rad, d_ax)
+    return np.hypot(max(d_rad, 0.0), max(d_ax, 0.0))
+
+
+def _np_seg_cyl(a, b, c, R, H, n=20001):
+    ts = np.linspace(0.0, 1.0, n)
+    pts = a[None] + ts[:, None] * (b - a)[None]
+    return min(_np_point_cyl(p, c, R, H) for p in pts)
+
+
+def test_forest_generation_invariants():
+    f = fo.make_forest(seed=0)
+    num = int(f.num_trees)
+    assert 1 <= num <= fo.MAX_TREES
+    pos = np.asarray(f.tree_pos[:num])
+    # Min spacing respected.
+    d = np.linalg.norm(pos[None, :, :2] - pos[:, None, :2], axis=-1)
+    d[np.diag_indices(num)] = np.inf
+    assert d.min() >= fo.MIN_DIST_BETWEEN_TREES - 1e-9
+    # All inside the mountain disc.
+    assert (
+        np.linalg.norm(pos[:, :2] - fo.MOUNTAIN_CENTER, axis=1)
+        <= fo.MOUNTAIN_RADIUS + 1e-9
+    ).all()
+    # Determinism.
+    f2 = fo.make_forest(seed=0)
+    assert jnp.array_equal(f.tree_pos, f2.tree_pos)
+    # Different seed -> different forest.
+    f3 = fo.make_forest(seed=1)
+    assert not jnp.array_equal(f.tree_pos, f3.tree_pos)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_segment_cylinder_distance_matches_oracle(seed):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=3) * 3
+    b = a + rng.normal(size=3) * 4
+    c = rng.normal(size=3) * 2
+    R, H = 0.3, 2.0
+    d_jax, p_seg, p_cyl = fo.segment_cylinder_distance(
+        jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32),
+        jnp.asarray(c, jnp.float32), R, H,
+    )
+    d_ref = _np_seg_cyl(a, b, c, R, H)
+    assert abs(float(d_jax) - d_ref) < 2e-4, (float(d_jax), d_ref)
+    if d_ref > 1e-3:
+        # Witness points consistent with the distance.
+        gap = np.linalg.norm(np.asarray(p_seg) - np.asarray(p_cyl))
+        assert abs(gap - d_ref) < 2e-3
+
+
+def test_point_cylinder_inside_sign():
+    d, cp = fo.point_cylinder_distance(
+        jnp.array([0.1, 0.0, 0.5]), jnp.zeros(3), 0.3, 2.0
+    )
+    assert float(d) < 0  # inside -> negative
+
+
+def test_capsule_forest_distance_and_collision_flag():
+    f = fo.make_forest(seed=0)
+    tree0 = f.tree_pos[0]
+    # Capsule axis passing right next to tree 0, 1.0 m away in y.
+    a = jnp.array([tree0[0] - 3.0, tree0[1] + 1.0, tree0[2]])
+    b = jnp.array([tree0[0] + 3.0, tree0[1] + 1.0, tree0[2]])
+    data = fo.capsule_forest_distance(f, a, b, 0.2, 10.0)
+    # Expected distance to tree 0: 1.0 - bark_radius - cap_radius = 0.5.
+    # (Other trees may be closer to this capsule, so check slot 0 specifically.)
+    assert abs(float(data.dists[0]) - 0.5) < 1e-3
+    # Touching capsule -> collision.
+    a2 = jnp.array([tree0[0] - 3.0, tree0[1], tree0[2]])
+    b2 = jnp.array([tree0[0] + 3.0, tree0[1], tree0[2]])
+    data2 = fo.capsule_forest_distance(f, a2, b2, 0.4, 10.0)
+    assert bool(data2.collision)
+
+
+def test_vision_cone_mask():
+    f = fo.make_forest(seed=0)
+    cam = jnp.asarray(f.tree_pos[0, :2]) - jnp.array([5.0, 0.0])
+    # Looking +x: tree 0 visible; looking -x: not.
+    m_fwd = fo.vision_cone_mask(f, cam, jnp.array([1.0, 0.0]), jnp.pi / 4)
+    m_bwd = fo.vision_cone_mask(f, cam, jnp.array([-1.0, 0.0]), jnp.pi / 4)
+    assert bool(m_fwd[0])
+    assert not bool(m_bwd[0])
+
+
+def test_collision_cbf_rows_active_and_inactive():
+    f = fo.make_forest(seed=0)
+    tree0 = np.asarray(f.tree_pos[0])
+    vision_radius = 6.0
+    # Moving toward tree 0 at 1 m/s from 4 m away -> active rows.
+    xl = jnp.asarray(tree0 - np.array([4.0, 0.0, 0.0]), jnp.float32)
+    vl = jnp.array([1.0, 0.0, 0.0])
+    cbf = fo.collision_cbf_rows(
+        f, xl, vl, collision_radius=0.5, max_deceleration=1.96,
+        vision_radius=vision_radius, dist_eps=0.1, alpha_env_cbf=2.0, n_rows=10,
+    )
+    assert cbf.lhs.shape == (10, 3)
+    assert float(cbf.min_dist) < vision_radius
+    active = jnp.any(jnp.abs(cbf.lhs) > 0, axis=1)
+    assert bool(jnp.any(active))
+    # Active row normal points from tree toward the system (negative x here).
+    i = int(jnp.argmax(active))
+    assert float(cbf.lhs[i, 0]) < 0
+    # Far away -> all rows vacuous (lhs 0, rhs < 0).
+    xl_far = jnp.array([-100.0, -100.0, 1.0])
+    cbf_far = fo.collision_cbf_rows(
+        f, xl_far, vl, 0.5, 1.96, vision_radius, 0.1, 2.0, 10,
+    )
+    assert float(jnp.abs(cbf_far.lhs).max()) == 0.0
+    assert bool(jnp.all(cbf_far.rhs < 0))
+    # No-env path.
+    cbf_none = fo.collision_cbf_rows(None, xl, vl, 0.5, 1.96,
+                                     vision_radius, 0.1, 2.0, 10)
+    assert float(jnp.abs(cbf_none.lhs).max()) == 0.0
+
+
+def test_ground_height():
+    f = fo.make_forest(seed=0)
+    center = jnp.asarray(fo.MOUNTAIN_CENTER, jnp.float32)
+    h_center = fo.ground_height(f, center)
+    # The cap apex height implied by the reference's sphere construction
+    # (env_forest.py:74-77) — note it is NOT _MOUNTAIN_HEIGHT itself.
+    expected = float(f.mountain_sphere_radius - f.mountain_center_depth)
+    assert abs(float(h_center) - expected) < 1e-3
+    assert 0.0 < expected < fo.MOUNTAIN_HEIGHT
+    h_far = fo.ground_height(f, center + 100.0)
+    assert float(h_far) == 0.0
+
+
+def test_distance_query_jits_and_vmaps():
+    f = fo.make_forest(seed=0)
+    xs = jnp.stack([jnp.array([20.0, 0.0, 2.0]), jnp.array([30.0, 5.0, 2.0])])
+    vs = jnp.tile(jnp.array([1.0, 0.0, 0.0]), (2, 1))
+    fn = jax.jit(jax.vmap(
+        lambda x, v: fo.collision_cbf_rows(f, x, v, 0.5, 1.96, 6.0, 0.1, 2.0, 10)
+    ))
+    out = fn(xs, vs)
+    assert out.lhs.shape == (2, 10, 3)

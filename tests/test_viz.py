@@ -1,0 +1,69 @@
+"""Smoke tests for the host-side plotting / scene-rendering layer."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _fake_logs(T=20, n=3):
+    rng = np.random.default_rng(0)
+    eye = np.tile(np.eye(3), (T, 1, 1))
+    return {
+        "n": n,
+        "dt": 1e-3,
+        "T": 2.0,
+        "hl_rel_freq": 10,
+        "log_freq": 10,
+        "state_seq": {
+            "xl": np.cumsum(rng.normal(size=(T, 3)) * 0.05, axis=0),
+            "vl": rng.normal(size=(T, 3)) * 0.1,
+            "Rl": eye,
+            "wl": np.zeros((T, 3)),
+            "R": np.tile(np.eye(3), (T, n, 1, 1)),
+            "w": np.zeros((T, n, 3)),
+        },
+        "x_err_seq": np.abs(rng.normal(size=T)),
+        "v_err_seq": np.abs(rng.normal(size=T)),
+        "iter_seq": rng.integers(1, 20, T),
+        "min_env_dist_seq": np.abs(rng.normal(size=T)) + 0.2,
+        "tree_pos": rng.normal(size=(5, 3)) * 3,
+    }
+
+
+def test_plots_render(tmp_path):
+    from tpu_aerial_transport.viz import plots
+
+    logs = _fake_logs()
+    plots.plot_tracking_errors(logs, str(tmp_path / "t.png"))
+    plots.plot_solver_stats(logs, str(tmp_path / "s.png"))
+    plots.plot_xy_trajectory(logs, str(tmp_path / "xy.png"))
+    errs = np.abs(np.random.default_rng(1).normal(size=(10, 25)))
+    errs[:, 15:] = np.nan
+    plots.plot_convergence_rates({"C-ADMM": errs, "DD": errs * 0.5},
+                                 str(tmp_path / "c.png"))
+    for f in ("t.png", "s.png", "xy.png", "c.png"):
+        assert (tmp_path / f).stat().st_size > 0
+
+
+def test_scene_frames(tmp_path):
+    from tpu_aerial_transport.harness import setup
+    from tpu_aerial_transport.viz import scene
+
+    params, col, _ = setup.rqp_setup(3)
+    logs = _fake_logs()
+    frames = scene.render_frames(
+        logs, params, col.payload_vertices, str(tmp_path / "frames"), stride=10
+    )
+    assert len(frames) == 2
+    assert all(os.path.getsize(f) > 0 for f in frames)
+    scene.render_ghost_snapshot(
+        logs, params, col.payload_vertices, str(tmp_path / "ghost.png"),
+        times=[0, 10, 19],
+    )
+    assert (tmp_path / "ghost.png").stat().st_size > 0
+
+
+def test_meshcat_backend_optional():
+    pytest.importorskip("meshcat")
+    from tpu_aerial_transport.viz.scene import MeshcatBackend  # noqa: F401

@@ -1,0 +1,13 @@
+"""Controllers (reference ``control/``): centralized RQP SOCP+CBF filter,
+C-ADMM and dual-decomposition distributed solvers, RP centralized QP,
+low-level SO(3) thrust/moment controllers."""
+
+from tpu_aerial_transport.control import (  # noqa: F401
+    cadmm,
+    centralized,
+    dd,
+    lowlevel,
+    rp_centralized,
+    so3_tracking,
+    types,
+)

@@ -1,0 +1,465 @@
+"""Consensus-ADMM distributed controller for the RQP model.
+
+TPU-native re-design of reference ``control/rqp_cadmm.py``: ``n`` agents each hold
+a full local copy ``f^(i) in R^{n x 3}`` of all forces (global-consensus ADMM).
+Per outer iteration (reference ``control``, :631-675):
+
+  1. each agent solves its primal SOCP (cost docstring :27-46) with augmented
+     objective ``<lambda_i, f> + (rho/2)||f - f_mean||^2``; only the agent's own
+     force column carries actuation constraints (:394-404);
+  2. consensus mean ``f_mean = (1/n) sum_i f^(i)`` and inf-norm residual
+     ``max_i ||f^(i) - f_mean||_inf`` — the logical all-reduce (:582-625);
+  3. stop when residual < ``res_tol`` (1e-2 N) or iteration cap; else dual update
+     ``lambda_i += rho (f^(i) - f_mean)`` (:627-629).
+
+TPU mapping (SURVEY.md §2.10): the reference's sequential per-agent loop becomes a
+``vmap`` over the agent axis (one fused kernel for all n primal SOCPs); the
+consensus mean/max are ``jnp`` reductions on-chip (and ``lax.psum``/``pmax`` over a
+mesh axis in the ``parallel`` layer). Because the reference's default rho schedule
+is constant (``rho0 = 1, tau_incr = 1``, :565-567), each agent's KKT matrix is
+fixed within a control step: we factor all n of them once (vmapped Cholesky) and
+reuse across every consensus iteration — only the linear term moves.
+
+All controller state (local copies, duals, means, per-agent warm starts) persists
+across control steps in :class:`CADMMState`, matching the reference's warm-start
+behavior (:576-580 and cvxpy ``warm_start=True``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from tpu_aerial_transport.control.types import EnvCBF, SolverStats, inactive_env_cbf
+from tpu_aerial_transport.envs import forest as forest_mod
+from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
+from tpu_aerial_transport.ops import lie, socp
+from tpu_aerial_transport.control.centralized import equilibrium_forces
+
+
+@struct.dataclass
+class RQPCADMMConfig:
+    """Constants from reference ``_set_controller_constants`` (:192-236, :556-567).
+    Note the distributed deltas vs centralized: ``k_f, k_m`` scaled by 1/n,
+    ``alpha_env_cbf = 1.5``, per-agent vision cone of half-angle 100 deg."""
+
+    min_fz: float
+    sec_max_f_ang: float
+    max_f: float
+    cos_max_p_ang: float
+    alpha1_p_cbf: float
+    alpha2_p_cbf: float
+    max_wl_sq: float
+    alpha_wl_cbf: float
+    max_vl_sq: float
+    alpha_vl_cbf: float
+    dist_eps: float
+    vision_radius: float
+    alpha_env_cbf: float
+    max_deceleration: float
+    vision_cone_ang: float
+    k_f: float  # already divided by n.
+    k_m: float
+    k_feq: float
+    k_dvl: float
+    k_dwl: float
+    rho0: float
+    res_tol: float
+    # Static fields.
+    leader_idx: int = struct.field(pytree_node=False, default=0)
+    n_env_cbfs: int = struct.field(pytree_node=False, default=10)
+    max_iter: int = struct.field(pytree_node=False, default=100)
+    inner_iters: int = struct.field(pytree_node=False, default=60)
+    solver_tol: float = struct.field(pytree_node=False, default=5e-3)
+    max_f_ang: float = struct.field(pytree_node=False, default=jnp.pi / 6)
+
+
+def make_config(
+    params: RQPParams,
+    collision_radius: float,
+    max_deceleration: float,
+    n_env_cbfs: int = 10,
+    max_iter: int = 100,
+    inner_iters: int = 60,
+    res_tol: float = 1e-2,
+) -> RQPCADMMConfig:
+    n = params.n
+    mTg = float(params.mT) * GRAVITY
+    return RQPCADMMConfig(
+        min_fz=mTg / (n * 10.0),
+        sec_max_f_ang=float(1.0 / jnp.cos(jnp.pi / 6.0)),
+        max_f=2.0 * mTg / n,
+        cos_max_p_ang=float(jnp.cos(jnp.pi / 12.0)),
+        alpha1_p_cbf=1.0,
+        alpha2_p_cbf=1.0,
+        max_wl_sq=float((jnp.pi / 6.0) ** 2),
+        alpha_wl_cbf=1.0,
+        max_vl_sq=1.0,
+        alpha_vl_cbf=1.0,
+        dist_eps=0.1,
+        vision_radius=collision_radius + 5.0,
+        alpha_env_cbf=1.5,
+        max_deceleration=max_deceleration,
+        vision_cone_ang=float(100.0 * jnp.pi / 180.0),
+        k_f=0.1 / n,
+        k_m=0.1 / n,
+        k_feq=0.1,
+        k_dvl=1.0,
+        k_dwl=1.0,
+        rho0=1.0,
+        res_tol=res_tol,
+        n_env_cbfs=n_env_cbfs,
+        max_iter=max_iter,
+        inner_iters=inner_iters,
+    )
+
+
+@struct.dataclass
+class CADMMState:
+    """Distributed-solver state carried across control steps (reference
+    ``_set_variables`` + ``_set_warm_start``, :569-580)."""
+
+    f: jnp.ndarray  # (n, n, 3): f[i, j] = agent i's copy of agent j's force.
+    lam: jnp.ndarray  # (n, n, 3) duals.
+    f_mean: jnp.ndarray  # (n, 3) consensus mean.
+    warm: socp.SOCPSolution  # leading agent axis on every leaf.
+
+
+def init_cadmm_state(params: RQPParams, cfg: RQPCADMMConfig) -> CADMMState:
+    n = params.n
+    f_eq = equilibrium_forces(params)
+    dtype = f_eq.dtype
+    nv = 9 + 3 * n
+    n_box = 13 + cfg.n_env_cbfs
+    m = n_box + 8
+    x0 = jnp.concatenate([jnp.zeros(9, dtype), f_eq.reshape(-1)])
+    warm = socp.SOCPSolution(
+        x=jnp.tile(x0, (n, 1)),
+        y=jnp.zeros((n, m), dtype),
+        z=jnp.zeros((n, m), dtype),
+        prim_res=jnp.zeros((n,), dtype),
+        dual_res=jnp.zeros((n,), dtype),
+    )
+    return CADMMState(
+        f=jnp.tile(f_eq, (n, 1, 1)),
+        lam=jnp.zeros((n, n, 3), dtype),
+        f_mean=f_eq,
+        warm=warm,
+    )
+
+
+def _build_agent_qp(
+    params: RQPParams,
+    cfg: RQPCADMMConfig,
+    f_eq: jnp.ndarray,
+    state: RQPState,
+    acc_des,
+    env_cbf: EnvCBF,
+    onehot: jnp.ndarray,
+    is_leader: jnp.ndarray,
+    rho,
+):
+    """Per-agent primal QP matrices (vmapped over ``onehot``/``is_leader``/CBF).
+
+    Variable layout matches the centralized controller: [dv_com | dvl | dwl | f].
+    Box rows: [dyn-trans 3 | dyn-rot 3 | kin 3 | own fz 1 | tilt 1 | wl 1 | vl 1 |
+    env k]; SOC: own thrust cone + own norm cap. The consensus-ADMM quadratic
+    ``(rho/2)||f||^2`` is baked into P (rho is constant within a control step);
+    the iteration-varying linear term ``lambda - rho f_mean`` is added by the
+    caller per consensus iteration.
+    """
+    n = params.n
+    dtype = state.xl.dtype
+    nv = 9 + 3 * n
+    dvl_des, dwl_des = acc_des
+    e3 = jnp.array([0.0, 0.0, 1.0], dtype=dtype)
+    Rl = state.Rl
+
+    P = jnp.zeros((nv, nv), dtype)
+    q = jnp.zeros((nv,), dtype)
+    k_dvl = cfg.k_dvl * is_leader
+    k_dwl = cfg.k_dwl * is_leader
+    P = P.at[3:6, 3:6].add(2.0 * k_dvl * jnp.eye(3, dtype=dtype))
+    q = q.at[3:6].add(-2.0 * k_dvl * dvl_des)
+    P = P.at[6:9, 6:9].add(2.0 * k_dwl * jnp.eye(3, dtype=dtype))
+    q = q.at[6:9].add(-2.0 * k_dwl * dwl_des)
+
+    S = jnp.tile(jnp.eye(3, dtype=dtype), (1, n))
+    G = jnp.concatenate(
+        [lie.hat(params.r_com[i]) @ Rl.T for i in range(n)], axis=1
+    )
+    own = jnp.repeat(onehot, 3)  # (3n,) mask of the agent's own force block.
+    Pff = (
+        2.0 * cfg.k_f * (S.T @ S)
+        + 2.0 * cfg.k_m * (G.T @ G)
+        + 2.0 * cfg.k_feq * jnp.diag(own)
+        + rho * jnp.eye(3 * n, dtype=dtype)  # (rho/2)||f||^2.
+    )
+    P = P.at[9:, 9:].add(Pff)
+    q = q.at[9:].add(
+        -2.0 * cfg.k_f * (S.T @ (params.mT * GRAVITY * e3))
+        - 2.0 * cfg.k_feq * own * f_eq.reshape(-1)
+    )
+
+    n_box = 13 + cfg.n_env_cbfs
+    A = jnp.zeros((n_box, nv), dtype)
+    lb = jnp.zeros((n_box,), dtype)
+    ub = jnp.zeros((n_box,), dtype)
+
+    A = A.at[0:3, 0:3].set(params.mT * jnp.eye(3, dtype=dtype))
+    A = A.at[0:3, 9:].set(-S)
+    rhs = -params.mT * GRAVITY * e3
+    lb = lb.at[0:3].set(rhs)
+    ub = ub.at[0:3].set(rhs)
+
+    A = A.at[3:6, 6:9].set(jnp.eye(3, dtype=dtype))
+    A = A.at[3:6, 9:].set(-params.JT_inv @ G)
+    rot_rhs = -params.JT_inv @ jnp.cross(state.wl, params.JT @ state.wl)
+    lb = lb.at[3:6].set(rot_rhs)
+    ub = ub.at[3:6].set(rot_rhs)
+
+    R_w_hat = Rl @ lie.hat(state.wl)
+    R_w_hat_sq = Rl @ lie.hat_square(state.wl, state.wl)
+    A = A.at[6:9, 0:3].set(-jnp.eye(3, dtype=dtype))
+    A = A.at[6:9, 3:6].set(jnp.eye(3, dtype=dtype))
+    A = A.at[6:9, 6:9].set(-Rl @ lie.hat(params.x_com))
+    kin_rhs = -R_w_hat_sq @ params.x_com
+    lb = lb.at[6:9].set(kin_rhs)
+    ub = ub.at[6:9].set(kin_rhs)
+
+    # Own-column f_z lower bound (row 9): one-hot selects the agent's column.
+    fz_row = jnp.kron(onehot, e3)  # (3n,)
+    A = A.at[9, 9:].set(fz_row)
+    lb = lb.at[9].set(cfg.min_fz)
+    ub = ub.at[9].set(socp.INF)
+
+    A = A.at[10, 6:9].set(-(Rl[2] @ lie.hat(e3)))
+    tilt_rhs = (
+        -R_w_hat_sq[2, 2]
+        - (cfg.alpha1_p_cbf + cfg.alpha2_p_cbf) * R_w_hat[2, 2]
+        - cfg.alpha1_p_cbf * cfg.alpha2_p_cbf * (Rl[2, 2] - cfg.cos_max_p_ang)
+    )
+    lb = lb.at[10].set(tilt_rhs)
+    ub = ub.at[10].set(socp.INF)
+
+    A = A.at[11, 6:9].set(-2.0 * state.wl)
+    lb = lb.at[11].set(
+        -cfg.alpha_wl_cbf * (cfg.max_wl_sq - jnp.dot(state.wl, state.wl))
+    )
+    ub = ub.at[11].set(socp.INF)
+
+    A = A.at[12, 3:6].set(-2.0 * state.vl)
+    lb = lb.at[12].set(
+        -cfg.alpha_vl_cbf * (cfg.max_vl_sq - jnp.dot(state.vl, state.vl))
+    )
+    ub = ub.at[12].set(socp.INF)
+
+    A = A.at[13 : 13 + cfg.n_env_cbfs, 3:6].set(env_cbf.lhs)
+    lb = lb.at[13 : 13 + cfg.n_env_cbfs].set(env_cbf.rhs)
+    ub = ub.at[13 : 13 + cfg.n_env_cbfs].set(socp.INF)
+
+    # SOC rows: own thrust cone [sec30 fz; f_own], own norm cap [max_f; f_own].
+    soc = jnp.zeros((8, nv), dtype)
+    shift_soc = jnp.zeros((8,), dtype)
+    own_block = jnp.kron(onehot, jnp.eye(3, dtype=dtype))  # (3, 3n)
+    soc = soc.at[0, 9:].set(cfg.sec_max_f_ang * fz_row)
+    soc = soc.at[1:4, 9:].set(own_block)
+    shift_soc = shift_soc.at[4].set(cfg.max_f)
+    soc = soc.at[5:8, 9:].set(own_block)
+
+    A_full = jnp.concatenate([A, soc], axis=0)
+    shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    return P, q, A_full, lb, ub, shift
+
+
+def agent_env_cbfs(
+    params: RQPParams,
+    cfg: RQPCADMMConfig,
+    forest: forest_mod.Forest | None,
+    state: RQPState,
+) -> EnvCBF:
+    """Per-agent vision-cone CBF rows for all n agents (single-program path)."""
+    return agent_env_cbfs_for(params, cfg, forest, state, params.r)
+
+
+def agent_env_cbfs_for(
+    params: RQPParams,
+    cfg: RQPCADMMConfig,
+    forest: forest_mod.Forest | None,
+    state: RQPState,
+    r_block: jnp.ndarray,
+) -> EnvCBF:
+    """Per-agent vision-cone-masked collision CBF rows, batched over the agents
+    whose attachment points are in ``r_block`` (a shard's block, or all of
+    ``params.r``). Reference ``_set_collision_avoidance_cbf_parameters``,
+    rqp_cadmm.py:307-373: camera at the agent's attachment point, cone toward
+    its bearing from the payload center."""
+    n = r_block.shape[0]
+    if forest is None:
+        base = inactive_env_cbf(
+            cfg.n_env_cbfs, cfg.vision_radius, cfg.dist_eps, cfg.alpha_env_cbf,
+            dtype=state.xl.dtype,
+        )
+        return jax.tree.map(lambda x: jnp.tile(x, (n,) + (1,) * x.ndim), base)
+
+    # The braking capsule is identical for every agent (it depends only on the
+    # payload state, reference :319-332) — run the expensive segment-cylinder
+    # sweep ONCE and give each agent its own vision-cone mask + top-k rows.
+    collision_radius = cfg.vision_radius - 5.0  # vision = collision + 5 (:216).
+    cap_a, cap_b, cap_h, speed, cap_dir = forest_mod.braking_capsule(
+        state.xl, state.vl, collision_radius, cfg.max_deceleration
+    )
+    data = forest_mod.capsule_forest_distance(
+        forest, cap_a, cap_b, collision_radius, cfg.vision_radius
+    )
+
+    def one_agent(r_i):
+        camera = (state.xl + state.Rl @ r_i)[:2]
+        d = camera - state.xl[:2]
+        norm = jnp.linalg.norm(d)
+        direction = d / jnp.where(norm > 0, norm, 1.0)
+        mask = forest_mod.vision_cone_mask(
+            forest, camera, direction, cfg.vision_cone_ang
+        )
+        # Degenerate bearing (attachment above payload center): reference flags
+        # collision and disables rows (:337-339).
+        mask = mask & (norm > 0)
+        cbf = forest_mod.cbf_rows_from_distance(
+            data, state.xl, state.vl, cap_h, speed, cap_dir,
+            cfg.max_deceleration, cfg.vision_radius, cfg.dist_eps,
+            cfg.alpha_env_cbf, cfg.n_env_cbfs, extra_mask=mask,
+        )
+        return cbf.replace(collision=cbf.collision | (norm == 0))
+
+    return jax.vmap(one_agent)(r_block)
+
+
+def control(
+    params: RQPParams,
+    cfg: RQPCADMMConfig,
+    f_eq: jnp.ndarray,
+    admm_state: CADMMState,
+    state: RQPState,
+    acc_des,
+    forest: forest_mod.Forest | None = None,
+    axis_name: str | None = None,
+):
+    """One distributed control step: ``-> (f_app (n_local, 3), CADMMState,
+    SolverStats)`` (reference ``RQPCADMMController.control``, :631-675).
+
+    With ``axis_name=None`` all n agents run in one program (vmap; single chip).
+    Inside ``shard_map`` over a mesh axis named ``axis_name``, each shard holds a
+    block of agents (the leading axis of every ``CADMMState`` leaf) and the
+    consensus mean/residual become ``lax.psum``/``pmax`` collectives over ICI —
+    the all-reduce pattern SURVEY.md §2.10 prescribes. ``state``/``acc_des``/
+    ``f_eq`` are replicated."""
+    n = params.n
+    dtype = state.xl.dtype
+    rho = jnp.asarray(cfg.rho0, dtype)
+
+    n_local = admm_state.f.shape[0]
+    if axis_name is None:
+        agent_ids = jnp.arange(n_local)
+    else:
+        agent_ids = lax.axis_index(axis_name) * n_local + jnp.arange(n_local)
+
+    def _mean_over_agents(x):
+        if axis_name is None:
+            return jnp.mean(x, axis=0)
+        return lax.psum(jnp.sum(x, axis=0), axis_name) / n
+
+    def _max_over_agents(x):
+        if axis_name is None:
+            return jnp.max(x)
+        return lax.pmax(jnp.max(x), axis_name)
+
+    def _min_over_agents(x):
+        if axis_name is None:
+            return jnp.min(x)
+        return lax.pmin(jnp.min(x), axis_name)
+
+    r_local = jnp.take(params.r, agent_ids, axis=0)
+
+    env_cbfs = agent_env_cbfs_for(params, cfg, forest, state, r_local)
+    onehots = jax.nn.one_hot(agent_ids, n, dtype=dtype)
+    leaders = (agent_ids == cfg.leader_idx).astype(dtype)
+
+    P, q0, A, lb, ub, shift = jax.vmap(
+        lambda oh, ld, cbf: _build_agent_qp(
+            params, cfg, f_eq, state, acc_des, cbf, oh, ld, rho
+        )
+    )(onehots, leaders, env_cbfs)
+
+    n_box = 13 + cfg.n_env_cbfs
+    m = n_box + 8
+    rho_vec = jax.vmap(
+        lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
+    )(lb, ub)
+    chol = socp.kkt_cholesky(P, A, rho_vec)
+
+    solve_one = jax.vmap(
+        lambda P_, q_, A_, lb_, ub_, shift_, chol_, warm_: socp.solve_socp(
+            P_, q_, A_, lb_, ub_,
+            n_box=n_box, soc_dims=(4, 4), iters=cfg.inner_iters,
+            warm=warm_, shift=shift_, chol=chol_,
+        )
+    )
+
+    def consensus_iter(carry):
+        f, lam, f_mean, warm, it, res, err_buf = carry
+        # Primal: augmented linear term <lam_i, f> - rho <f_mean, f>.
+        q_extra = (lam - rho * f_mean[None, :, :]).reshape(n_local, 3 * n)
+        q = q0.at[:, 9:].add(q_extra)
+        sols = solve_one(P, q, A, lb, ub, shift, chol, warm)
+        f_new = sols.x[:, 9:].reshape(n_local, n, 3)
+        # Failed agents fall back to equilibrium forces (reference :491-494).
+        ok = (sols.prim_res < cfg.solver_tol)[:, None, None] & jnp.all(
+            jnp.isfinite(f_new), axis=(1, 2), keepdims=True
+        )
+        f_new = jnp.where(ok, f_new, f_eq[None, :, :])
+        # Failed agents also keep their previous warm start (a NaN iterate would
+        # poison every later solve; cvxpy in the reference re-solves fresh).
+        ok_flat = ok[:, 0, 0]
+        sols = jax.tree.map(
+            lambda new, old: jnp.where(
+                ok_flat.reshape((n_local,) + (1,) * (new.ndim - 1)), new, old
+            ),
+            sols, warm,
+        )
+        # Consensus all-reduce: mean + inf-norm residual (psum/pmax over the
+        # mesh axis when agents are sharded).
+        f_mean_new = _mean_over_agents(f_new)
+        res_new = _max_over_agents(jnp.abs(f_new - f_mean_new[None, :, :]))
+        err_buf = err_buf.at[it].set(res_new)
+        it = it + 1
+        # Dual update (skipped after the final iteration by the while cond).
+        lam_new = lam + rho * (f_new - f_mean_new[None, :, :])
+        return f_new, lam_new, f_mean_new, sols, it, res_new, err_buf
+
+    def cond(carry):
+        *_, it, res, _buf = carry
+        return (res >= cfg.res_tol) & (it <= cfg.max_iter)
+
+    err_buf0 = jnp.full((cfg.max_iter + 1,), jnp.nan, dtype)
+    init = (
+        admm_state.f, admm_state.lam, admm_state.f_mean, admm_state.warm,
+        jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype), err_buf0,
+    )
+    f, lam, f_mean, warm, iters, res, err_buf = lax.while_loop(
+        cond, lambda c: consensus_iter(c), init
+    )
+
+    # Applied forces: agent i applies its own column (reference :669-675).
+    f_app = f[jnp.arange(n_local), agent_ids, :]
+    new_state = CADMMState(f=f, lam=lam, f_mean=f_mean, warm=warm)
+    collision = _max_over_agents(env_cbfs.collision.astype(jnp.int32)) > 0
+    stats = SolverStats(
+        iters=iters,
+        solve_res=res,
+        collision=collision,
+        min_env_dist=_min_over_agents(env_cbfs.min_dist),
+        err_seq=err_buf,
+    )
+    return f_app, new_state, stats

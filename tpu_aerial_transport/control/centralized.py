@@ -115,6 +115,15 @@ def equilibrium_forces(params: RQPParams) -> jnp.ndarray:
     return jnp.concatenate([jnp.zeros((n, 2), params.r.dtype), fz[:, None]], axis=1)
 
 
+def qp_dims(n: int, n_env_cbfs: int):
+    """Single source of truth for the QP row layout: ``(n_box, m, soc_dims)``.
+    Box rows: [dyn-trans 3 | dyn-rot 3 | kin 3 | fz_min n | tilt 1 | wl 1 |
+    vl 1 | env k]; then per agent two SOC(4) blocks (thrust cone, norm cap)."""
+    n_box = 12 + n + n_env_cbfs
+    soc_dims = (4,) * (2 * n)
+    return n_box, n_box + sum(soc_dims), soc_dims
+
+
 @struct.dataclass
 class CtrlState:
     """Mutable controller state threaded through the rollout scan: previous
@@ -126,8 +135,7 @@ class CtrlState:
 
 def init_ctrl_state(params: RQPParams, cfg: RQPCentralizedConfig) -> CtrlState:
     n = params.n
-    n_box = 12 + n + cfg.n_env_cbfs
-    m = n_box + 8 * n  # box rows + 2n SOC(4) blocks (see _build_qp).
+    _, m, _ = qp_dims(n, cfg.n_env_cbfs)
     f_eq = equilibrium_forces(params)
     x0 = jnp.concatenate([jnp.zeros(9, f_eq.dtype), f_eq.reshape(-1)])
     warm = socp.SOCPSolution(
@@ -150,9 +158,8 @@ def _build_qp(
 ):
     """Assemble ``(P, q, A, lb, ub, shift)`` for the current state. Pure, jittable.
 
-    Variable layout: [dv_com 0:3 | dvl 3:6 | dwl 6:9 | f 9:9+3n] (agent-major).
-    Box rows: [dyn-trans 3 | dyn-rot 3 | kin 3 | fz_min n | tilt 1 | wl 1 | vl 1 |
-    env k]; then per agent two SOC(4) blocks (thrust cone, norm cap).
+    Variable layout: [dv_com 0:3 | dvl 3:6 | dwl 6:9 | f 9:9+3n] (agent-major);
+    the row layout is defined by :func:`qp_dims`.
     """
     n = params.n
     dtype = state.xl.dtype
@@ -186,7 +193,7 @@ def _build_qp(
     )
 
     # --- Box constraint rows.
-    n_box = 12 + n + cfg.n_env_cbfs
+    n_box, _, _ = qp_dims(n, cfg.n_env_cbfs)
     A = jnp.zeros((n_box, nv), dtype)
     lb = jnp.zeros((n_box,), dtype)
     ub = jnp.zeros((n_box,), dtype)
@@ -296,11 +303,11 @@ def control(
             dtype=state.xl.dtype,
         )
     P, q, A, lb, ub, shift = _build_qp(params, cfg, f_eq, state, acc_des, env_cbf)
-    n_box = 12 + n + cfg.n_env_cbfs
+    n_box, _, soc_dims = qp_dims(n, cfg.n_env_cbfs)
     sol = socp.solve_socp(
         P, q, A, lb, ub,
         n_box=n_box,
-        soc_dims=(4,) * (2 * n),
+        soc_dims=soc_dims,
         iters=cfg.solver_iters,
         warm=ctrl_state.warm,
         shift=shift,

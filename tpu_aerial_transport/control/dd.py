@@ -1,0 +1,425 @@
+"""Dual-decomposition distributed controller for the RQP model.
+
+TPU-native re-design of reference ``control/rqp_dd.py``. Each agent's primal holds
+only its own force ``f_i`` plus aggregate-of-others force ``F_i`` and moment
+``M_i`` (consensus ``F_i + f_i = sum_j f_j``, ``M_i + r_i x Rl^T f_i = sum_j r_j x
+Rl^T f_j``, docstring :48-51), with a linear price cost ``c_fi^T f_i + c_Fi^T F_i
++ c_Mi^T M_i`` assembled from every agent's duals (the logical all-gather,
+:716-722). The dual update is a quasi-Newton ascent (:634-693): per-agent
+strong-convexity matrices ``Q_i (9x9)`` from the cost curvature, global consensus
+matrix ``A (6n x 9n)``, QN matrix ``A Q^{-1} A^T + beta I`` Cholesky-factored once
+per control step, dual step ``cho_solve(QN, A @ primal)``.
+
+TPU mapping: each agent's QP has a **constant 18 variables regardless of n** (vs
+9+3n for C-ADMM's full local copies), so DD is the better-scaling distributed
+mode; all n QPs solve as one vmapped batch, the price assembly is two ``sum``
+reductions (``psum`` over a mesh axis in the ``parallel`` layer), and the 6n-dim
+QN solve is replicated on every device — tiny and deterministic, as SURVEY.md §5.8
+prescribes. Like the C-ADMM port, the per-agent KKT systems are factored once per
+control step (only the price vector moves between dual iterations).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from tpu_aerial_transport.control.cadmm import RQPCADMMConfig, agent_env_cbfs
+from tpu_aerial_transport.control.centralized import equilibrium_forces
+from tpu_aerial_transport.control.types import EnvCBF, SolverStats
+from tpu_aerial_transport.envs import forest as forest_mod
+from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
+from tpu_aerial_transport.ops import lie, socp
+
+
+@struct.dataclass
+class RQPDDConfig:
+    """DD constants (reference ``_set_controller_constants``, rqp_dd.py:197-241 and
+    :604-616). Shares every primal constant with C-ADMM; adds the dual-ascent
+    regularization ``beta`` (0 by default) and the primal-infeasibility stop."""
+
+    base: RQPCADMMConfig
+    beta: float = 0.0
+    prim_inf_tol: float = 1e-2
+    sc_eps: float = 1e-6  # strong-convexity floor (reference :514).
+
+
+def make_config(
+    params: RQPParams,
+    collision_radius: float,
+    max_deceleration: float,
+    n_env_cbfs: int = 10,
+    max_iter: int = 100,
+    inner_iters: int = 60,
+    prim_inf_tol: float = 1e-2,
+) -> RQPDDConfig:
+    from tpu_aerial_transport.control import cadmm as cadmm_mod
+
+    base = cadmm_mod.make_config(
+        params, collision_radius, max_deceleration,
+        n_env_cbfs=n_env_cbfs, max_iter=max_iter, inner_iters=inner_iters,
+    )
+    return RQPDDConfig(base=base, prim_inf_tol=prim_inf_tol)
+
+
+@struct.dataclass
+class DDState:
+    """Solver state across control steps (reference ``_set_variables`` +
+    ``_set_warm_start``, :618-632): primal optima, duals, per-agent warm starts."""
+
+    f: jnp.ndarray  # (n, 3) own forces.
+    F: jnp.ndarray  # (n, 3) aggregate-of-others forces.
+    M: jnp.ndarray  # (n, 3) aggregate-of-others moments.
+    lam_F: jnp.ndarray  # (n, 3) duals of the force consensus rows.
+    lam_M: jnp.ndarray  # (n, 3) duals of the moment consensus rows.
+    warm: socp.SOCPSolution  # leading agent axis.
+
+
+def init_dd_state(params: RQPParams, cfg: RQPDDConfig) -> DDState:
+    n = params.n
+    f_eq = equilibrium_forces(params)
+    dtype = f_eq.dtype
+    F0 = jnp.sum(f_eq, axis=0)[None, :] - f_eq
+    # prev_Mi = -JT_inv hat(r_com_i) f_eq_i (reference :466).
+    M0 = -jnp.einsum(
+        "ij,njk,nk->ni", params.JT_inv,
+        jax.vmap(lie.hat)(params.r_com), f_eq,
+    )
+    nv = 18
+    n_box = 13 + cfg.base.n_env_cbfs
+    m = n_box + 8
+    x0 = jnp.concatenate(
+        [jnp.zeros((n, 9), dtype), f_eq, F0, M0], axis=1
+    )
+    warm = socp.SOCPSolution(
+        x=x0,
+        y=jnp.zeros((n, m), dtype),
+        z=jnp.zeros((n, m), dtype),
+        prim_res=jnp.zeros((n,), dtype),
+        dual_res=jnp.zeros((n,), dtype),
+    )
+    return DDState(
+        f=f_eq, F=F0, M=M0,
+        lam_F=jnp.zeros((n, 3), dtype),
+        lam_M=jnp.zeros((n, 3), dtype),
+        warm=warm,
+    )
+
+
+def _build_agent_qp(
+    params: RQPParams,
+    cfg: RQPCADMMConfig,
+    fi_eq: jnp.ndarray,
+    r_com_i: jnp.ndarray,
+    state: RQPState,
+    acc_des,
+    env_cbf: EnvCBF,
+    is_leader: jnp.ndarray,
+):
+    """Per-agent DD primal QP (docstring rqp_dd.py:30-46), vmapped over agents.
+
+    Variable layout: [dv_com 0:3 | dvl 3:6 | dwl 6:9 | f_i 9:12 | F_i 12:15 |
+    M_i 15:18] — 18 vars independent of n. Box rows: [dyn-trans 3 | dyn-rot 3 |
+    kin 3 | fz 1 | tilt 1 | wl 1 | vl 1 | env k]; SOC: thrust cone + norm cap.
+    The iteration-varying price vector c enters via q (caller adds it).
+    """
+    dtype = state.xl.dtype
+    nv = 18
+    dvl_des, dwl_des = acc_des
+    e3 = jnp.array([0.0, 0.0, 1.0], dtype=dtype)
+    Rl = state.Rl
+    Gi = lie.hat(r_com_i) @ Rl.T  # hat(r_com_i) Rl^T.
+
+    P = jnp.zeros((nv, nv), dtype)
+    q = jnp.zeros((nv,), dtype)
+    k_dvl = cfg.k_dvl * is_leader
+    k_dwl = cfg.k_dwl * is_leader
+    P = P.at[3:6, 3:6].add(2.0 * k_dvl * jnp.eye(3, dtype=dtype))
+    q = q.at[3:6].add(-2.0 * k_dvl * dvl_des)
+    P = P.at[6:9, 6:9].add(2.0 * k_dwl * jnp.eye(3, dtype=dtype))
+    q = q.at[6:9].add(-2.0 * k_dwl * dwl_des)
+
+    # (k_f/n) ||f_i + F_i - mT g e3||^2 on blocks [f, F].
+    Sf = jnp.zeros((3, nv), dtype)
+    Sf = Sf.at[:, 9:12].set(jnp.eye(3, dtype=dtype))
+    Sf = Sf.at[:, 12:15].set(jnp.eye(3, dtype=dtype))
+    P = P + 2.0 * cfg.k_f * (Sf.T @ Sf)
+    q = q + (-2.0 * cfg.k_f) * (Sf.T @ (params.mT * GRAVITY * e3))
+    # (k_m/n) ||M_i + hat(r_com_i) Rl^T f_i||^2.
+    Sm = jnp.zeros((3, nv), dtype)
+    Sm = Sm.at[:, 9:12].set(Gi)
+    Sm = Sm.at[:, 15:18].set(jnp.eye(3, dtype=dtype))
+    P = P + 2.0 * cfg.k_m * (Sm.T @ Sm)
+    # k_feq ||f_i - fi_eq||^2.
+    P = P.at[9:12, 9:12].add(2.0 * cfg.k_feq * jnp.eye(3, dtype=dtype))
+    q = q.at[9:12].add(-2.0 * cfg.k_feq * fi_eq)
+
+    n_box = 13 + cfg.n_env_cbfs
+    A = jnp.zeros((n_box, nv), dtype)
+    lb = jnp.zeros((n_box,), dtype)
+    ub = jnp.zeros((n_box,), dtype)
+
+    # Dynamics translation: mT dv_com - f_i - F_i = -mT g e3.
+    A = A.at[0:3, 0:3].set(params.mT * jnp.eye(3, dtype=dtype))
+    A = A.at[0:3, 9:12].set(-jnp.eye(3, dtype=dtype))
+    A = A.at[0:3, 12:15].set(-jnp.eye(3, dtype=dtype))
+    rhs = -params.mT * GRAVITY * e3
+    lb = lb.at[0:3].set(rhs)
+    ub = ub.at[0:3].set(rhs)
+
+    # Dynamics rotation: dwl - JT_inv (hat(r_i) Rl^T f_i + M_i) = -JT_inv (wl x JT wl).
+    A = A.at[3:6, 6:9].set(jnp.eye(3, dtype=dtype))
+    A = A.at[3:6, 9:12].set(-params.JT_inv @ Gi)
+    A = A.at[3:6, 15:18].set(-params.JT_inv)
+    rot_rhs = -params.JT_inv @ jnp.cross(state.wl, params.JT @ state.wl)
+    lb = lb.at[3:6].set(rot_rhs)
+    ub = ub.at[3:6].set(rot_rhs)
+
+    # Kinematics.
+    R_w_hat = Rl @ lie.hat(state.wl)
+    R_w_hat_sq = Rl @ lie.hat_square(state.wl, state.wl)
+    A = A.at[6:9, 0:3].set(-jnp.eye(3, dtype=dtype))
+    A = A.at[6:9, 3:6].set(jnp.eye(3, dtype=dtype))
+    A = A.at[6:9, 6:9].set(-Rl @ lie.hat(params.x_com))
+    kin_rhs = -R_w_hat_sq @ params.x_com
+    lb = lb.at[6:9].set(kin_rhs)
+    ub = ub.at[6:9].set(kin_rhs)
+
+    # f_z >= min_fz.
+    A = A.at[9, 11].set(1.0)
+    lb = lb.at[9].set(cfg.min_fz)
+    ub = ub.at[9].set(socp.INF)
+
+    # Tilt / |wl| / |vl| CBFs.
+    A = A.at[10, 6:9].set(-(Rl[2] @ lie.hat(e3)))
+    tilt_rhs = (
+        -R_w_hat_sq[2, 2]
+        - (cfg.alpha1_p_cbf + cfg.alpha2_p_cbf) * R_w_hat[2, 2]
+        - cfg.alpha1_p_cbf * cfg.alpha2_p_cbf * (Rl[2, 2] - cfg.cos_max_p_ang)
+    )
+    lb = lb.at[10].set(tilt_rhs)
+    ub = ub.at[10].set(socp.INF)
+    A = A.at[11, 6:9].set(-2.0 * state.wl)
+    lb = lb.at[11].set(
+        -cfg.alpha_wl_cbf * (cfg.max_wl_sq - jnp.dot(state.wl, state.wl))
+    )
+    ub = ub.at[11].set(socp.INF)
+    A = A.at[12, 3:6].set(-2.0 * state.vl)
+    lb = lb.at[12].set(
+        -cfg.alpha_vl_cbf * (cfg.max_vl_sq - jnp.dot(state.vl, state.vl))
+    )
+    ub = ub.at[12].set(socp.INF)
+
+    A = A.at[13 : 13 + cfg.n_env_cbfs, 3:6].set(env_cbf.lhs)
+    lb = lb.at[13 : 13 + cfg.n_env_cbfs].set(env_cbf.rhs)
+    ub = ub.at[13 : 13 + cfg.n_env_cbfs].set(socp.INF)
+
+    # SOC rows on f_i.
+    soc = jnp.zeros((8, nv), dtype)
+    shift_soc = jnp.zeros((8,), dtype)
+    soc = soc.at[0, 11].set(cfg.sec_max_f_ang)
+    soc = soc.at[1:4, 9:12].set(jnp.eye(3, dtype=dtype))
+    shift_soc = shift_soc.at[4].set(cfg.max_f)
+    soc = soc.at[5:8, 9:12].set(jnp.eye(3, dtype=dtype))
+
+    A_full = jnp.concatenate([A, soc], axis=0)
+    shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    return P, q, A_full, lb, ub, shift
+
+
+def strong_convexity_matrix(
+    params: RQPParams,
+    cfg: RQPCADMMConfig,
+    state: RQPState,
+    r_com_i: jnp.ndarray,
+    is_leader: jnp.ndarray,
+    eps: float,
+):
+    """Per-agent curvature lower-bound over (f_i, F_i, M_i) (reference
+    ``strong_convexity_matrix``, rqp_dd.py:513-555): sum of 2 k (C^T C) for each
+    quadratic cost term, with the dynamics equalities substituted so dvl/dwl
+    become affine in (f_i, F_i, M_i)."""
+    dtype = state.xl.dtype
+    eye = jnp.eye(3, dtype=dtype)
+    mat = eps * jnp.eye(9, dtype=dtype)
+
+    def add(mat, Cf, CF, CM, k):
+        C = jnp.concatenate([Cf, CF, CM], axis=1)  # (3, 9)
+        return mat + 2.0 * k * (C.T @ C)
+
+    zero = jnp.zeros((3, 3), dtype)
+    # k_feq on f_i.
+    mat = add(mat, eye, zero, zero, cfg.k_feq)
+    # k_f on f_i + F_i.
+    mat = add(mat, eye, eye, zero, cfg.k_f)
+    # k_m on M_i + hat(r_i) Rl^T f_i.
+    Gi = lie.hat(r_com_i) @ state.Rl.T
+    mat = add(mat, Gi, zero, eye, cfg.k_m)
+    # k_dwl (leader only): dwl = JT_inv Gi f + JT_inv M + const.
+    coeff_dwl_f = params.JT_inv @ Gi
+    mat = add(mat, coeff_dwl_f, zero, params.JT_inv, cfg.k_dwl * is_leader)
+    # k_dvl (leader only): dvl = f/mT + F/mT + Rl hat(x_com) dwl + const.
+    Rx = state.Rl @ lie.hat(params.x_com)
+    mat = add(
+        mat,
+        eye / params.mT + Rx @ coeff_dwl_f,
+        eye / params.mT,
+        Rx @ params.JT_inv,
+        cfg.k_dvl * is_leader,
+    )
+    return mat
+
+
+def _consensus_matrix(params: RQPParams, state: RQPState):
+    """Global consensus constraint matrix ``A (6n, 9n)`` (reference :643-653):
+    row block i reads ``[F_i - sum_{j!=i} f_j ; M_i - sum_{j!=i} r_j x Rl^T f_j]``
+    off the stacked per-agent primal ``(f_j, F_j, M_j)``."""
+    n = params.n
+    dtype = state.xl.dtype
+    G = jax.vmap(lambda r: lie.hat(r) @ state.Rl.T)(params.r_com)  # (n, 3, 3)
+    eye = jnp.eye(3, dtype=dtype)
+    A = jnp.zeros((6 * n, 9 * n), dtype)
+    for i in range(n):
+        A = A.at[6 * i : 6 * i + 3, 9 * i + 3 : 9 * i + 6].set(eye)
+        A = A.at[6 * i + 3 : 6 * i + 6, 9 * i + 6 : 9 * i + 9].set(eye)
+    for i in range(n):
+        for j in range(n):
+            if j == i:
+                continue
+            A = A.at[6 * i : 6 * i + 3, 9 * j : 9 * j + 3].set(-eye)
+            A = A.at[6 * i + 3 : 6 * i + 6, 9 * j : 9 * j + 3].set(-G[j])
+    return A
+
+
+def control(
+    params: RQPParams,
+    cfg: RQPDDConfig,
+    f_eq: jnp.ndarray,
+    dd_state: DDState,
+    state: RQPState,
+    acc_des,
+    forest: forest_mod.Forest | None = None,
+):
+    """One DD control step: ``-> (f (n, 3), DDState, SolverStats)`` (reference
+    ``RQPDDController.control``, :695-752)."""
+    n = params.n
+    base = cfg.base
+    dtype = state.xl.dtype
+
+    env_cbfs = agent_env_cbfs(params, base, forest, state)
+    leaders = jnp.zeros((n,), dtype).at[base.leader_idx].set(1.0)
+
+    P, q0, A, lb, ub, shift = jax.vmap(
+        lambda fi_eq, r_i, ld, cbf: _build_agent_qp(
+            params, base, fi_eq, r_i, state, acc_des, cbf, ld
+        )
+    )(f_eq, params.r_com, leaders, env_cbfs)
+
+    n_box = 13 + base.n_env_cbfs
+    m = n_box + 8
+    rho_vec = jax.vmap(
+        lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
+    )(lb, ub)
+    chol = socp.kkt_cholesky(P, A, rho_vec)
+
+    # Quasi-Newton preparation, once per control step (reference :634-657).
+    Q = jax.vmap(
+        lambda r_i, ld: strong_convexity_matrix(
+            params, base, state, r_i, ld, cfg.sc_eps
+        )
+    )(params.r_com, leaders)
+    Q_inv = jnp.linalg.inv(Q)
+    Q_inv = 0.5 * (Q_inv + jnp.swapaxes(Q_inv, -1, -2))
+    Ac = _consensus_matrix(params, state)  # (6n, 9n)
+    # Block-diagonal Q^{-1}: apply per 9-block instead of materializing 9n x 9n.
+    Ac_blocks = Ac.reshape(6 * n, n, 9)
+    AQinv = jnp.einsum("mnj,njk->mnk", Ac_blocks, Q_inv).reshape(6 * n, 9 * n)
+    qn_mat = AQinv @ Ac.T + cfg.beta * jnp.eye(6 * n, dtype=dtype)
+    qn_chol = jnp.linalg.cholesky(qn_mat)
+
+    G = jax.vmap(lambda r: lie.hat(r) @ state.Rl.T)(params.r_com)
+
+    solve_one = jax.vmap(
+        lambda P_, q_, A_, lb_, ub_, shift_, chol_, warm_: socp.solve_socp(
+            P_, q_, A_, lb_, ub_,
+            n_box=n_box, soc_dims=(4, 4), iters=base.inner_iters,
+            warm=warm_, shift=shift_, chol=chol_,
+        )
+    )
+
+    # Solver-failure fallbacks (reference :486-489): equilibrium forces and the
+    # aggregates they imply.
+    fallback_F = jnp.sum(f_eq, axis=0)[None, :] - f_eq
+    fallback_M = -jnp.einsum("ij,njk,nk->ni", params.JT_inv, G, f_eq)
+
+    def dd_iter(carry):
+        f, F, M, lam_F, lam_M, warm, it, err, err_buf = carry
+        # Price assembly (the all-gather, reference :716-722).
+        sum_lF = jnp.sum(lam_F, axis=0)
+        sum_lM = jnp.sum(lam_M, axis=0)
+        c_F = lam_F
+        c_M = lam_M
+        c_f = -(sum_lF[None, :] - lam_F) + jnp.einsum(
+            "nij,nj->ni",
+            jax.vmap(lambda r: state.Rl @ lie.hat(r))(params.r_com),
+            sum_lM[None, :] - lam_M,
+        )
+        q = q0.at[:, 9:12].add(c_f).at[:, 12:15].add(c_F).at[:, 15:18].add(c_M)
+        sols = solve_one(P, q, A, lb, ub, shift, chol, warm)
+        x = sols.x
+        ok = (sols.prim_res < base.solver_tol) & jnp.all(
+            jnp.isfinite(x), axis=-1
+        )
+        okc = ok[:, None]
+        f_new = jnp.where(okc, x[:, 9:12], f_eq)
+        F_new = jnp.where(okc, x[:, 12:15], fallback_F)
+        M_new = jnp.where(okc, x[:, 15:18], fallback_M)
+        warm_new = jax.tree.map(
+            lambda new, old: jnp.where(
+                ok.reshape((n,) + (1,) * (new.ndim - 1)), new, old
+            ),
+            sols, warm,
+        )
+        # Primal infeasibility (the all-reduce, reference :659-676).
+        moments = jnp.einsum("nij,nj->ni", G, f_new)
+        err_F = F_new - (jnp.sum(f_new, axis=0)[None, :] - f_new)
+        err_M = M_new - (jnp.sum(moments, axis=0)[None, :] - moments)
+        err_new = jnp.maximum(jnp.max(jnp.abs(err_F)), jnp.max(jnp.abs(err_M)))
+        err_buf = err_buf.at[it].set(err_new)
+        it = it + 1
+        # Quasi-Newton dual ascent (reference :678-693).
+        prim = jnp.concatenate([f_new, F_new, M_new], axis=1).reshape(-1)  # (9n,)
+        dual_grad = Ac @ prim
+        t = jax.scipy.linalg.solve_triangular(qn_chol, dual_grad, lower=True)
+        step = jax.scipy.linalg.solve_triangular(qn_chol.T, t, lower=False)
+        step = step.reshape(n, 6)
+        lam_F_new = lam_F + step[:, :3]
+        lam_M_new = lam_M + step[:, 3:]
+        return (f_new, F_new, M_new, lam_F_new, lam_M_new, warm_new, it,
+                err_new, err_buf)
+
+    def cond(carry):
+        *_, it, err, _buf = carry
+        return (err >= cfg.prim_inf_tol) & (it <= base.max_iter)
+
+    err_buf0 = jnp.full((base.max_iter + 1,), jnp.nan, dtype)
+    init = (
+        dd_state.f, dd_state.F, dd_state.M, dd_state.lam_F, dd_state.lam_M,
+        dd_state.warm, jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype),
+        err_buf0,
+    )
+    f, F, M, lam_F, lam_M, warm, iters, err, err_buf = lax.while_loop(
+        cond, dd_iter, init
+    )
+
+    new_state = DDState(f=f, F=F, M=M, lam_F=lam_F, lam_M=lam_M, warm=warm)
+    stats = SolverStats(
+        iters=iters,
+        solve_res=err,
+        collision=jnp.any(env_cbfs.collision),
+        min_env_dist=jnp.min(env_cbfs.min_dist),
+        err_seq=err_buf,
+    )
+    return f, new_state, stats

@@ -1,0 +1,226 @@
+"""Centralized QP + CBF safety-filter controller for the rigid-payload (RP) model.
+
+TPU-native re-design of reference ``control/rp_centralized.py``
+(``RPCentralizedController``, problem docstring :11-22): decision variables
+``[dvl | dwl | f_1..f_n]`` (no CoM split — RP forces act at payload body points),
+quadratic tracking + regularization costs, payload dynamics equalities, per-agent
+thrust-cone/norm SOCs, tilt / |wl| / |vl| CBF rows. No environment CBFs (the
+reference leaves them as a TODO at :74).
+
+Reference constants (:147-175): min_fz = ml g / 10n, cone 30 deg,
+max_f = 2 ml g / n, max payload tilt 30 deg (vs 15 for RQP), |wl| <= pi/6,
+|vl| <= 1, k_f = k_feq = 0.1, k_dvl = k_dwl = 1.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from tpu_aerial_transport.control.types import SolverStats
+from tpu_aerial_transport.models.rp import GRAVITY, RPParams, RPState
+from tpu_aerial_transport.ops import lie, socp
+
+
+@struct.dataclass
+class RPCentralizedConfig:
+    min_fz: float
+    sec_max_f_ang: float
+    max_f: float
+    cos_max_p_ang: float
+    alpha1_p_cbf: float
+    alpha2_p_cbf: float
+    max_wl_sq: float
+    alpha_wl_cbf: float
+    max_vl_sq: float
+    alpha_vl_cbf: float
+    k_f: float
+    k_feq: float
+    k_dvl: float
+    k_dwl: float
+    solver_iters: int = struct.field(pytree_node=False, default=150)
+    solver_tol: float = struct.field(pytree_node=False, default=5e-3)
+
+
+def make_config(params: RPParams, solver_iters: int = 150) -> RPCentralizedConfig:
+    n = params.n
+    mlg = float(params.ml) * GRAVITY
+    return RPCentralizedConfig(
+        min_fz=mlg / (n * 10.0),
+        sec_max_f_ang=float(1.0 / jnp.cos(jnp.pi / 6.0)),
+        max_f=2.0 * mlg / n,
+        cos_max_p_ang=float(jnp.cos(jnp.pi / 6.0)),  # 30 deg for RP.
+        alpha1_p_cbf=1.0,
+        alpha2_p_cbf=1.0,
+        max_wl_sq=float((jnp.pi / 6.0) ** 2),
+        alpha_wl_cbf=1.0,
+        max_vl_sq=1.0,
+        alpha_vl_cbf=1.0,
+        k_f=0.1,
+        k_feq=0.1,
+        k_dvl=1.0,
+        k_dwl=1.0,
+        solver_iters=solver_iters,
+    )
+
+
+def equilibrium_forces(params: RPParams) -> jnp.ndarray:
+    """Vertical static-wrench-balance forces (reference :122-130)."""
+    n = params.n
+    e3 = jnp.array([0.0, 0.0, 1.0], dtype=params.r.dtype)
+    rxe = jnp.cross(params.r, e3)
+    wrench = jnp.concatenate(
+        [jnp.ones((n, 1), params.r.dtype), rxe[:, :2]], axis=1
+    ).T
+    rhs = jnp.array([params.ml * GRAVITY, 0.0, 0.0], dtype=params.r.dtype)
+    fz = jnp.linalg.lstsq(wrench, rhs)[0]
+    return jnp.concatenate([jnp.zeros((n, 2), params.r.dtype), fz[:, None]], axis=1)
+
+
+@struct.dataclass
+class CtrlState:
+    prev_f: jnp.ndarray  # (n, 3)
+    warm: socp.SOCPSolution
+
+
+def init_ctrl_state(params: RPParams, cfg: RPCentralizedConfig) -> CtrlState:
+    n = params.n
+    n_box = 9 + n
+    m = n_box + 8 * n
+    f_eq = equilibrium_forces(params)
+    x0 = jnp.concatenate([jnp.zeros(6, f_eq.dtype), f_eq.reshape(-1)])
+    warm = socp.SOCPSolution(
+        x=x0,
+        y=jnp.zeros((m,), f_eq.dtype),
+        z=jnp.zeros((m,), f_eq.dtype),
+        prim_res=jnp.zeros((), f_eq.dtype),
+        dual_res=jnp.zeros((), f_eq.dtype),
+    )
+    return CtrlState(prev_f=f_eq, warm=warm)
+
+
+def _build_qp(params: RPParams, cfg: RPCentralizedConfig, f_eq, state: RPState,
+              acc_des):
+    """[dvl 0:3 | dwl 3:6 | f 6:6+3n]; box rows [dyn-trans 3 | dyn-rot 3 |
+    fz n | tilt 1 | wl 1 | vl 1] then 2n SOC(4) blocks."""
+    n = params.n
+    dtype = state.xl.dtype
+    nv = 6 + 3 * n
+    dvl_des, dwl_des = acc_des
+    e3 = jnp.array([0.0, 0.0, 1.0], dtype=dtype)
+    Rl = state.Rl
+
+    P = jnp.zeros((nv, nv), dtype)
+    q = jnp.zeros((nv,), dtype)
+    P = P.at[0:3, 0:3].add(2.0 * cfg.k_dvl * jnp.eye(3, dtype=dtype))
+    q = q.at[0:3].add(-2.0 * cfg.k_dvl * dvl_des)
+    P = P.at[3:6, 3:6].add(2.0 * cfg.k_dwl * jnp.eye(3, dtype=dtype))
+    q = q.at[3:6].add(-2.0 * cfg.k_dwl * dwl_des)
+    S = jnp.tile(jnp.eye(3, dtype=dtype), (1, n))
+    P = P.at[6:, 6:].add(
+        2.0 * cfg.k_f * (S.T @ S) + 2.0 * cfg.k_feq * jnp.eye(3 * n, dtype=dtype)
+    )
+    q = q.at[6:].add(
+        -2.0 * cfg.k_f * (S.T @ (params.ml * GRAVITY * e3))
+        - 2.0 * cfg.k_feq * f_eq.reshape(-1)
+    )
+
+    n_box = 9 + n
+    A = jnp.zeros((n_box, nv), dtype)
+    lb = jnp.zeros((n_box,), dtype)
+    ub = jnp.zeros((n_box,), dtype)
+
+    # ml dvl - sum f_i = -ml g e3.
+    A = A.at[0:3, 0:3].set(params.ml * jnp.eye(3, dtype=dtype))
+    A = A.at[0:3, 6:].set(-S)
+    rhs = -params.ml * GRAVITY * e3
+    lb = lb.at[0:3].set(rhs)
+    ub = ub.at[0:3].set(rhs)
+
+    # dwl - sum Jl_inv hat(r_i) Rl^T f_i = -Jl_inv (wl x Jl wl).
+    G = jnp.concatenate([lie.hat(params.r[i]) @ Rl.T for i in range(n)], axis=1)
+    A = A.at[3:6, 3:6].set(jnp.eye(3, dtype=dtype))
+    A = A.at[3:6, 6:].set(-params.Jl_inv @ G)
+    rot_rhs = -params.Jl_inv @ jnp.cross(state.wl, params.Jl @ state.wl)
+    lb = lb.at[3:6].set(rot_rhs)
+    ub = ub.at[3:6].set(rot_rhs)
+
+    for i in range(n):
+        A = A.at[6 + i, 6 + 3 * i + 2].set(1.0)
+    lb = lb.at[6 : 6 + n].set(cfg.min_fz)
+    ub = ub.at[6 : 6 + n].set(socp.INF)
+
+    R_w_hat = Rl @ lie.hat(state.wl)
+    R_w_hat_sq = Rl @ lie.hat_square(state.wl, state.wl)
+    r_tilt = 6 + n
+    A = A.at[r_tilt, 3:6].set(-(Rl[2] @ lie.hat(e3)))
+    tilt_rhs = (
+        -R_w_hat_sq[2, 2]
+        - (cfg.alpha1_p_cbf + cfg.alpha2_p_cbf) * R_w_hat[2, 2]
+        - cfg.alpha1_p_cbf * cfg.alpha2_p_cbf * (Rl[2, 2] - cfg.cos_max_p_ang)
+    )
+    lb = lb.at[r_tilt].set(tilt_rhs)
+    ub = ub.at[r_tilt].set(socp.INF)
+
+    A = A.at[7 + n, 3:6].set(-2.0 * state.wl)
+    lb = lb.at[7 + n].set(
+        -cfg.alpha_wl_cbf * (cfg.max_wl_sq - jnp.dot(state.wl, state.wl))
+    )
+    ub = ub.at[7 + n].set(socp.INF)
+
+    A = A.at[8 + n, 0:3].set(-2.0 * state.vl)
+    lb = lb.at[8 + n].set(
+        -cfg.alpha_vl_cbf * (cfg.max_vl_sq - jnp.dot(state.vl, state.vl))
+    )
+    ub = ub.at[8 + n].set(socp.INF)
+
+    soc = jnp.zeros((8 * n, nv), dtype)
+    shift_soc = jnp.zeros((8 * n,), dtype)
+    for i in range(n):
+        base = 8 * i
+        fi = 6 + 3 * i
+        soc = soc.at[base, fi + 2].set(cfg.sec_max_f_ang)
+        soc = soc.at[base + 1 : base + 4, fi : fi + 3].set(jnp.eye(3, dtype=dtype))
+        shift_soc = shift_soc.at[base + 4].set(cfg.max_f)
+        soc = soc.at[base + 5 : base + 8, fi : fi + 3].set(jnp.eye(3, dtype=dtype))
+
+    A_full = jnp.concatenate([A, soc], axis=0)
+    shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    return P, q, A_full, lb, ub, shift
+
+
+def control(
+    params: RPParams,
+    cfg: RPCentralizedConfig,
+    f_eq: jnp.ndarray,
+    ctrl_state: CtrlState,
+    state: RPState,
+    acc_des,
+):
+    """One control step: ``-> (f (n, 3), CtrlState, SolverStats)`` with
+    previous-solution fallback (reference ``control``, :291-302)."""
+    n = params.n
+    P, q, A, lb, ub, shift = _build_qp(params, cfg, f_eq, state, acc_des)
+    sol = socp.solve_socp(
+        P, q, A, lb, ub,
+        n_box=9 + n, soc_dims=(4,) * (2 * n), iters=cfg.solver_iters,
+        warm=ctrl_state.warm, shift=shift,
+    )
+    f = sol.x[6:].reshape(n, 3)
+    ok = (sol.prim_res < cfg.solver_tol) & jnp.all(jnp.isfinite(sol.x))
+    f_out = jnp.where(ok, f, ctrl_state.prev_f)
+    keep = lambda new, old: jnp.where(ok, new, old)
+    warm = socp.SOCPSolution(
+        x=keep(sol.x, ctrl_state.warm.x),
+        y=keep(sol.y, ctrl_state.warm.y),
+        z=keep(sol.z, ctrl_state.warm.z),
+        prim_res=sol.prim_res,
+        dual_res=sol.dual_res,
+    )
+    stats = SolverStats(
+        iters=jnp.asarray(-1, jnp.int32),
+        solve_res=sol.prim_res,
+        collision=jnp.zeros((), bool),
+        min_env_dist=jnp.asarray(jnp.inf, state.xl.dtype),
+    )
+    return f_out, CtrlState(prev_f=f_out, warm=warm), stats

@@ -1,0 +1,4 @@
+"""Environments (reference ``example/env_forest.py``): procedural forest with
+closed-form collision distance queries in JAX."""
+
+from tpu_aerial_transport.envs import forest  # noqa: F401

@@ -1,0 +1,4 @@
+"""Simulation harness (reference ``example/``): setup factories, two-rate
+jit-compiled rollouts, log schema."""
+
+from tpu_aerial_transport.harness import rollout, setup  # noqa: F401

@@ -1,0 +1,104 @@
+"""Parameter / collision / initial-state factories for all three system models.
+
+TPU-native counterpart of reference ``example/setup.py``. For ``n == 3`` the exact
+reference values are reproduced (masses 0.5 kg, payload 0.225 kg, the triangle
+attachment geometry, setup.py:64-118); for other ``n`` — which the reference
+rejects with ``NotImplementedError`` (setup.py:23,81,144) — we generalize to a
+regular n-gon of attachments with the same total actuator mass per unit payload,
+so every controller/benchmark scales in the agent axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_aerial_transport.models import pmrl, rp, rqp
+
+_REF_R3 = np.array(
+    [
+        [-0.42, -0.27, 0.0],
+        [0.48, -0.27, 0.0],
+        [-0.06, 0.55, 0.0],
+    ]
+)
+_REF_ML = 0.225
+_REF_JL = np.diag([2.1, 1.87, 3.97]) * 1e-2
+_REF_MQ = 0.5
+_REF_JQ = np.diag([2.32, 2.32, 4.0]) * 1e-3
+
+_PAYLOAD_VERTICES = np.array(
+    [
+        [-0.42, -0.27, 0.0],
+        [0.48, -0.27, 0.0],
+        [-0.06, 0.55, 0.0],
+        [-0.42, -0.27, -0.1],
+        [0.48, -0.27, -0.1],
+        [-0.06, 0.55, -0.1],
+    ]
+)
+_PAYLOAD_MESH_VERTICES = np.array(
+    [
+        [-0.52, -0.37, 0.1],
+        [0.58, -0.37, 0.1],
+        [-0.06, 0.65, 0.1],
+        [-0.52, -0.37, -0.2],
+        [0.58, -0.37, -0.2],
+        [-0.06, 0.65, -0.2],
+    ]
+)
+
+
+def _attachments(n: int) -> np.ndarray:
+    """Reference triangle for n=3; regular n-gon of circumradius 0.5 otherwise."""
+    if n == 3:
+        return _REF_R3.copy()
+    ang = 2.0 * np.pi * np.arange(n) / n
+    return np.stack(
+        [0.5 * np.cos(ang), 0.5 * np.sin(ang), np.zeros(n)], axis=-1
+    )
+
+
+def rqp_setup(n: int = 3, dtype=None):
+    """-> (RQPParams, RQPCollision, RQPState) (reference setup.py:121-126)."""
+    kw = {} if dtype is None else {"dtype": dtype}
+    params = rqp.rqp_params(
+        m=np.full(n, _REF_MQ),
+        J=np.tile(_REF_JQ, (n, 1, 1)),
+        ml=_REF_ML,
+        Jl=_REF_JL,
+        r=_attachments(n),
+        **kw,
+    )
+    col = rqp.RQPCollision(_PAYLOAD_VERTICES, _PAYLOAD_MESH_VERTICES)
+    state = rqp.rqp_identity_state(n, **kw)
+    return params, col, state
+
+
+def rp_setup(n: int = 3, dtype=None):
+    """-> (RPParams, RPCollision, RPState) (reference setup.py:59-60)."""
+    kw = {} if dtype is None else {"dtype": dtype}
+    params = rp.rp_params(ml=_REF_ML, Jl=_REF_JL, r=_attachments(n), **kw)
+    col = rp.RPCollision(_PAYLOAD_VERTICES, _PAYLOAD_MESH_VERTICES)
+    state = rp.rp_identity_state(**kw)
+    return params, col, state
+
+
+def pmrl_setup(n: int = 3, dtype=None):
+    """-> (PMRLParams, PMRLCollision-ish, PMRLState) (reference setup.py:182-187).
+    Initial link directions all +z, zero tangent velocity."""
+    kw = {} if dtype is None else {"dtype": dtype}
+    params = pmrl.pmrl_params(
+        m=np.full(n, _REF_MQ),
+        ml=_REF_ML,
+        Jl=_REF_JL,
+        r=_attachments(n),
+        L=np.ones(n),
+        **kw,
+    )
+    col = rp.RPCollision(_PAYLOAD_VERTICES, _PAYLOAD_MESH_VERTICES)
+    q = np.tile(np.array([0.0, 0.0, 1.0]), (n, 1))
+    state = pmrl.pmrl_state(
+        q=q, dq=np.zeros((n, 3)), xl=np.zeros(3), vl=np.zeros(3),
+        Rl=np.eye(3), wl=np.zeros(3), **kw,
+    )
+    return params, col, state

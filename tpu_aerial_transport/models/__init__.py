@@ -1,0 +1,3 @@
+"""System models (reference ``system/``): RQP (primary), RP, PMRL."""
+
+from tpu_aerial_transport.models import pmrl, rp, rqp  # noqa: F401

@@ -1,0 +1,165 @@
+"""ctypes bindings for the native (C++) conic-QP solver.
+
+The reference's native solver tier is Clarabel (Rust) reached through cvxpy;
+this package's native tier is ``socp_solver.cpp`` — the same ADMM algorithm as
+:mod:`tpu_aerial_transport.ops.socp`, dependency-free C++, built on demand with
+the system compiler and bound via ctypes (no pybind11 in this image). It serves
+as an independent f64 oracle for the JAX solver's tests and as a low-latency
+host-side fallback for single instances.
+
+Build: lazy, once per process tree — ``g++ -O3 -shared -fPIC`` into
+``~/.cache/tpu_aerial_transport``. Use :func:`available` to probe.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).with_name("socp_solver.cpp")
+_LIB_NAME = "libtat_socp.so"
+_lib = None
+_build_error: str | None = None
+
+
+def _cache_dir() -> Path:
+    base = os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache"))
+    d = Path(base) / "tpu_aerial_transport"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _build() -> Path:
+    out = _cache_dir() / _LIB_NAME
+    if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
+        return out
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+           str(_SRC), "-o", str(out)]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out
+
+
+def _load():
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    try:
+        lib = ctypes.CDLL(str(_build()))
+    except Exception as e:  # compiler missing, sandboxed fs, ...
+        _build_error = str(e)
+        return None
+    d = ctypes.POINTER(ctypes.c_double)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    lib.socp_solve.restype = ctypes.c_int
+    lib.socp_solve.argtypes = [
+        d, d, d, d, d, d,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, i32, ctypes.c_int,
+        ctypes.c_int, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        d, d, d, d, d, d, d,
+    ]
+    lib.socp_solve_batch.restype = ctypes.c_int
+    lib.socp_solve_batch.argtypes = [
+        d, d, d, d, d, d,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, i32,
+        ctypes.c_int,
+        ctypes.c_int, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        d, d, d, d,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True if the native library built (or loads) on this host."""
+    return _load() is not None
+
+
+def _ptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def solve_socp_native(
+    P, q, A, lb, ub, *, n_box: int, soc_dims=(), iters: int = 200,
+    rho: float = 0.4, sigma: float = 1e-6, alpha: float = 1.6, shift=None,
+    warm=None,
+):
+    """Solve one conic QP with the C++ solver (f64). Same problem layout and
+    defaults as :func:`tpu_aerial_transport.ops.socp.solve_socp`. Returns
+    ``(x, y, z, prim_res, dual_res)`` as numpy arrays/floats."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native solver unavailable: {_build_error}")
+    P = np.ascontiguousarray(P, np.float64)
+    q = np.ascontiguousarray(q, np.float64)
+    A = np.ascontiguousarray(A, np.float64)
+    lb = np.ascontiguousarray(lb, np.float64)
+    ub = np.ascontiguousarray(ub, np.float64)
+    m, nv = A.shape
+    dims = np.ascontiguousarray(soc_dims, np.int32)
+    assert m == n_box + int(dims.sum())
+    shift_p = None
+    if shift is not None:
+        shift = np.ascontiguousarray(shift, np.float64)
+        shift_p = _ptr(shift)
+    x = np.zeros(nv)
+    y = np.zeros(m)
+    z = np.zeros(m)
+    res = np.zeros(2)
+    x0 = y0 = z0 = None
+    if warm is not None:
+        x0 = np.ascontiguousarray(warm[0], np.float64)
+        y0 = np.ascontiguousarray(warm[1], np.float64)
+        z0 = np.ascontiguousarray(warm[2], np.float64)
+    rc = lib.socp_solve(
+        _ptr(P), _ptr(q), _ptr(A), _ptr(lb), _ptr(ub), shift_p,
+        nv, m, n_box,
+        dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(dims),
+        iters, rho, sigma, alpha,
+        _ptr(x0) if x0 is not None else None,
+        _ptr(y0) if y0 is not None else None,
+        _ptr(z0) if z0 is not None else None,
+        _ptr(x), _ptr(y), _ptr(z), _ptr(res),
+    )
+    if rc != 0:
+        raise RuntimeError("native KKT factorization failed (P not PSD?)")
+    return x, y, z, float(res[0]), float(res[1])
+
+
+def solve_socp_native_batch(
+    P, q, A, lb, ub, *, n_box: int, soc_dims=(), iters: int = 200,
+    rho: float = 0.4, sigma: float = 1e-6, alpha: float = 1.6, shift=None,
+):
+    """Batched native solve over the leading axis (the C counterpart of
+    ``vmap(solve_socp)``). Returns ``(x (nb, nv), residuals (nb, 2))``."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native solver unavailable: {_build_error}")
+    P = np.ascontiguousarray(P, np.float64)
+    q = np.ascontiguousarray(q, np.float64)
+    A = np.ascontiguousarray(A, np.float64)
+    lb = np.ascontiguousarray(lb, np.float64)
+    ub = np.ascontiguousarray(ub, np.float64)
+    nb, m, nv = A.shape
+    dims = np.ascontiguousarray(soc_dims, np.int32)
+    shift_p = None
+    if shift is not None:
+        shift = np.ascontiguousarray(shift, np.float64)
+        shift_p = _ptr(shift)
+    x = np.zeros((nb, nv))
+    y = np.zeros((nb, m))
+    z = np.zeros((nb, m))
+    res = np.zeros((nb, 2))
+    rc = lib.socp_solve_batch(
+        _ptr(P), _ptr(q), _ptr(A), _ptr(lb), _ptr(ub), shift_p,
+        nb, nv, m, n_box,
+        dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(dims),
+        iters, rho, sigma, alpha,
+        _ptr(x), _ptr(y), _ptr(z), _ptr(res),
+    )
+    if rc != 0:
+        raise RuntimeError("native KKT factorization failed in batch")
+    return x, res

@@ -1,0 +1,3 @@
+"""Math/solver cores: batched SO(3)/Lie ops and the conic-QP (SOCP) solver."""
+
+from tpu_aerial_transport.ops import lie, socp  # noqa: F401

@@ -158,12 +158,7 @@ def solve_socp(
     assert m == n_box + sum(soc_dims)
     dtype = P.dtype
 
-    rho_vec = jnp.full((m,), rho, dtype)
-    if n_box:
-        is_eq = (ub - lb) < 1e-9
-        rho_vec = rho_vec.at[:n_box].set(
-            jnp.where(is_eq, rho * EQ_RHO_SCALE, rho)
-        )
+    rho_vec = make_rho_vec(m, n_box, lb, ub, rho, dtype)
 
     if chol is None:
         M = P + sigma * jnp.eye(nv, dtype=dtype) + (A.T * rho_vec) @ A
@@ -201,18 +196,27 @@ def solve_socp(
         return prim, dual
 
     if check_every and tol > 0:
-        n_chunks = -(-iters // check_every)
+        n_full, rem = divmod(iters, check_every)
+
+        def above_tol(carry):
+            prim, dual = residuals(carry)
+            return (prim > tol) | (dual > tol)
 
         def cond(s):
             carry, i = s
-            prim, dual = residuals(carry)
-            return (i < n_chunks) & ((prim > tol) | (dual > tol))
+            return (i < n_full) & above_tol(carry)
 
         def body(s):
             carry, i = s
             return run_chunk(carry, check_every), i + 1
 
         carry, _ = lax.while_loop(cond, body, ((x0, y0, z0), 0))
+        if rem:
+            # Remainder chunk keeps the total at exactly `iters` when the
+            # budget is not a multiple of check_every (skipped if converged).
+            carry = lax.cond(
+                above_tol(carry), lambda c: run_chunk(c, rem), lambda c: c, carry
+            )
     else:
         carry = run_chunk((x0, y0, z0), iters)
 

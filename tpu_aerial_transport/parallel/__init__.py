@@ -1,0 +1,4 @@
+"""Mesh parallelism: agent-sharded consensus (psum/pmax over ICI) and
+scenario-sharded Monte-Carlo batches."""
+
+from tpu_aerial_transport.parallel import mesh  # noqa: F401

@@ -1,0 +1,118 @@
+"""Device-mesh parallelism layer: agent-axis sharding for the distributed
+controllers and scenario-axis sharding for Monte-Carlo rollouts.
+
+The reference has no communication backend at all — its "distributed" solvers
+loop over agents in one process (SURVEY.md §2.10). Here the two scaling axes map
+onto a ``jax.sharding.Mesh``:
+
+- **agent axis**: ``shard_map`` the C-ADMM consensus loop so each device owns a
+  block of agents' primal solvers; the consensus mean/residual run as
+  ``lax.psum``/``pmax`` collectives over ICI (wired through
+  ``control.cadmm.control(axis_name=...)``).
+- **scenario axis**: Monte-Carlo batches of full rollouts ``vmap``-ed then
+  sharded over the mesh with ``NamedSharding`` — pure data parallelism, no
+  collectives, so XLA partitions it for free.
+
+Tested on a virtual 8-device CPU mesh (conftest.py); the same code drives real
+TPU slices (ICI) and multi-host DCN meshes unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_aerial_transport.control import cadmm
+from tpu_aerial_transport.envs import forest as forest_mod
+from tpu_aerial_transport.models.rqp import RQPParams, RQPState
+
+
+def make_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a mesh over the available devices. Default: all devices on one
+    ``"agent"`` axis. ``axes`` maps axis names to sizes (product must divide the
+    device count; remaining devices are dropped)."""
+    devices = devices if devices is not None else jax.devices()
+    if axes is None:
+        axes = {"agent": len(devices)}
+    names = tuple(axes.keys())
+    sizes = tuple(axes.values())
+    total = int(np.prod(sizes))
+    assert total <= len(devices), (axes, len(devices))
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def cadmm_control_sharded(
+    params: RQPParams,
+    cfg: cadmm.RQPCADMMConfig,
+    f_eq: jnp.ndarray,
+    mesh: Mesh,
+    forest: forest_mod.Forest | None = None,
+    axis: str = "agent",
+) -> Callable:
+    """Agent-sharded C-ADMM control step.
+
+    Returns ``step(admm_state, state, acc_des) -> (f_app, admm_state, stats)``
+    where every leading-``n`` leaf of ``admm_state`` and the returned ``f_app``
+    are sharded over the ``axis`` mesh dimension; ``state``/``acc_des`` are
+    replicated. Requires ``n % mesh.shape[axis] == 0``.
+    """
+    n = params.n
+    n_shards = mesh.shape[axis]
+    assert n % n_shards == 0, (n, n_shards)
+
+    state_spec = cadmm.CADMMState(
+        f=P(axis), lam=P(axis), f_mean=P(),
+        warm=jax.tree.map(lambda _: P(axis), _warm_structure()),
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(state_spec, P(), (P(), P())),
+        out_specs=(P(axis), state_spec, P()),
+        check_vma=False,
+    )
+    def step(admm_state, state, acc_des):
+        return cadmm.control(
+            params, cfg, f_eq, admm_state, state, acc_des, forest,
+            axis_name=axis,
+        )
+
+    return step
+
+
+def _warm_structure():
+    """PartitionSpec skeleton matching SOCPSolution's 5 leaves."""
+    from tpu_aerial_transport.ops.socp import SOCPSolution
+
+    return SOCPSolution(x=0, y=0, z=0, prim_res=0, dual_res=0)
+
+
+def shard_scenarios(mesh: Mesh, batch, axis: str = "scenario"):
+    """Place a leading-axis Monte-Carlo batch pytree onto the mesh, sharded over
+    ``axis`` (payloads/scenarios are independent — pure data parallelism)."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(
+        lambda x: jax.device_put(x, sharding) if hasattr(x, "ndim") and x.ndim
+        else x,
+        batch,
+    )
+
+
+def scenario_rollout(rollout_fn: Callable, mesh: Mesh, axis: str = "scenario"):
+    """Wrap a single-scenario rollout into a sharded Monte-Carlo batch rollout:
+    ``vmap`` over the leading scenario axis, jit with shardings so XLA keeps each
+    scenario on its device (BASELINE.json config "256 scenarios x 8 agents")."""
+    batched = jax.vmap(rollout_fn)
+
+    def run(batch_args):
+        batch_args = shard_scenarios(mesh, batch_args, axis)
+        return jax.jit(batched)(*batch_args)
+
+    return run

@@ -1,0 +1,4 @@
+"""Host-side visualization: matplotlib paper figures (reference
+``example/rqp_plots.py``). Never inside the compiled path."""
+
+from tpu_aerial_transport.viz import plots  # noqa: F401

@@ -1,0 +1,108 @@
+"""Matplotlib figures from rollout logs — the reference's ``example/rqp_plots.py``
+paper-figure surface re-pointed at the npz/dict log schema from
+``harness.rollout.logs_to_dict``.
+
+All host-side; never inside the compiled path. Figures:
+- :func:`plot_tracking_errors` — position/velocity error vs time
+  (rqp_example.py:167-181).
+- :func:`plot_solver_stats` — iterations + min-env-distance (log scale, with the
+  ``dist_eps`` safety line) vs time (rqp_example.py:183-200, rqp_plots.py:393-467).
+- :func:`plot_xy_trajectory` — top-down trajectory through the forest with tree
+  footprints (rqp_plots.py:173-390, simplified: no mesh snapshots).
+- :func:`plot_convergence_rates` — DD vs C-ADMM residual-vs-iteration curves with
+  min/max bands (test_rqpcontrollers.py:101-156).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _mpl():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def plot_tracking_errors(logs: dict, path: str):
+    plt = _mpl()
+    fig, ax = plt.subplots(2, 1, figsize=(3.54, 3.54), dpi=200, sharex=True,
+                           layout="constrained")
+    T = logs["T"]
+    x_err = np.asarray(logs["x_err_seq"])
+    v_err = np.asarray(logs["v_err_seq"])
+    t = np.linspace(0.0, T, len(x_err))
+    ax[0].plot(t, x_err, "-b", lw=1)
+    ax[0].set_ylabel(r"$\|x_l - x_{ref}\|$ [m]")
+    ax[1].plot(t, v_err, "-b", lw=1)
+    ax[1].set_ylabel(r"$\|v_l - v_{ref}\|$ [m/s]")
+    ax[1].set_xlabel("t [s]")
+    fig.savefig(path)
+    plt.close(fig)
+
+
+def plot_solver_stats(logs: dict, path: str, dist_eps: float = 0.1):
+    plt = _mpl()
+    fig, ax = plt.subplots(2, 1, figsize=(3.54, 3.54), dpi=200, sharex=True,
+                           layout="constrained")
+    T = logs["T"]
+    iters = np.asarray(logs["iter_seq"])
+    t = np.linspace(0.0, T, len(iters))
+    ax[0].plot(t, iters, "-b", lw=1)
+    ax[0].set_ylabel("solver iterations")
+    d = np.asarray(logs["min_env_dist_seq"]) + 1e-6
+    t = np.linspace(0.0, T, len(d))
+    ax[1].plot(t, d, "-b", lw=1)
+    ax[1].axhline(dist_eps, color="r", ls="--", lw=0.8,
+                  label=r"$\epsilon_d$")
+    ax[1].set_yscale("log")
+    ax[1].set_ylabel("min env dist [m]")
+    ax[1].set_xlabel("t [s]")
+    ax[1].legend()
+    fig.savefig(path)
+    plt.close(fig)
+
+
+def plot_xy_trajectory(logs: dict, path: str, bark_radius: float = 0.3):
+    plt = _mpl()
+    fig, ax = plt.subplots(figsize=(3.54, 3.54), dpi=200, layout="constrained")
+    xl = np.asarray(logs["state_seq"]["xl"])
+    ax.plot(xl[:, 0], xl[:, 1], "-b", lw=1, label="payload")
+    if "tree_pos" in logs:
+        for p in np.asarray(logs["tree_pos"]):
+            ax.add_patch(plt.Circle((p[0], p[1]), bark_radius, color="saddlebrown",
+                                    alpha=0.7))
+    ax.set_aspect("equal")
+    ax.set_xlabel("x [m]")
+    ax.set_ylabel("y [m]")
+    ax.legend(loc="upper left")
+    fig.savefig(path)
+    plt.close(fig)
+
+
+def plot_convergence_rates(err_seqs: dict[str, np.ndarray], path: str):
+    """``err_seqs`` maps label -> (num_samples, num_iters) residual curves
+    (NaN-padded); plots mean with min/max band per solver on a log scale."""
+    plt = _mpl()
+    fig, ax = plt.subplots(figsize=(3.54, 2.8), dpi=200, layout="constrained")
+    colors = {"C-ADMM": "tab:blue", "DD": "tab:orange"}
+    for label, errs in err_seqs.items():
+        errs = np.asarray(errs)
+        with np.errstate(all="ignore"):
+            mean = np.nanmean(errs, axis=0)
+            lo = np.nanmin(errs, axis=0)
+            hi = np.nanmax(errs, axis=0)
+        it = np.arange(1, errs.shape[1] + 1)
+        valid = ~np.isnan(mean)
+        c = colors.get(label)
+        ax.plot(it[valid], mean[valid], lw=1.2, label=label, color=c)
+        ax.fill_between(it[valid], lo[valid], hi[valid], alpha=0.2, color=c)
+    ax.set_yscale("log")
+    ax.set_xlabel("iteration")
+    ax.set_ylabel("consensus residual [N]")
+    ax.legend()
+    fig.savefig(path)
+    plt.close(fig)

@@ -1,0 +1,206 @@
+"""3-D scene rendering and trajectory replay (reference ``RQPVisualizer`` +
+``rqp_plots._visualization`` / ``_snapshot``, system/rigid_quadrotor_payload.py:313-418
+and example/rqp_plots.py:44-147).
+
+The reference renders through meshcat (a websocket three.js viewer). meshcat is
+not part of this image, so the default backend is matplotlib 3-D snapshots —
+same scene content (payload hull, quadrotor positions/attitudes, forest, ghost
+snapshots), rendered to PNG frames host-side. If meshcat IS importable, the
+:class:`MeshcatBackend` provides the reference's live-viewer path with the same
+call surface.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+QUAD_ARM = 0.15  # [m] drawn arm length for the quadrotor cross.
+
+
+def _mpl():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def draw_snapshot(ax, params, payload_vertices, state, forest=None, alpha=1.0):
+    """Draw one scene state into a 3-D matplotlib axis.
+
+    ``state`` needs ``xl, Rl`` and optionally per-agent ``R``; agent positions
+    are the attachment points ``xl + Rl r_i`` (rigid attachment, RQP model).
+    ``alpha < 1`` renders a ghost (multi-snapshot scenes, rqp_plots.py:112-147).
+    """
+    from mpl_toolkits.mplot3d.art3d import Poly3DCollection
+
+    xl = np.asarray(state.xl)
+    Rl = np.asarray(state.Rl)
+    r = np.asarray(params.r)
+    n = r.shape[0]
+
+    # Payload hull (world frame).
+    verts = np.asarray(payload_vertices) @ Rl.T + xl
+    try:
+        from scipy.spatial import ConvexHull
+
+        hull = ConvexHull(verts)
+        faces = [verts[s] for s in hull.simplices]
+        ax.add_collection3d(
+            Poly3DCollection(faces, alpha=0.3 * alpha, facecolor="tab:gray")
+        )
+    except Exception:
+        ax.scatter(*verts.T, color="tab:gray", alpha=alpha, s=4)
+
+    # Quadrotors: attachment points + body-frame arms.
+    quad_pos = xl + r @ Rl.T
+    ax.scatter(*quad_pos.T, color="tab:blue", s=18 * alpha, alpha=alpha)
+    if hasattr(state, "R") and state.R is not None:
+        R = np.asarray(state.R)
+        for i in range(n):
+            for axis in (R[i, :, 0], R[i, :, 1]):
+                seg = np.stack(
+                    [quad_pos[i] - QUAD_ARM * axis, quad_pos[i] + QUAD_ARM * axis]
+                )
+                ax.plot(*seg.T, color="k", lw=0.8, alpha=alpha)
+
+    if forest is not None:
+        num = int(forest.num_trees)
+        pos = np.asarray(forest.tree_pos[:num])
+        h = forest.bark_height
+        for p in pos:
+            ax.plot([p[0], p[0]], [p[1], p[1]], [p[2] - h / 2, p[2] + h / 2],
+                    color="saddlebrown", lw=2, alpha=0.6)
+
+
+def render_frames(
+    logs: dict,
+    params,
+    payload_vertices,
+    out_dir: str,
+    forest=None,
+    stride: int = 25,
+    follow: bool = True,
+):
+    """Replay a rollout log as PNG frames (the reference's meshcat replay with
+    follow camera, rqp_plots.py:44-109; camera smoothing via a simple windowed
+    mean instead of savgol). Returns the frame paths."""
+    plt = _mpl()
+    os.makedirs(out_dir, exist_ok=True)
+    xl_seq = np.asarray(logs["state_seq"]["xl"])
+    Rl_seq = np.asarray(logs["state_seq"]["Rl"])
+    R_seq = np.asarray(logs["state_seq"]["R"])
+
+    # Smoothed follow-camera track.
+    k = 25
+    pad = np.pad(xl_seq, ((k, k), (0, 0)), mode="edge")
+    smooth = np.stack([
+        pad[i : i + 2 * k + 1].mean(axis=0) for i in range(len(xl_seq))
+    ])
+
+    class _S:
+        pass
+
+    paths = []
+    for fi, t in enumerate(range(0, len(xl_seq), stride)):
+        fig = plt.figure(figsize=(5, 4), dpi=120)
+        ax = fig.add_subplot(projection="3d")
+        s = _S()
+        s.xl, s.Rl, s.R = xl_seq[t], Rl_seq[t], R_seq[t]
+        draw_snapshot(ax, params, payload_vertices, s, forest)
+        c = smooth[t] if follow else xl_seq[0]
+        ax.set_xlim(c[0] - 4, c[0] + 4)
+        ax.set_ylim(c[1] - 4, c[1] + 4)
+        ax.set_zlim(max(0, c[2] - 3), c[2] + 3)
+        ax.set_xlabel("x")
+        ax.set_ylabel("y")
+        path = os.path.join(out_dir, f"frame_{fi:04d}.png")
+        fig.savefig(path)
+        plt.close(fig)
+        paths.append(path)
+    return paths
+
+
+def render_ghost_snapshot(
+    logs: dict, params, payload_vertices, path: str, times: list[int],
+    forest=None,
+):
+    """Multi-ghost single figure (reference ``_snapshot``, rqp_plots.py:112-147):
+    overlay the system at several log indices with increasing opacity."""
+    plt = _mpl()
+    fig = plt.figure(figsize=(6, 4.5), dpi=150)
+    ax = fig.add_subplot(projection="3d")
+    xl_seq = np.asarray(logs["state_seq"]["xl"])
+    Rl_seq = np.asarray(logs["state_seq"]["Rl"])
+    R_seq = np.asarray(logs["state_seq"]["R"])
+
+    class _S:
+        pass
+
+    for k, t in enumerate(times):
+        s = _S()
+        s.xl, s.Rl, s.R = xl_seq[t], Rl_seq[t], R_seq[t]
+        alpha = 0.3 + 0.7 * (k + 1) / len(times)
+        draw_snapshot(ax, params, payload_vertices, s, forest, alpha=alpha)
+    ax.plot(*xl_seq[: max(times) + 1].T, color="tab:blue", lw=0.8, ls="--")
+    lo = xl_seq[times].min(axis=0) - 3
+    hi = xl_seq[times].max(axis=0) + 3
+    ax.set_xlim(lo[0], hi[0])
+    ax.set_ylim(lo[1], hi[1])
+    ax.set_zlim(max(0, lo[2]), hi[2])
+    fig.savefig(path)
+    plt.close(fig)
+
+
+class MeshcatBackend:
+    """Live three.js viewer path, used only when meshcat is installed (the
+    reference's default backend). Mirrors ``RQPVisualizer``'s scene graph:
+    payload hull mesh, per-quad bodies, forest cylinders."""
+
+    def __init__(self):
+        import meshcat  # noqa: F401 — optional dependency.
+
+        self.vis = meshcat.Visualizer()
+
+    def open(self):
+        self.vis.open()
+        return self
+
+    def visualize_env(self, forest):
+        import meshcat.geometry as gm
+        import meshcat.transformations as tf
+
+        num = int(forest.num_trees)
+        for i, p in enumerate(np.asarray(forest.tree_pos[:num])):
+            self.vis[f"bark_{i}"].set_object(
+                gm.Cylinder(height=forest.bark_height, radius=forest.bark_radius)
+            )
+            T = tf.translation_matrix(p)
+            # meshcat cylinders are y-up; rotate to z-up.
+            T[:3, :3] = np.array([[1, 0, 0], [0, 0, -1], [0, 1, 0]], float).T
+            self.vis[f"bark_{i}"].set_transform(T)
+
+    def update(self, params, state, prefix: str = ""):
+        import meshcat.geometry as gm
+        import meshcat.transformations as tf
+
+        xl = np.asarray(state.xl)
+        Rl = np.asarray(state.Rl)
+        T = tf.translation_matrix(xl)
+        T[:3, :3] = Rl
+        self.vis[prefix + "payload"].set_transform(T)
+        r = np.asarray(params.r)
+        R = np.asarray(state.R)
+        if not hasattr(self, "_objs"):
+            self._objs = set()
+        for i in range(r.shape[0]):
+            Ti = tf.translation_matrix(xl + Rl @ r[i])
+            Ti[:3, :3] = R[i]
+            name = prefix + f"quad_{i}"
+            if name not in self._objs:
+                self.vis[name].set_object(gm.Sphere(0.08))
+                self._objs.add(name)
+            self.vis[name].set_transform(Ti)
