@@ -59,6 +59,33 @@ def test_scenario_sharding_placement():
     assert len(out.sharding.device_set) == 8
 
 
+def test_swarm_payloads_sharded_cadmm():
+    """Swarm config (BASELINE config 5 at test scale): independent payload teams
+    sharded over the mesh, each running a full C-ADMM consensus step (vmap of
+    the distributed controller over the payload axis)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n, n_payloads = 4, 8
+    params, col, state0, cfg, f_eq = _setup(n)
+    m = mesh_mod.make_mesh({"scenario": 8})
+    sharding = NamedSharding(m, P("scenario"))
+
+    xs = jnp.asarray(
+        np.random.default_rng(1).normal(size=(n_payloads, 3)), jnp.float32
+    )
+    states = jax.vmap(lambda x: state0.replace(xl=x))(xs)
+    astates = jax.vmap(lambda _: cadmm.init_cadmm_state(params, cfg))(
+        jnp.arange(n_payloads)
+    )
+    states = jax.device_put(states, sharding)
+    acc = (jnp.array([0.2, 0.0, 0.0]), jnp.zeros(3))
+    f, astates2, stats = jax.jit(
+        jax.vmap(lambda a, s: cadmm.control(params, cfg, f_eq, a, s, acc))
+    )(astates, states)
+    assert f.shape == (n_payloads, n, 3)
+    assert bool(jnp.all(jnp.isfinite(f)))
+
+
 def test_scenario_parallel_rollout_smoke():
     """Batch of scenarios through a tiny jitted physics rollout, sharded."""
     from tpu_aerial_transport.models import rqp

@@ -1,0 +1,70 @@
+"""Checkpoint / resume for rollouts and solver state.
+
+The reference's persistence story is trajectory-level only: the finished run is
+pickled (example/rqp_example.py:141-165) and later replayed, with the forest
+reconstructed from logged tree positions (rqp_plots.py:503-505); there is no
+mid-run resume (SURVEY.md §5.4). Here both levels exist:
+
+- :func:`save_run` / :func:`load_run` — the reference's artifact: the log dict
+  (npz) including tree positions, so plotting/replay tools work unchanged.
+- :func:`save_state` / :func:`load_state` — mid-run resume: any pytree
+  (``(RQPState, CtrlState/CADMMState/DDState)`` scan carry included) via orbax,
+  so a 100 s rollout can be split into segments or recovered after preemption.
+  Forest regeneration stays deterministic through ``make_forest(seed)``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+
+def save_run(path: str, log_dict: dict) -> None:
+    """Persist a rollout log dict (from ``rollout.logs_to_dict``) as npz."""
+    flat = {}
+    for k, v in log_dict.items():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                flat[f"{k}.{k2}"] = np.asarray(v2)
+        else:
+            flat[k] = np.asarray(v)
+    np.savez_compressed(path, **flat)
+
+
+def load_run(path: str) -> dict:
+    """Inverse of :func:`save_run`; nested keys are restored."""
+    raw = np.load(path, allow_pickle=False)
+    out: dict = {}
+    for k in raw.files:
+        v = raw[k]
+        if v.ndim == 0:
+            v = v.item()
+        if "." in k:
+            outer, inner = k.split(".", 1)
+            out.setdefault(outer, {})[inner] = v
+        else:
+            out[k] = v
+    return out
+
+
+def save_state(path: str, state) -> None:
+    """Checkpoint an arbitrary pytree (scan carry, solver state) with orbax."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, state, force=True)
+
+
+def load_state(path: str, template):
+    """Restore a pytree checkpoint; ``template`` supplies structure/dtypes
+    (pass the same pytree shape you saved, e.g. a freshly-initialized state)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(path, item=template)
+    return jax.tree.map(lambda t, r: jax.numpy.asarray(r, t.dtype)
+                        if hasattr(t, "dtype") else r, template, restored)

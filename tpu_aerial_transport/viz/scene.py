@@ -76,6 +76,26 @@ def draw_snapshot(ax, params, payload_vertices, state, forest=None, alpha=1.0):
                     color="saddlebrown", lw=2, alpha=0.6)
 
 
+def draw_pmrl_snapshot(ax, params, payload_vertices, state, alpha=1.0):
+    """PMRL scene: payload hull + rigid links (cylinders in the reference,
+    ``PMRLVisualizer``, point_mass_rigid_link.py:257-397) + point-mass robots at
+    ``xl + Rl r_i + L_i q_i``."""
+    xl = np.asarray(state.xl)
+    Rl = np.asarray(state.Rl)
+    r = np.asarray(params.r)
+    L = np.asarray(params.L)
+    q = np.asarray(state.q)
+
+    draw_snapshot(ax, params, payload_vertices,
+                  type("S", (), {"xl": xl, "Rl": Rl, "R": None})(), alpha=alpha)
+    attach = xl + r @ Rl.T
+    robots = attach + q * L[:, None]
+    ax.scatter(*robots.T, color="tab:red", s=20 * alpha, alpha=alpha)
+    for i in range(r.shape[0]):
+        seg = np.stack([attach[i], robots[i]])
+        ax.plot(*seg.T, color="gray", lw=1.2, alpha=alpha)
+
+
 def render_frames(
     logs: dict,
     params,
