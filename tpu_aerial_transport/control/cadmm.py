@@ -67,8 +67,13 @@ class RQPCADMMConfig:
     k_dwl: float
     rho0: float
     res_tol: float
+    # Dynamic leader index (reference static index 0, rqp_cadmm.py:556-558,
+    # with runtime set_leader/unset_leader hooks :503-507). A pytree LEAF, not
+    # a static field, so a leader change mid-rollout (via :func:`set_leader`)
+    # re-uses the compiled step; -1 means no leader (no agent carries the
+    # tracking cost).
+    leader_idx: int = 0
     # Static fields.
-    leader_idx: int = struct.field(pytree_node=False, default=0)
     n_env_cbfs: int = struct.field(pytree_node=False, default=10)
     max_iter: int = struct.field(pytree_node=False, default=100)
     inner_iters: int = struct.field(pytree_node=False, default=60)
@@ -114,6 +119,45 @@ def make_config(
         max_iter=max_iter,
         inner_iters=inner_iters,
     )
+
+
+def set_leader(cfg, leader_idx):
+    """Runtime leader change (reference ``set_leader``, rqp_cadmm.py:503-505 /
+    rqp_dd.py:507-509): agent ``leader_idx`` alone carries the tracking cost.
+    ``leader_idx`` is a dynamic pytree leaf, so the returned config re-uses any
+    compiled control step — usable mid-rollout (even traced, via
+    ``cfg.replace(leader_idx=...)`` inside a scan). Works on both
+    :class:`RQPCADMMConfig` and the DD config (pass ``cfg.base``-level
+    replace for that, or use the same helper on the wrapper)."""
+    if hasattr(cfg, "base"):  # RQPDDConfig wraps the shared base config.
+        return cfg.replace(base=cfg.base.replace(leader_idx=leader_idx))
+    return cfg.replace(leader_idx=leader_idx)
+
+
+def unset_leader(cfg):
+    """No agent carries the tracking cost (reference ``unset_leader``,
+    rqp_cadmm.py:506-507): the team holds formation/equilibrium only."""
+    return set_leader(cfg, -1)
+
+
+def set_tolerance(cfg, res_tol: float):
+    """Runtime consensus-tolerance setter (reference ``set_tolerance``,
+    rqp_cadmm.py:677-682 / rqp_dd.py:754-759). Dynamic leaf — no recompile."""
+    if hasattr(cfg, "base"):
+        return cfg.replace(
+            base=cfg.base.replace(res_tol=res_tol), prim_inf_tol=res_tol
+        )
+    return cfg.replace(res_tol=res_tol)
+
+
+def set_max_iter(cfg, max_iter: int):
+    """Runtime iteration-cap setter (reference ``set_max_iterations``,
+    rqp_cadmm.py:683-688 / rqp_dd.py:760-764). ``max_iter`` sizes the fixed
+    ``err_seq`` buffer, so it is a STATIC field: changing it recompiles the
+    step (the reference equivalent re-allocates its Python-side buffers)."""
+    if hasattr(cfg, "base"):
+        return cfg.replace(base=cfg.base.replace(max_iter=max_iter))
+    return cfg.replace(max_iter=max_iter)
 
 
 @struct.dataclass
@@ -397,13 +441,13 @@ def control(
     rho_vec = jax.vmap(
         lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
     )(lb, ub)
-    chol = socp.kkt_cholesky(P, A, rho_vec)
+    op = socp.kkt_operator(P, A, rho_vec)
 
     solve_one = jax.vmap(
-        lambda P_, q_, A_, lb_, ub_, shift_, chol_, warm_: socp.solve_socp(
+        lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_: socp.solve_socp(
             P_, q_, A_, lb_, ub_,
             n_box=n_box, soc_dims=(4, 4), iters=cfg.inner_iters,
-            warm=warm_, shift=shift_, chol=chol_,
+            warm=warm_, shift=shift_, op=op_,
         )
     )
 
@@ -412,7 +456,7 @@ def control(
         # Primal: augmented linear term <lam_i, f> - rho <f_mean, f>.
         q_extra = (lam - rho * f_mean[None, :, :]).reshape(n_local, 3 * n)
         q = q0.at[:, 9:].add(q_extra)
-        sols = solve_one(P, q, A, lb, ub, shift, chol, warm)
+        sols = solve_one(P, q, A, lb, ub, shift, op, warm)
         f_new = sols.x[:, 9:].reshape(n_local, n, 3)
         # Failed agents fall back to equilibrium forces (reference :491-494).
         ok = (sols.prim_res < cfg.solver_tol)[:, None, None] & jnp.all(
@@ -434,7 +478,13 @@ def control(
         res_new = _max_over_agents(jnp.abs(f_new - f_mean_new[None, :, :]))
         err_buf = err_buf.at[it].set(res_new)
         it = it + 1
-        # Dual update (skipped after the final iteration by the while cond).
+        # Dual update. Deliberate deviation from the reference: the reference
+        # breaks out of its loop *before* updating lambda on the converged
+        # iteration (:661-665); here the update runs unconditionally, so the
+        # warm-started duals for the NEXT control step include one extra
+        # rho*(f - f_mean) term, bounded by rho*res_tol — it only perturbs warm
+        # starts, never the applied forces (and err_seq gains the final
+        # converged residual the reference omits).
         lam_new = lam + rho * (f_new - f_mean_new[None, :, :])
         return f_new, lam_new, f_mean_new, sols, it, res_new, err_buf
 

@@ -26,7 +26,10 @@ import jax.numpy as jnp
 from flax import struct
 from jax import lax
 
-from tpu_aerial_transport.control.cadmm import RQPCADMMConfig, agent_env_cbfs
+from tpu_aerial_transport.control.cadmm import (
+    RQPCADMMConfig,
+    agent_env_cbfs_for,
+)
 from tpu_aerial_transport.control.centralized import equilibrium_forces
 from tpu_aerial_transport.control.types import EnvCBF, SolverStats
 from tpu_aerial_transport.envs import forest as forest_mod
@@ -301,35 +304,82 @@ def control(
     state: RQPState,
     acc_des,
     forest: forest_mod.Forest | None = None,
+    axis_name: str | None = None,
 ):
-    """One DD control step: ``-> (f (n, 3), DDState, SolverStats)`` (reference
-    ``RQPDDController.control``, :695-752)."""
+    """One DD control step: ``-> (f (n_local, 3), DDState, SolverStats)``
+    (reference ``RQPDDController.control``, :695-752).
+
+    With ``axis_name=None`` all n agents run in one program (vmap; single
+    chip). Inside ``shard_map`` over a mesh axis named ``axis_name``, each
+    shard holds a block of agents (the leading axis of every ``DDState``
+    leaf); the price sums and consensus-violation sums become ``lax.psum``
+    collectives, and the 6n-dim quasi-Newton dual step is **replicated** on
+    every shard after a ``lax.all_gather`` of the per-agent violation blocks
+    (the dual gradient ``Ac @ prim`` *is* the stacked per-agent consensus
+    violations ``[err_F_i; err_M_i]``, so it never needs the full 9n primal) —
+    exactly the collective realization SURVEY.md §5.8 prescribes for the
+    reference's price all-gather (rqp_dd.py:716-722) + centralized QN solve
+    (:678-693). ``state``/``acc_des``/``f_eq`` are replicated; ``f_eq`` is
+    always the full (n, 3) table."""
     n = params.n
     base = cfg.base
     dtype = state.xl.dtype
 
-    env_cbfs = agent_env_cbfs(params, base, forest, state)
-    leaders = jnp.zeros((n,), dtype).at[base.leader_idx].set(1.0)
+    n_local = dd_state.f.shape[0]
+    if axis_name is None:
+        agent_ids = jnp.arange(n_local)
+    else:
+        agent_ids = lax.axis_index(axis_name) * n_local + jnp.arange(n_local)
+
+    def _sum_over_agents(x):
+        s = jnp.sum(x, axis=0)
+        return s if axis_name is None else lax.psum(s, axis_name)
+
+    def _max_over_agents(x):
+        s = jnp.max(x)
+        return s if axis_name is None else lax.pmax(s, axis_name)
+
+    def _min_over_agents(x):
+        s = jnp.min(x)
+        return s if axis_name is None else lax.pmin(s, axis_name)
+
+    def _gather_blocks(x):
+        """(n_local, d) local blocks -> (n, d) full table, shard-ordered."""
+        if axis_name is None:
+            return x
+        return lax.all_gather(x, axis_name).reshape(n, x.shape[-1])
+
+    r_local = jnp.take(params.r, agent_ids, axis=0)
+    r_com_local = jnp.take(params.r_com, agent_ids, axis=0)
+    f_eq_local = jnp.take(f_eq, agent_ids, axis=0)
+
+    env_cbfs = agent_env_cbfs_for(params, base, forest, state, r_local)
+    # Equality test (not .at[idx]) so leader_idx = -1 (unset_leader) yields no
+    # leader rather than wrapping to the last agent.
+    leaders_full = (jnp.arange(n) == base.leader_idx).astype(dtype)
+    leaders = (agent_ids == base.leader_idx).astype(dtype)
 
     P, q0, A, lb, ub, shift = jax.vmap(
         lambda fi_eq, r_i, ld, cbf: _build_agent_qp(
             params, base, fi_eq, r_i, state, acc_des, cbf, ld
         )
-    )(f_eq, params.r_com, leaders, env_cbfs)
+    )(f_eq_local, r_com_local, leaders, env_cbfs)
 
     n_box = 13 + base.n_env_cbfs
     m = n_box + 8
     rho_vec = jax.vmap(
         lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
     )(lb, ub)
-    chol = socp.kkt_cholesky(P, A, rho_vec)
+    op = socp.kkt_operator(P, A, rho_vec)
 
     # Quasi-Newton preparation, once per control step (reference :634-657).
+    # Replicated on every shard: it needs only the (replicated) params/state,
+    # and the resulting 6n x 6n inverse is tiny.
     Q = jax.vmap(
         lambda r_i, ld: strong_convexity_matrix(
             params, base, state, r_i, ld, cfg.sc_eps
         )
-    )(params.r_com, leaders)
+    )(params.r_com, leaders_full)
     Q_inv = jnp.linalg.inv(Q)
     Q_inv = 0.5 * (Q_inv + jnp.swapaxes(Q_inv, -1, -2))
     Ac = _consensus_matrix(params, state)  # (6n, 9n)
@@ -337,64 +387,76 @@ def control(
     Ac_blocks = Ac.reshape(6 * n, n, 9)
     AQinv = jnp.einsum("mnj,njk->mnk", Ac_blocks, Q_inv).reshape(6 * n, 9 * n)
     qn_mat = AQinv @ Ac.T + cfg.beta * jnp.eye(6 * n, dtype=dtype)
-    qn_chol = jnp.linalg.cholesky(qn_mat)
+    # Explicit inverse: the QN solve runs once per dual iteration inside the
+    # while_loop; a precomputed inverse keeps it a single matmul (MXU) instead
+    # of two serial triangular solves (see ops/socp.py design note).
+    qn_inv = jnp.linalg.inv(qn_mat)
+    qn_inv = 0.5 * (qn_inv + qn_inv.T)
 
-    G = jax.vmap(lambda r: lie.hat(r) @ state.Rl.T)(params.r_com)
+    G_local = jax.vmap(lambda r: lie.hat(r) @ state.Rl.T)(r_com_local)
 
     solve_one = jax.vmap(
-        lambda P_, q_, A_, lb_, ub_, shift_, chol_, warm_: socp.solve_socp(
+        lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_: socp.solve_socp(
             P_, q_, A_, lb_, ub_,
             n_box=n_box, soc_dims=(4, 4), iters=base.inner_iters,
-            warm=warm_, shift=shift_, chol=chol_,
+            warm=warm_, shift=shift_, op=op_,
         )
     )
 
     # Solver-failure fallbacks (reference :486-489): equilibrium forces and the
     # aggregates they imply.
-    fallback_F = jnp.sum(f_eq, axis=0)[None, :] - f_eq
-    fallback_M = -jnp.einsum("ij,njk,nk->ni", params.JT_inv, G, f_eq)
+    fallback_F = jnp.sum(f_eq, axis=0)[None, :] - f_eq_local
+    fallback_M = -jnp.einsum("ij,njk,nk->ni", params.JT_inv, G_local, f_eq_local)
 
     def dd_iter(carry):
         f, F, M, lam_F, lam_M, warm, it, err, err_buf = carry
-        # Price assembly (the all-gather, reference :716-722).
-        sum_lF = jnp.sum(lam_F, axis=0)
-        sum_lM = jnp.sum(lam_M, axis=0)
+        # Price assembly (the all-gather, reference :716-722) — two psum
+        # reductions over the agent axis.
+        sum_lF = _sum_over_agents(lam_F)
+        sum_lM = _sum_over_agents(lam_M)
         c_F = lam_F
         c_M = lam_M
         c_f = -(sum_lF[None, :] - lam_F) + jnp.einsum(
             "nij,nj->ni",
-            jax.vmap(lambda r: state.Rl @ lie.hat(r))(params.r_com),
+            jax.vmap(lambda r: state.Rl @ lie.hat(r))(r_com_local),
             sum_lM[None, :] - lam_M,
         )
         q = q0.at[:, 9:12].add(c_f).at[:, 12:15].add(c_F).at[:, 15:18].add(c_M)
-        sols = solve_one(P, q, A, lb, ub, shift, chol, warm)
+        sols = solve_one(P, q, A, lb, ub, shift, op, warm)
         x = sols.x
         ok = (sols.prim_res < base.solver_tol) & jnp.all(
             jnp.isfinite(x), axis=-1
         )
         okc = ok[:, None]
-        f_new = jnp.where(okc, x[:, 9:12], f_eq)
+        f_new = jnp.where(okc, x[:, 9:12], f_eq_local)
         F_new = jnp.where(okc, x[:, 12:15], fallback_F)
         M_new = jnp.where(okc, x[:, 15:18], fallback_M)
         warm_new = jax.tree.map(
             lambda new, old: jnp.where(
-                ok.reshape((n,) + (1,) * (new.ndim - 1)), new, old
+                ok.reshape((n_local,) + (1,) * (new.ndim - 1)), new, old
             ),
             sols, warm,
         )
         # Primal infeasibility (the all-reduce, reference :659-676).
-        moments = jnp.einsum("nij,nj->ni", G, f_new)
-        err_F = F_new - (jnp.sum(f_new, axis=0)[None, :] - f_new)
-        err_M = M_new - (jnp.sum(moments, axis=0)[None, :] - moments)
-        err_new = jnp.maximum(jnp.max(jnp.abs(err_F)), jnp.max(jnp.abs(err_M)))
+        moments = jnp.einsum("nij,nj->ni", G_local, f_new)
+        sum_f = _sum_over_agents(f_new)
+        sum_m = _sum_over_agents(moments)
+        err_F = F_new - (sum_f[None, :] - f_new)
+        err_M = M_new - (sum_m[None, :] - moments)
+        err_new = _max_over_agents(
+            jnp.maximum(jnp.max(jnp.abs(err_F)), jnp.max(jnp.abs(err_M)))
+        )
         err_buf = err_buf.at[it].set(err_new)
         it = it + 1
-        # Quasi-Newton dual ascent (reference :678-693).
-        prim = jnp.concatenate([f_new, F_new, M_new], axis=1).reshape(-1)  # (9n,)
-        dual_grad = Ac @ prim
-        t = jax.scipy.linalg.solve_triangular(qn_chol, dual_grad, lower=True)
-        step = jax.scipy.linalg.solve_triangular(qn_chol.T, t, lower=False)
-        step = step.reshape(n, 6)
+        # Quasi-Newton dual ascent (reference :678-693). The dual gradient
+        # ``Ac @ prim`` equals the stacked per-agent consensus violations
+        # [err_F_i; err_M_i], so each shard contributes its local blocks
+        # (all_gather) and the tiny 6n-dim solve replicates on every shard.
+        dual_grad = _gather_blocks(
+            jnp.concatenate([err_F, err_M], axis=1)
+        ).reshape(-1)
+        step = (qn_inv @ dual_grad).reshape(n, 6)
+        step = jnp.take(step, agent_ids, axis=0)
         lam_F_new = lam_F + step[:, :3]
         lam_M_new = lam_M + step[:, 3:]
         return (f_new, F_new, M_new, lam_F_new, lam_M_new, warm_new, it,
@@ -415,11 +477,12 @@ def control(
     )
 
     new_state = DDState(f=f, F=F, M=M, lam_F=lam_F, lam_M=lam_M, warm=warm)
+    collision = _max_over_agents(env_cbfs.collision.astype(jnp.int32)) > 0
     stats = SolverStats(
         iters=iters,
         solve_res=err,
-        collision=jnp.any(env_cbfs.collision),
-        min_env_dist=jnp.min(env_cbfs.min_dist),
+        collision=collision,
+        min_env_dist=_min_over_agents(env_cbfs.min_dist),
         err_seq=err_buf,
     )
     return f, new_state, stats
