@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpu_aerial_transport.control import cadmm, centralized
+from tpu_aerial_transport.control import cadmm, centralized, dd
 from tpu_aerial_transport.harness import setup
 from tpu_aerial_transport.parallel import mesh as mesh_mod
 
@@ -49,6 +49,34 @@ def test_sharded_cadmm_matches_single_program(n, n_shards):
     assert astate_sh.f.shape == (n, n, 3)
     # Second step consumes the sharded state (round-trip).
     f2, _, _ = step(astate_sh, state, acc_des)
+    assert np.all(np.isfinite(np.asarray(f2)))
+
+
+@pytest.mark.parametrize("n,n_shards", [(4, 4), (8, 8), (8, 2)])
+def test_sharded_dd_matches_single_program(n, n_shards):
+    """Agent-sharded DD (psum price sums + all_gather'd replicated QN dual
+    step) == vmap-only path (mirror of the C-ADMM test above)."""
+    params, col, state, _, f_eq = _setup(n)
+    cfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=40, inner_iters=60, prim_inf_tol=1e-3,
+    )
+    state = state.replace(vl=jnp.array([0.2, 0.1, 0.0], jnp.float32))
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+
+    ds = dd.init_dd_state(params, cfg)
+    f_ref, _, stats_ref = dd.control(params, cfg, f_eq, ds, state, acc_des)
+
+    m = mesh_mod.make_mesh({"agent": n_shards})
+    step = mesh_mod.dd_control_sharded(params, cfg, f_eq, m)
+    f_sh, ds_sh, stats_sh = step(ds, state, acc_des)
+
+    assert f_sh.shape == (n, 3)
+    assert np.abs(np.asarray(f_sh) - np.asarray(f_ref)).max() < 5e-3
+    assert abs(int(stats_sh.iters) - int(stats_ref.iters)) <= 1
+    assert ds_sh.f.shape == (n, 3) and ds_sh.lam_M.shape == (n, 3)
+    # Second step consumes the sharded state (round-trip).
+    f2, _, _ = step(ds_sh, state, acc_des)
     assert np.all(np.isfinite(np.asarray(f2)))
 
 

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_aerial_transport.control import cadmm
+from tpu_aerial_transport.control import cadmm, dd
 from tpu_aerial_transport.envs import forest as forest_mod
 from tpu_aerial_transport.models.rqp import RQPParams, RQPState
 
@@ -87,6 +87,47 @@ def cadmm_control_sharded(
     return step
 
 
+def dd_control_sharded(
+    params: RQPParams,
+    cfg: dd.RQPDDConfig,
+    f_eq: jnp.ndarray,
+    mesh: Mesh,
+    forest: forest_mod.Forest | None = None,
+    axis: str = "agent",
+) -> Callable:
+    """Agent-sharded dual-decomposition control step (the C-ADMM twin above).
+
+    Returns ``step(dd_state, state, acc_des) -> (f, dd_state, stats)`` with
+    every leading-``n`` leaf of ``dd_state`` and the returned ``f`` sharded
+    over ``axis``; ``state``/``acc_des``/``f_eq`` replicated. Price sums and
+    consensus-violation sums run as ``psum`` and the 6n-dim quasi-Newton dual
+    step replicates per shard after an ``all_gather`` (see
+    ``control.dd.control``). Requires ``n % mesh.shape[axis] == 0``."""
+    n = params.n
+    n_shards = mesh.shape[axis]
+    assert n % n_shards == 0, (n, n_shards)
+
+    state_spec = dd.DDState(
+        f=P(axis), F=P(axis), M=P(axis), lam_F=P(axis), lam_M=P(axis),
+        warm=jax.tree.map(lambda _: P(axis), _warm_structure()),
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(state_spec, P(), (P(), P())),
+        out_specs=(P(axis), state_spec, P()),
+        check_vma=False,
+    )
+    def step(dd_state, state, acc_des):
+        return dd.control(
+            params, cfg, f_eq, dd_state, state, acc_des, forest,
+            axis_name=axis,
+        )
+
+    return step
+
+
 def _warm_structure():
     """PartitionSpec skeleton matching SOCPSolution's 5 leaves."""
     from tpu_aerial_transport.ops.socp import SOCPSolution
@@ -109,10 +150,11 @@ def scenario_rollout(rollout_fn: Callable, mesh: Mesh, axis: str = "scenario"):
     """Wrap a single-scenario rollout into a sharded Monte-Carlo batch rollout:
     ``vmap`` over the leading scenario axis, jit with shardings so XLA keeps each
     scenario on its device (BASELINE.json config "256 scenarios x 8 agents")."""
-    batched = jax.vmap(rollout_fn)
+    batched_jit = jax.jit(jax.vmap(rollout_fn))  # jit once: repeated runs hit
+    # the compile cache (a fresh wrapper per call would retrace every time).
 
     def run(batch_args):
         batch_args = shard_scenarios(mesh, batch_args, axis)
-        return jax.jit(batched)(*batch_args)
+        return batched_jit(*batch_args)
 
     return run
