@@ -115,3 +115,70 @@ def test_cadmm_jit_compiles_under_scan():
         lambda c: jax.lax.scan(body, c, None, length=5)
     )((astate, state0))
     assert bool(jnp.all(jnp.isfinite(fs)))
+
+
+def test_leader_hooks_and_setters():
+    """Runtime set_leader/unset_leader/set_tolerance (reference
+    rqp_cadmm.py:503-507, 677-688): leader changes re-use the compiled step
+    (dynamic pytree leaf), unset_leader drops the tracking cost everywhere."""
+    n = 3
+    params, col, _, ccfg, acfg, f_eq = _setup(n)
+    state = _random_state(jax.random.PRNGKey(3), n)
+    acc_des = (jnp.array([0.5, 0.0, 0.0]), jnp.zeros(3))
+
+    step = jax.jit(
+        lambda cfg, a, s: cadmm.control(params, cfg, f_eq, a, s, acc_des)
+    )
+    a0 = cadmm.init_cadmm_state(params, acfg)
+    f0, _, _ = step(acfg, a0, state)
+
+    # Same compiled step, different leader — no retrace (leader_idx is a leaf).
+    n_traces = step._cache_size()
+    f1, _, _ = step(cadmm.set_leader(acfg, 1), a0, state)
+    assert step._cache_size() == n_traces, "leader change retraced the step"
+    assert not bool(jnp.allclose(f0, f1, atol=1e-4)), \
+        "leader change did not alter the solution"
+
+    # unset_leader: no tracking cost -> forces stay near equilibrium.
+    f_un, _, _ = step(cadmm.unset_leader(acfg), a0, state)
+    assert float(jnp.abs(f_un - f_eq).max()) < float(jnp.abs(f0 - f_eq).max())
+
+    # set_tolerance loosens the stop -> no more iterations than the tight run.
+    _, _, st_tight = step(acfg, a0, state)
+    _, _, st_loose = step(cadmm.set_tolerance(acfg, 1e-1), a0, state)
+    assert int(st_loose.iters) <= int(st_tight.iters)
+
+    # set_max_iter caps the consensus loop (static: fresh compile is expected).
+    _, _, st_cap = step(cadmm.set_max_iter(acfg, 2), a0, state)
+    assert int(st_cap.iters) <= 3
+
+
+def test_leader_change_mid_rollout():
+    """Leader handoff inside a jitted scan: switch the tracking-cost carrier at
+    the halfway step; the rollout stays finite and the consensus keeps
+    converging (VERDICT round-2 item 7)."""
+    n = 3
+    params, col, state0, ccfg, acfg, f_eq = _setup(n)
+    acc_des = (jnp.array([0.3, 0.0, 0.0]), jnp.zeros(3))
+    n_steps = 6
+
+    def body(carry, i):
+        astate, state = carry
+        cfg_i = cadmm.set_leader(
+            acfg, jnp.where(i < n_steps // 2, 0, 2)
+        )
+        f, astate, stats = cadmm.control(
+            params, cfg_i, f_eq, astate, state, acc_des
+        )
+        fz = jnp.sum(f * state.R[..., :, 2], axis=-1)
+        state = rqp.integrate(params, state, (fz, jnp.zeros((n, 3))), 1e-3)
+        return (astate, state), (stats.iters, stats.solve_res)
+
+    a0 = cadmm.init_cadmm_state(params, acfg)
+    (a_fin, s_fin), (iters, res) = jax.jit(
+        lambda c, i: jax.lax.scan(body, c, i)
+    )((a0, state0), jnp.arange(n_steps))
+    assert bool(jnp.all(jnp.isfinite(s_fin.xl)))
+    # Consensus converged on both sides of the handoff.
+    assert int(iters.max()) <= acfg.max_iter
+    assert float(res[-1]) < 1e-2
