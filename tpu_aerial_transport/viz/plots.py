@@ -66,20 +66,182 @@ def plot_solver_stats(logs: dict, path: str, dist_eps: float = 0.1):
     plt.close(fig)
 
 
-def plot_xy_trajectory(logs: dict, path: str, bark_radius: float = 0.3):
+# Paper-figure palette (reference rqp_plots.py:36-41).
+_GRASS_COLOR = "#70AB94"
+_BARK_COLOR = "#694B37"
+_MESH_COLOR = "#FF22DD"
+_QUADROTOR_COLOR = "#1590A0"
+_PAYLOAD_COLOR = "#D70E36"
+_VISIONCONE_COLOR = "#A8AEAC"
+_SAVE_DPI = 600  # reference uses >= 600 for the paper PNGs (:32).
+
+# Key-frame fractions per controller type (reference :245-250).
+_KEY_FRAMES = {
+    "centralized": (0.5,),
+    "dual-decomposition": (0.16, 0.55),
+    "consensus-admm": (0.19, 0.51, 0.72),
+}
+
+
+def _draw_capsule_outline(ax, c1, c2, radius, **kwargs):
+    """2-D stadium outline of the braking capsule (reference ``_draw_capsule``,
+    rqp_plots.py:150-170)."""
+    height = float(np.linalg.norm(c2 - c1))
+    if height < 1e-9:
+        theta = np.linspace(0.0, 2 * np.pi, 100)
+        ax.plot(radius * np.cos(theta) + c1[0],
+                radius * np.sin(theta) + c1[1], **kwargs)
+        return
+    d = (c2 - c1) / height
+    ang = np.arctan2(d[0], -d[1])  # angle of the left-hand orthogonal.
+    theta1 = np.linspace(ang, ang + np.pi, 50)
+    theta2 = np.linspace(ang + np.pi, ang + 2 * np.pi, 50)
+    x = np.concatenate([
+        np.stack([c1[0] + radius * np.cos(theta1),
+                  c1[1] + radius * np.sin(theta1)], axis=1),
+        np.stack([c2[0] + radius * np.cos(theta2),
+                  c2[1] + radius * np.sin(theta2)], axis=1),
+    ])
+    x = np.concatenate([x, x[:1]])
+    ax.plot(x[:, 0], x[:, 1], **kwargs)
+
+
+def plot_xy_trajectory(
+    logs: dict,
+    path: str,
+    bark_radius: float = 0.3,
+    params=None,
+    collision=None,
+    controller_type: str = "consensus-admm",
+    vision_radius: float | None = None,
+    vision_cone_ang: float | None = None,
+    mountain_center=(30.0, 0.0),
+    mountain_radius: float = 25.0,
+    key_frames=None,
+    dpi: int = _SAVE_DPI,
+):
+    """Top-down paper figure (reference ``_plot_xy_trajectory``,
+    rqp_plots.py:173-390): hill outline, tree footprints, dashed payload
+    trajectory, and — at the controller-specific key frames — the payload
+    polygon, per-quad footprints, the braking collision capsule, and the
+    vision region (full disc for the centralized controller, per-agent wedges
+    for the distributed ones).
+
+    The overlays need system geometry: pass ``params`` (RQPParams: attachment
+    points ``r``) and ``collision`` (RQPCollision: quad radius, collision
+    radius, max deceleration). Without them, only trajectory + forest are
+    drawn (the round-1 behavior).
+    """
     plt = _mpl()
-    fig, ax = plt.subplots(figsize=(3.54, 3.54), dpi=200, layout="constrained")
-    xl = np.asarray(logs["state_seq"]["xl"])
-    ax.plot(xl[:, 0], xl[:, 1], "-b", lw=1, label="payload")
+    from matplotlib import patches
+
+    fig, ax = plt.subplots(figsize=(3.54, 2.0), dpi=200, layout="constrained")
+    for side in ("top", "bottom", "left", "right"):
+        ax.spines[side].set_visible(False)
+
+    # Hill outline + forest (reference :206-232).
+    theta = np.linspace(0.0, 2 * np.pi, 100)
+    ax.plot(mountain_radius * np.cos(theta) + mountain_center[0],
+            mountain_radius * np.sin(theta) + mountain_center[1],
+            ls="--", lw=1, color=_GRASS_COLOR)
     if "tree_pos" in logs:
-        for p in np.asarray(logs["tree_pos"]):
-            ax.add_patch(plt.Circle((p[0], p[1]), bark_radius, color="saddlebrown",
-                                    alpha=0.7))
-    ax.set_aspect("equal")
-    ax.set_xlabel("x [m]")
-    ax.set_ylabel("y [m]")
-    ax.legend(loc="upper left")
-    fig.savefig(path)
+        for i, p in enumerate(np.asarray(logs["tree_pos"])):
+            ax.add_patch(patches.Circle(
+                (p[0], p[1]), bark_radius, fc=_BARK_COLOR, ec="black", lw=1.0,
+                label="trees" if i == 0 else None,
+            ))
+
+    # Payload trajectory (reference :233-239).
+    xl = np.asarray(logs["state_seq"]["xl"])
+    ax.plot(xl[:, 0], xl[:, 1], ls="--", lw=1, color="black", label=r"$x_L$")
+
+    # Key-frame overlays (reference :240-358).
+    if params is not None and collision is not None:
+        Rl = np.asarray(logs["state_seq"]["Rl"])
+        vl = np.asarray(logs["state_seq"]["vl"])
+        r = np.asarray(params.r)  # (n, 3) agent-leading layout.
+        frames = key_frames if key_frames is not None else \
+            _KEY_FRAMES.get(controller_type, (0.5,))
+        n_steps = xl.shape[0]
+        for k, frac in enumerate(frames):
+            i = min(int(frac * n_steps), n_steps - 1)
+            first = k == 0
+            xq = xl[i][None, :] + np.einsum("ab,nb->na", Rl[i], r)  # (n, 3)
+            ax.add_patch(patches.Polygon(
+                xq[:, :2], closed=True, fc=_PAYLOAD_COLOR, ec="black", lw=0.5,
+                label="payload" if first else None,
+            ))
+            for j in range(xq.shape[0]):
+                ax.add_patch(patches.Circle(
+                    xq[j, :2], collision.quadrotor_radius,
+                    fc=_QUADROTOR_COLOR, ec="black", lw=0.5, alpha=0.75,
+                    label="quadrotor" if first and j == 0 else None,
+                ))
+            # Braking collision capsule (reference :289-308).
+            c1 = xl[i]
+            c2 = xl[i] + 0.5 * np.linalg.norm(vl[i]) \
+                / collision.max_deceleration * vl[i]
+            _draw_capsule_outline(
+                ax, c1[:2], c2[:2], collision.collision_radius,
+                ls="--", lw=1, color=_MESH_COLOR,
+                label="collision capsule" if first else None,
+            )
+            # Vision regions (reference :309-358).
+            vr = vision_radius if vision_radius is not None \
+                else collision.collision_radius + 5.0
+            if controller_type == "centralized":
+                ax.add_patch(patches.Circle(
+                    c1[:2], vr, fc=_VISIONCONE_COLOR, ec="none", alpha=0.25,
+                    label="vision region" if first else None,
+                ))
+            else:
+                ang = vision_cone_ang if vision_cone_ang is not None \
+                    else 100.0 * np.pi / 180.0
+                for j in range(xq.shape[0]):
+                    d = xq[j, :2] - xl[i, :2]
+                    dir_ang = np.arctan2(d[1], d[0])
+                    ax.add_patch(patches.Wedge(
+                        xq[j, :2], vr,
+                        (dir_ang - ang) * 180 / np.pi,
+                        (dir_ang + ang) * 180 / np.pi,
+                        fc=_VISIONCONE_COLOR, ec="none", alpha=0.25,
+                        label="vision region" if first and j == 0 else None,
+                    ))
+
+    ax.legend(loc="upper right", fontsize=8, framealpha=1.0, ncol=2,
+              fancybox=False, edgecolor="black", labelspacing=0.15)
+    ax.tick_params(axis="both", which="both", bottom=False, top=False,
+                   left=False, right=False, labelbottom=False, labelleft=False)
+    ax.margins(0.05, 0.05)
+    ax.axis("equal")
+    fig.savefig(path, dpi=dpi)
+    plt.close(fig)
+
+
+def plot_min_dist(logs: dict, path: str, dist_eps: float = 0.1,
+                  t_final_frac: float = 0.85, dpi: int = _SAVE_DPI):
+    """Min-obstacle-distance paper figure (reference ``_plot_min_dist``,
+    rqp_plots.py:393-467): log-scale distance vs time with the ``eps_d``
+    safety line, saved at >= 600 dpi."""
+    plt = _mpl()
+    fig, ax = plt.subplots(figsize=(3.54, 2.0), dpi=200, layout="constrained")
+    ax.spines["top"].set_visible(False)
+    ax.spines["right"].set_visible(False)
+    T = logs["T"]
+    d = np.asarray(logs["min_env_dist_seq"])
+    t = np.linspace(0.0, T, len(d))
+    ax.plot(t, d, "-b", lw=1,
+            label=r"$\min_j\ \mathrm{dist}(CC(x_r(t)), \mathcal{O}_j)$")
+    ax.plot(t, dist_eps * np.ones_like(t), "--k", lw=1, label=r"$\epsilon_d$")
+    ax.legend(loc="upper right", fontsize=8, framealpha=0.5, fancybox=False,
+              edgecolor="black", labelspacing=0.15)
+    ax.set_yscale("log")
+    ax.set_xlim([0.0, t_final_frac * T])
+    ax.set_xlabel("time (s)", fontsize=8)
+    ax.set_ylabel("minimum distance (m)", fontsize=8)
+    ax.tick_params(axis="both", which="major", labelsize=8)
+    ax.margins(0.05, 0.05)
+    fig.savefig(path, dpi=dpi)
     plt.close(fig)
 
 
@@ -91,10 +253,15 @@ def plot_convergence_rates(err_seqs: dict[str, np.ndarray], path: str):
     colors = {"C-ADMM": "tab:blue", "DD": "tab:orange"}
     for label, errs in err_seqs.items():
         errs = np.asarray(errs)
-        with np.errstate(all="ignore"):
-            mean = np.nanmean(errs, axis=0)
-            lo = np.nanmin(errs, axis=0)
-            hi = np.nanmax(errs, axis=0)
+        # nanmean/nanmin warn on all-NaN columns (tail iterations no sample
+        # reached); reduce only columns with at least one finite entry.
+        has_data = np.any(~np.isnan(errs), axis=0)
+        mean = np.full(errs.shape[1], np.nan)
+        lo = np.full(errs.shape[1], np.nan)
+        hi = np.full(errs.shape[1], np.nan)
+        mean[has_data] = np.nanmean(errs[:, has_data], axis=0)
+        lo[has_data] = np.nanmin(errs[:, has_data], axis=0)
+        hi[has_data] = np.nanmax(errs[:, has_data], axis=0)
         it = np.arange(1, errs.shape[1] + 1)
         valid = ~np.isnan(mean)
         c = colors.get(label)
