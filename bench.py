@@ -3,11 +3,12 @@
 Headline config from BASELINE.json ("env_forest obstacle field: 256 Monte-Carlo
 scenarios x 8 agents, batched"): each scenario runs a full receding-horizon
 control period — per-agent vision-cone env queries, consensus-ADMM over vmapped
-conic-QP solves, low-level thrust projection, 10 physics substeps at 1 kHz — and
-256 scenarios are batched in one jitted computation (vmap over the scenario
-axis), the exact workload the reference executes one-scenario-at-a-time with
-sequential cvxpy/Clarabel solves (test_rqpcontrollers.py:112-124 runs its 100
-Monte-Carlo re-solves in a Python loop).
+conic-QP solves, low-level SO(3) attitude control at 1 kHz, 10 physics substeps
+— and 256 scenarios are batched in one jitted computation (vmap over the
+scenario axis), the exact workload the reference executes one-scenario-at-a-time
+with sequential cvxpy/Clarabel solves (test_rqpcontrollers.py:112-124 runs its
+100 Monte-Carlo re-solves in a Python loop). The low-level SO(3) law runs inside
+every 1 kHz substep, as the reference's hot loop does (rqp_example.py:120-131).
 
 Baseline: the reference's cvxpy/Clarabel stack is not installed in this image, so
 the recorded baseline is THIS framework executed on the host CPU via XLA — a
@@ -15,11 +16,20 @@ generous stand-in (same fused program; the reference additionally pays cvxpy
 re-canonicalization per solve and runs agents sequentially). ``vs_baseline`` is
 the TPU/CPU throughput ratio at identical batch size.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default mode prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``--sweep`` measures the full BASELINE.json matrix — MPC steps/sec/chip at
+N in {4, 16, 64} agents for centralized / C-ADMM / DD, p50 control-step time
+per consensus iteration, and the 1024-agent swarm config — and writes
+``BENCH_SWEEP.json`` (a markdown table is printed for BASELINE.md).
+
+``--profile <dir>`` wraps the headline timed window in a ``jax.profiler.trace``
+for op-level attribution (SURVEY.md §5.1).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
@@ -30,82 +40,151 @@ import numpy as np
 N_AGENTS = 8
 N_SCENARIOS = 256
 TIMED_STEPS = 10
-CPU_TIMED_STEPS = 2
+CPU_TIMED_STEPS = 4
 
 
-def build():
-    from tpu_aerial_transport.control import cadmm, centralized
+def _setup(n):
+    from tpu_aerial_transport.control import centralized, lowlevel
     from tpu_aerial_transport.envs import forest as forest_mod
     from tpu_aerial_transport.harness import setup
-    from tpu_aerial_transport.models import rqp
 
-    n = N_AGENTS
     params, col, state0 = setup.rqp_setup(n)
     forest = forest_mod.make_forest(seed=0)
-    # Warm starts carry solver state across control steps and consensus
-    # iterations, so 25 inner ADMM iterations hold the consensus residual well
-    # under the 1e-2 N tolerance (see tests/test_cadmm.py).
-    cfg = cadmm.make_config(
-        params, col.collision_radius, col.max_deceleration,
-        max_iter=20, inner_iters=25,
-    )
     f_eq = centralized.equilibrium_forces(params)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
     acc_des = (jnp.array([0.3, 0.0, 0.0], jnp.float32), jnp.zeros(3, jnp.float32))
+    return params, col, state0, forest, f_eq, ll, acc_des
 
-    # Scenario batch: payloads scattered around the forest edge, flying in.
+
+def _substeps(params, ll, state, f_des, n_sub=10, dt=1e-3):
+    """1 kHz low-level control + physics, the reference's inner loop."""
+    from tpu_aerial_transport.models import rqp
+
+    def body(s, _):
+        f, M = ll.control(s, f_des)
+        return rqp.integrate(params, s, (f, M), dt), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_sub)
+    return state
+
+
+def make_mpc_step(controller: str, n: int, max_iter: int = 20,
+                  inner_iters: int = 25):
+    """Build ``(mpc_step(cs, state) -> (cs, state, stats), cs0, state0)`` for one
+    scenario with the given high-level controller."""
+    from tpu_aerial_transport.control import cadmm, centralized, dd
+    from tpu_aerial_transport.envs import forest as forest_mod
+
+    params, col, state0, forest, f_eq, ll, acc_des = _setup(n)
+
+    if controller == "cadmm":
+        cfg = cadmm.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=max_iter, inner_iters=inner_iters,
+        )
+        cs0 = cadmm.init_cadmm_state(params, cfg)
+
+        def mpc_step(cs, state):
+            f_app, cs, stats = cadmm.control(
+                params, cfg, f_eq, cs, state, acc_des, forest
+            )
+            return cs, _substeps(params, ll, state, f_app), stats
+
+    elif controller == "dd":
+        cfg = dd.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=max_iter, inner_iters=inner_iters,
+        )
+        cs0 = dd.init_dd_state(params, cfg)
+
+        def mpc_step(cs, state):
+            f_des, cs, stats = dd.control(
+                params, cfg, f_eq, cs, state, acc_des, forest
+            )
+            return cs, _substeps(params, ll, state, f_des), stats
+
+    elif controller == "centralized":
+        cfg = centralized.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            solver_iters=120,
+        )
+        cs0 = centralized.init_ctrl_state(params, cfg)
+
+        def mpc_step(cs, state):
+            env_cbf = forest_mod.collision_cbf_rows(
+                forest, state.xl, state.vl, col.collision_radius,
+                col.max_deceleration, cfg.vision_radius, cfg.dist_eps,
+                cfg.alpha_env_cbf, cfg.n_env_cbfs,
+            )
+            f_des, cs, stats = centralized.control(
+                params, cfg, f_eq, cs, state, acc_des, env_cbf
+            )
+            return cs, _substeps(params, ll, state, f_des), stats
+
+    else:
+        raise ValueError(controller)
+
+    return mpc_step, cs0, state0
+
+
+def _scenario_batch(state0, n_scenarios):
     xs = jnp.asarray(
-        np.random.default_rng(0).normal(size=(N_SCENARIOS, 3)) * 2.0
+        np.random.default_rng(0).normal(size=(n_scenarios, 3)) * 2.0
         + np.array([5.0, 0.0, 2.0]),
         jnp.float32,
     )
-    states = jax.vmap(
+    return jax.vmap(
         lambda x: state0.replace(xl=x, vl=jnp.array([0.5, 0.0, 0.0], jnp.float32))
     )(xs)
-    astates = jax.vmap(lambda _: cadmm.init_cadmm_state(params, cfg))(
-        jnp.arange(N_SCENARIOS)
-    )
 
-    def mpc_step(astate, state):
-        f_app, astate, _ = cadmm.control(
-            params, cfg, f_eq, astate, state, acc_des, forest
-        )
-        fz = jnp.sum(f_app * state.R[..., :, 2], axis=-1)
-        M = jnp.zeros((n, 3), jnp.float32)
-        for _ in range(10):
-            state = rqp.integrate(params, state, (fz, M), 1e-3)
-        return astate, state
 
-    def rollout(astates, states, n_steps):
+def build(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS):
+    mpc_step, cs0, state0 = make_mpc_step(controller, n)
+    states = _scenario_batch(state0, n_scenarios)
+    css = jax.vmap(lambda _: cs0)(jnp.arange(n_scenarios))
+
+    def rollout(css, states, n_steps):
         def body(carry, _):
-            a, s = carry
-            return jax.vmap(mpc_step)(a, s), None
+            cs, s = carry
+            cs, s, _ = jax.vmap(mpc_step)(cs, s)
+            return (cs, s), None
 
-        (astates, states), _ = jax.lax.scan(
-            body, (astates, states), None, length=n_steps
+        (css, states), _ = jax.lax.scan(
+            body, (css, states), None, length=n_steps
         )
-        return astates, states
+        return css, states
 
-    return jax.jit(rollout, static_argnames="n_steps"), astates, states
+    return jax.jit(rollout, static_argnames="n_steps"), css, states
 
 
-def measure(step, astates, states, device, n_steps):
-    astates = jax.device_put(astates, device)
+def measure(step, css, states, device, n_steps, n_scenarios):
+    css = jax.device_put(css, device)
     states = jax.device_put(states, device)
     # Compile + warmup at the timed length so the timed call hits the cache.
-    out = step(astates, states, n_steps)
+    out = step(css, states, n_steps)
     jax.block_until_ready(out[1].xl)
     t0 = time.perf_counter()
-    out = step(astates, states, n_steps)
+    out = step(css, states, n_steps)
     jax.block_until_ready(out[1].xl)
-    return N_SCENARIOS * n_steps / (time.perf_counter() - t0)
+    return n_scenarios * n_steps / (time.perf_counter() - t0)
 
 
-def main():
-    step, astates, states = build()
-    tpu_rate = measure(step, astates, states, jax.devices()[0], TIMED_STEPS)
+def headline(profile_dir: str | None = None):
+    step, css, states = build()
+    if profile_dir:
+        # Warm up outside the trace so the profile shows steady-state execution.
+        measure(step, css, states, jax.devices()[0], TIMED_STEPS, N_SCENARIOS)
+        with jax.profiler.trace(profile_dir):
+            tpu_rate = measure(
+                step, css, states, jax.devices()[0], TIMED_STEPS, N_SCENARIOS
+            )
+    else:
+        tpu_rate = measure(
+            step, css, states, jax.devices()[0], TIMED_STEPS, N_SCENARIOS
+        )
     try:
         cpu_rate = measure(
-            step, astates, states, jax.devices("cpu")[0], CPU_TIMED_STEPS
+            step, css, states, jax.devices("cpu")[0], CPU_TIMED_STEPS, N_SCENARIOS
         )
         vs = tpu_rate / cpu_rate
     except Exception:
@@ -117,6 +196,96 @@ def main():
         "unit": "scenario-MPC-steps/s",
         "vs_baseline": round(vs, 2),
     }))
+
+
+def _single_stream(controller, n, n_steps=30):
+    """Single-scenario MPC rate + p50 control-call time per consensus iteration
+    (the BASELINE.json 'p50 solve-time/ADMM-iter' metric; the centralized
+    controller has no consensus loop — reference SolverStatistics reports
+    iter = -1 — so the per-iteration metric is omitted for it)."""
+    mpc_step, cs0, state0 = make_mpc_step(controller, n)
+    step = jax.jit(mpc_step)
+    state = state0.replace(vl=jnp.array([0.5, 0.0, 0.0], jnp.float32))
+    cs, state_out, stats = step(cs0, state)  # compile
+    jax.block_until_ready(state_out.xl)
+    cs = cs0
+    times, iters = [], []
+    for _ in range(n_steps):
+        t0 = time.perf_counter()
+        cs, state, stats = step(cs, state)
+        jax.block_until_ready(state.xl)
+        times.append(time.perf_counter() - t0)
+        iters.append(int(stats.iters))
+    out = {
+        "mpc_steps_per_sec": 1.0 / float(np.median(times)),
+        "p50_step_ms": float(np.median(times)) * 1e3,
+    }
+    # p50 time per consensus/ADMM iteration — the BASELINE.json metric. Only
+    # meaningful for the distributed solvers (centralized reports iters = -1,
+    # reference SolverStatistics semantics).
+    if any(k > 0 for k in iters):
+        per_iter = [t / k for t, k in zip(times, iters) if k > 0]
+        out["p50_iters"] = float(np.median([k for k in iters if k > 0]))
+        out["p50_ms_per_consensus_iter"] = float(np.median(per_iter)) * 1e3
+    return out
+
+
+def _batched(controller, n, n_scenarios, n_steps=10):
+    step, css, states = build(controller, n, n_scenarios)
+    return measure(step, css, states, jax.devices()[0], n_steps, n_scenarios)
+
+
+def sweep():
+    results = {}
+    # MPC steps/sec/chip at N in {4, 16, 64} for all three controllers.
+    for ctrl in ("centralized", "cadmm", "dd"):
+        for n in (4, 16, 64):
+            key = f"{ctrl}_n{n}_single"
+            results[key] = _single_stream(ctrl, n)
+            print(f"# {key}: {results[key]}", flush=True)
+    # Batched throughput (the TPU's actual operating point) at the same Ns.
+    for ctrl in ("cadmm", "dd"):
+        for n, ns in ((4, 256), (16, 128), (64, 32)):
+            key = f"{ctrl}_n{n}_batch{ns}"
+            rate = _batched(ctrl, n, ns)
+            results[key] = {"scenario_mpc_steps_per_sec": rate,
+                            "agent_mpc_steps_per_sec": rate * n}
+            print(f"# {key}: {results[key]}", flush=True)
+    # Swarm (BASELINE.json config 5): 128 payloads x 8 quads = 1024 agents.
+    rate = _batched("cadmm", 8, 128)
+    results["swarm_128x8"] = {"scenario_mpc_steps_per_sec": rate,
+                              "agent_mpc_steps_per_sec": rate * 8}
+    print(f"# swarm_128x8: {results['swarm_128x8']}", flush=True)
+
+    with open("BENCH_SWEEP.json", "w") as fh:
+        json.dump(results, fh, indent=1)
+
+    # Markdown table for BASELINE.md.
+    print("\n| Config | MPC steps/s | p50 step ms | p50 ms/consensus-iter |")
+    print("|---|---|---|---|")
+    for ctrl in ("centralized", "cadmm", "dd"):
+        for n in (4, 16, 64):
+            r = results[f"{ctrl}_n{n}_single"]
+            per_iter = r.get("p50_ms_per_consensus_iter")
+            per_iter_s = f"{per_iter:.2f}" if per_iter is not None else "—"
+            print(f"| {ctrl} n={n} single-stream | "
+                  f"{r['mpc_steps_per_sec']:.1f} | {r['p50_step_ms']:.2f} | "
+                  f"{per_iter_s} |")
+    for key in [k for k in results if "batch" in k or "swarm" in k]:
+        r = results[key]
+        print(f"| {key} | {r['scenario_mpc_steps_per_sec']:.1f} scenario-steps/s "
+              f"({r['agent_mpc_steps_per_sec']:.0f} agent-steps/s) | — | — |")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--profile", default=None, metavar="DIR")
+    args = ap.parse_args()
+    if args.sweep:
+        sweep()
+    else:
+        headline(args.profile)
 
 
 if __name__ == "__main__":

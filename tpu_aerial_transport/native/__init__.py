@@ -21,7 +21,9 @@ from pathlib import Path
 import numpy as np
 
 _SRC = Path(__file__).with_name("socp_solver.cpp")
-_LIB_NAME = "libtat_socp.so"
+# v2: generic-ISA build (no -march=native). The version suffix keys the cache
+# on the compile flags, so stale ISA-specific binaries from v1 are not reused.
+_LIB_NAME = "libtat_socp_v2.so"
 _lib = None
 _build_error: str | None = None
 
@@ -34,11 +36,13 @@ def _cache_dir() -> Path:
 
 
 def _build() -> Path:
+    # No -march=native: the solver is tiny and latency-bound, and the cache is
+    # keyed only on source mtime — an ISA-specific binary could SIGILL after a
+    # host change (shared/NFS home) without ever being rebuilt.
     out = _cache_dir() / _LIB_NAME
     if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
         return out
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-           str(_SRC), "-o", str(out)]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(out)]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
     return out
 
