@@ -84,7 +84,7 @@ def rp_setup(n: int = 3, dtype=None):
 
 
 def pmrl_setup(n: int = 3, dtype=None):
-    """-> (PMRLParams, PMRLCollision-ish, PMRLState) (reference setup.py:182-187).
+    """-> (PMRLParams, PMRLCollision, PMRLState) (reference setup.py:182-187).
     Initial link directions all +z, zero tangent velocity."""
     kw = {} if dtype is None else {"dtype": dtype}
     params = pmrl.pmrl_params(
@@ -95,7 +95,9 @@ def pmrl_setup(n: int = 3, dtype=None):
         L=np.ones(n),
         **kw,
     )
-    col = rp.RPCollision(_PAYLOAD_VERTICES, _PAYLOAD_MESH_VERTICES)
+    col = pmrl.PMRLCollision(
+        _PAYLOAD_VERTICES, _PAYLOAD_MESH_VERTICES, link_lengths=params.L
+    )
     q = np.tile(np.array([0.0, 0.0, 1.0]), (n, 1))
     state = pmrl.pmrl_state(
         q=q, dq=np.zeros((n, 3)), xl=np.zeros(3), vl=np.zeros(3),

@@ -23,6 +23,7 @@ every step + SO(3) projection every 20 (reference :101-132).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from tpu_aerial_transport.ops import lie
@@ -165,6 +166,26 @@ def integrate(params: PMRLParams, state: PMRLState, f, dt,
               project_every: int = PROJECTION_PERIOD) -> PMRLState:
     acc, _ = forward_dynamics(params, state, f)
     return integrate_state(state, acc, dt, project_every)
+
+
+class PMRLCollision:
+    """Host-side collision/visual metadata (reference ``PMRLCollision``,
+    point_mass_rigid_link.py:257-278): payload hull + collision-mesh vertex
+    sets. Unlike RQP there is no quadrotor mesh — the robots are point masses —
+    so the conservative bounding radius covers payload + fully-extended links."""
+
+    def __init__(self, payload_vertices, payload_mesh_vertices,
+                 link_lengths=None):
+        payload_vertices = np.asarray(payload_vertices, np.float64)
+        payload_mesh_vertices = np.asarray(payload_mesh_vertices, np.float64)
+        assert payload_vertices.shape[1] == 3
+        assert payload_mesh_vertices.shape[1] == 3
+        self.payload_vertices = payload_vertices
+        self.payload_mesh_vertices = payload_mesh_vertices
+        mesh_radius = float(np.max(np.linalg.norm(payload_mesh_vertices, axis=1)))
+        max_link = float(np.max(np.asarray(link_lengths))) \
+            if link_lengths is not None else 0.0
+        self.collision_radius = mesh_radius + max_link + 0.1
 
 
 def inverse_dynamics_error(state: PMRLState, params: PMRLParams, f, T, acc):
