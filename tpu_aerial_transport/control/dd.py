@@ -278,22 +278,25 @@ def strong_convexity_matrix(
 def _consensus_matrix(params: RQPParams, state: RQPState):
     """Global consensus constraint matrix ``A (6n, 9n)`` (reference :643-653):
     row block i reads ``[F_i - sum_{j!=i} f_j ; M_i - sum_{j!=i} r_j x Rl^T f_j]``
-    off the stacked per-agent primal ``(f_j, F_j, M_j)``."""
+    off the stacked per-agent primal ``(f_j, F_j, M_j)``.
+
+    Built as a block tensor ``(i, row_half, 3, j, var_block, 3)`` with masked
+    einsums — an O(n^2) Python scatter loop here emitted tens of thousands of
+    HLO ops at n = 64 and crashed the TPU compiler."""
     n = params.n
     dtype = state.xl.dtype
     G = jax.vmap(lambda r: lie.hat(r) @ state.Rl.T)(params.r_com)  # (n, 3, 3)
-    eye = jnp.eye(3, dtype=dtype)
-    A = jnp.zeros((6 * n, 9 * n), dtype)
-    for i in range(n):
-        A = A.at[6 * i : 6 * i + 3, 9 * i + 3 : 9 * i + 6].set(eye)
-        A = A.at[6 * i + 3 : 6 * i + 6, 9 * i + 6 : 9 * i + 9].set(eye)
-    for i in range(n):
-        for j in range(n):
-            if j == i:
-                continue
-            A = A.at[6 * i : 6 * i + 3, 9 * j : 9 * j + 3].set(-eye)
-            A = A.at[6 * i + 3 : 6 * i + 6, 9 * j : 9 * j + 3].set(-G[j])
-    return A
+    I3 = jnp.eye(3, dtype=dtype)
+    eyen = jnp.eye(n, dtype=dtype)
+    offd = 1.0 - eyen
+    blocks = jnp.zeros((n, 2, 3, n, 3, 3), dtype)
+    # F rows (half 0): +I on F_i (var block 1), -I on every other f_j (block 0).
+    blocks = blocks.at[:, 0, :, :, 1, :].set(jnp.einsum("ij,ab->iajb", eyen, I3))
+    blocks = blocks.at[:, 0, :, :, 0, :].set(jnp.einsum("ij,ab->iajb", -offd, I3))
+    # M rows (half 1): +I on M_i (block 2), -G_j on every other f_j (block 0).
+    blocks = blocks.at[:, 1, :, :, 2, :].set(jnp.einsum("ij,ab->iajb", eyen, I3))
+    blocks = blocks.at[:, 1, :, :, 0, :].set(jnp.einsum("ij,jab->iajb", -offd, G))
+    return blocks.reshape(6 * n, 9 * n)
 
 
 def control(

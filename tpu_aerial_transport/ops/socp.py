@@ -178,9 +178,15 @@ def solve_socp(
 
     if op is None:
         op = kkt_operator(P, A, rho_vec, sigma)
-    # Fused x-update: x+ = K @ [x ; rho z - y] - Minv q, one matmul per iter.
+    # Fused iteration operator: with u = [x ; rho z - y],
+    #   x+   = K @ u - Minv q          (the ADMM x-update)
+    #   A x+ = (A K) @ u - A Minv q    (needed by the z/y updates)
+    # stack both into ONE (nv+m, nv+m) matmul per iteration — the entire
+    # linear-algebra step of an ADMM iteration as a single MXU op.
     K = jnp.concatenate([sigma * op.Minv, op.MinvAT], axis=-1)  # (nv, nv + m)
+    K2 = jnp.concatenate([K, A @ K], axis=0)  # (nv + m, nv + m)
     wq = op.Minv @ q
+    w2 = jnp.concatenate([wq, A @ wq])  # (nv + m,)
 
     if warm is None:
         x0 = jnp.zeros((nv,), dtype)
@@ -192,8 +198,8 @@ def solve_socp(
 
     def step(carry, _):
         x, y, z = carry
-        x_new = K @ jnp.concatenate([x, rho_vec * z - y]) - wq
-        Ax = A @ x_new
+        v = K2 @ jnp.concatenate([x, rho_vec * z - y]) - w2
+        x_new, Ax = v[:nv], v[nv:]
         Ax_rel = alpha * Ax + (1 - alpha) * z
         z_new = _project_cone(Ax_rel + y / rho_vec, lb, ub, n_box, soc_dims, shift)
         y_new = y + rho_vec * (Ax_rel - z_new)
