@@ -10,11 +10,21 @@ with sequential cvxpy/Clarabel solves (test_rqpcontrollers.py:112-124 runs its
 100 Monte-Carlo re-solves in a Python loop). The low-level SO(3) law runs inside
 every 1 kHz substep, as the reference's hot loop does (rqp_example.py:120-131).
 
-Baseline: the reference's cvxpy/Clarabel stack is not installed in this image, so
-the recorded baseline is THIS framework executed on the host CPU via XLA — a
-generous stand-in (same fused program; the reference additionally pays cvxpy
-re-canonicalization per solve and runs agents sequentially). ``vs_baseline`` is
-the TPU/CPU throughput ratio at identical batch size.
+Baseline: the reference's cvxpy/Clarabel stack is not installed in this image.
+Two CPU baselines are measured instead (both recorded in BASELINE.md):
+
+1. **Reference-architecture baseline** (the ``vs_baseline`` denominator):
+   the reference's actual execution model — n per-agent conic QPs solved
+   SEQUENTIALLY by a native (C++, f64) solver per consensus iteration, one
+   scenario at a time, warm-started, same stopping rule
+   (rqp_cadmm.py:644-648 runs exactly this loop through cvxpy+Clarabel).
+   Generous to the baseline: QP assembly, env queries, and physics are
+   EXCLUDED from its timing (the reference pays cvxpy re-canonicalization
+   per solve on top).
+2. **Same-program XLA-CPU baseline**: this framework's own fused program on
+   the host CPU — a much stronger baseline than the reference stack (fully
+   vectorized, no per-solve overhead); reported as ``vs_xla_cpu`` for
+   transparency.
 
 Default mode prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
@@ -157,16 +167,109 @@ def build(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS):
     return jax.jit(rollout, static_argnames="n_steps"), css, states
 
 
-def measure(step, css, states, device, n_steps, n_scenarios):
+def measure(step, css, states, device, n_steps, n_scenarios, reps=3):
     css = jax.device_put(css, device)
     states = jax.device_put(states, device)
-    # Compile + warmup at the timed length so the timed call hits the cache.
+    # Compile + warmup at the timed length so the timed calls hit the cache.
     out = step(css, states, n_steps)
     jax.block_until_ready(out[1].xl)
-    t0 = time.perf_counter()
-    out = step(css, states, n_steps)
-    jax.block_until_ready(out[1].xl)
-    return n_scenarios * n_steps / (time.perf_counter() - t0)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = step(css, states, n_steps)
+        jax.block_until_ready(out[1].xl)
+        times.append(time.perf_counter() - t0)
+    # Median over reps: one-off dispatch/timing glitches produced wildly
+    # wrong single-sample readings through the device tunnel.
+    return n_scenarios * n_steps / float(np.median(times))
+
+
+def ref_arch_cpu_rate(n=N_AGENTS, max_iter=20, inner_iters=25, n_steps=5):
+    """Reference-architecture CPU baseline: sequential per-agent native conic
+    solves (C++ f64 ADMM standing in for Clarabel) inside the C-ADMM consensus
+    loop, one scenario at a time — the reference's execution model
+    (rqp_cadmm.py:631-675). Only the solve loop + consensus bookkeeping are
+    timed (QP assembly / env query / physics excluded — generous).
+    Returns MPC steps/s, or None if the native solver is unavailable."""
+    from tpu_aerial_transport import native
+    from tpu_aerial_transport.control import cadmm
+
+    if not native.available():
+        return None
+    params, col, state0, forest, f_eq, ll, acc_des = _setup(n)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=max_iter, inner_iters=inner_iters,
+    )
+    state = state0.replace(vl=jnp.array([0.5, 0.0, 0.0], jnp.float32))
+    env_cbfs = cadmm.agent_env_cbfs(params, cfg, forest, state)
+    onehots = jnp.eye(n, dtype=jnp.float32)
+    leaders = (jnp.arange(n) == 0).astype(jnp.float32)
+    rho = float(cfg.rho0)
+    P, q0, A, lb, ub, shift = jax.vmap(
+        lambda oh, ld, cbf: cadmm._build_agent_qp(
+            params, cfg, f_eq, state, acc_des, cbf, oh, ld, rho
+        )
+    )(onehots, leaders, env_cbfs)
+    P, q0, A, lb, ub, shift = (np.asarray(x, np.float64)
+                               for x in (P, q0, A, lb, ub, shift))
+    n_box = 13 + cfg.n_env_cbfs
+
+    f_eq_np = np.asarray(f_eq, np.float64)
+    f = np.tile(f_eq_np, (n, 1, 1))  # (n, n, 3) local copies.
+    lam = np.zeros_like(f)
+    f_mean = f_eq_np.copy()
+    warms = [None] * n
+
+    # State evolves between control steps (untimed physics, same two-rate
+    # pattern as the TPU bench) so warm starts face a moving target — without
+    # this the repeated identical state converges in one consensus iteration
+    # and flatters the baseline.
+    from tpu_aerial_transport.models import rqp as rqp_mod
+
+    def advance(state, f_app):
+        fz = jnp.sum(jnp.asarray(f_app, jnp.float32) * state.R[..., :, 2],
+                     axis=-1)
+        for _ in range(10):
+            state = rqp_mod.integrate(
+                params, state, (fz, jnp.zeros((n, 3), jnp.float32)), 1e-3
+            )
+        return state
+
+    def rebuild(state):
+        cbfs = cadmm.agent_env_cbfs(params, cfg, forest, state)
+        out = jax.vmap(
+            lambda oh, ld, cbf: cadmm._build_agent_qp(
+                params, cfg, f_eq, state, acc_des, cbf, oh, ld, rho
+            )
+        )(onehots, leaders, cbfs)
+        return tuple(np.asarray(x, np.float64) for x in out)
+
+    t_total = 0.0
+    for _ in range(n_steps):
+        t0 = time.perf_counter()
+        for _it in range(max_iter):
+            for i in range(n):  # THE reference's sequential agent loop.
+                q = q0[i].copy()
+                q[9:] += (lam[i] - rho * f_mean).reshape(-1)
+                x, y, z, prim, dual = native.solve_socp_native(
+                    P[i], q, A[i], lb[i], ub[i], n_box=n_box,
+                    soc_dims=(4, 4), iters=inner_iters, shift=shift[i],
+                    warm=warms[i],
+                )
+                warms[i] = (x, y, z)
+                f[i] = x[9:].reshape(n, 3)
+            f_mean = f.mean(axis=0)
+            res = np.abs(f - f_mean[None]).max()
+            if res < cfg.res_tol:
+                break
+            lam += rho * (f - f_mean[None])
+        t_total += time.perf_counter() - t0
+        # Untimed: physics + QP re-assembly for the next step.
+        f_app = np.stack([f[i, i] for i in range(n)])
+        state = advance(state, f_app)
+        P, q0, A, lb, ub, shift = rebuild(state)
+    return n_steps / t_total
 
 
 def headline(profile_dir: str | None = None):
@@ -186,47 +289,70 @@ def headline(profile_dir: str | None = None):
         cpu_rate = measure(
             step, css, states, jax.devices("cpu")[0], CPU_TIMED_STEPS, N_SCENARIOS
         )
-        vs = tpu_rate / cpu_rate
+        vs_xla_cpu = tpu_rate / cpu_rate
     except Exception:
-        vs = float("nan")
+        vs_xla_cpu = float("nan")
+    try:
+        ref_rate = ref_arch_cpu_rate()
+        vs_ref = tpu_rate / ref_rate if ref_rate else float("nan")
+    except Exception:
+        vs_ref = float("nan")
 
     print(json.dumps({
         "metric": f"scenario_mpc_steps_per_sec_{N_SCENARIOS}x{N_AGENTS}_cadmm_forest",
         "value": round(tpu_rate, 1),
         "unit": "scenario-MPC-steps/s",
-        "vs_baseline": round(vs, 2),
+        # vs the reference's execution model (sequential native per-agent
+        # solves on CPU, BASELINE.json's 'cvxpy/Clarabel CPU baseline');
+        # vs_xla_cpu is this framework's own fused program on host CPU.
+        "vs_baseline": round(vs_ref, 2),
+        "vs_xla_cpu": round(vs_xla_cpu, 2),
     }))
 
 
-def _single_stream(controller, n, n_steps=30):
+def _single_stream(controller, n, n_steps=50):
     """Single-scenario MPC rate + p50 control-call time per consensus iteration
     (the BASELINE.json 'p50 solve-time/ADMM-iter' metric; the centralized
     controller has no consensus loop — reference SolverStatistics reports
-    iter = -1 — so the per-iteration metric is omitted for it)."""
+    iter = -1 — so the per-iteration metric is omitted for it).
+
+    The ``n_steps`` rollout runs as ONE on-device ``lax.scan`` and the wall
+    time is divided by ``n_steps``: per-call host dispatch through the device
+    tunnel is ~100 ms, which would otherwise swamp the few-ms step compute."""
     mpc_step, cs0, state0 = make_mpc_step(controller, n)
-    step = jax.jit(mpc_step)
-    state = state0.replace(vl=jnp.array([0.5, 0.0, 0.0], jnp.float32))
-    cs, state_out, stats = step(cs0, state)  # compile
-    jax.block_until_ready(state_out.xl)
-    cs = cs0
-    times, iters = [], []
-    for _ in range(n_steps):
-        t0 = time.perf_counter()
-        cs, state, stats = step(cs, state)
-        jax.block_until_ready(state.xl)
-        times.append(time.perf_counter() - t0)
-        iters.append(int(stats.iters))
+    state0 = state0.replace(vl=jnp.array([0.5, 0.0, 0.0], jnp.float32))
+
+    def roll(cs, state):
+        def body(carry, _):
+            cs, s = carry
+            cs, s, stats = mpc_step(cs, s)
+            return (cs, s), stats.iters
+
+        (cs, s), iters = jax.lax.scan(body, (cs, state), None, length=n_steps)
+        return cs, s, iters
+
+    jitted = jax.jit(roll)
+    cs, s, iters = jitted(cs0, state0)  # compile + warmup.
+    jax.block_until_ready(s.xl)
+    t0 = time.perf_counter()
+    cs, s, iters = jitted(cs0, state0)
+    jax.block_until_ready(s.xl)
+    per_step = (time.perf_counter() - t0) / n_steps
+    iters = np.asarray(iters)
+    # These are scan-amortized MEANS over n_steps (per-step host timing is
+    # impossible without paying ~100 ms dispatch per step); with warm-started
+    # steady-state steps the mean tracks the median closely.
     out = {
-        "mpc_steps_per_sec": 1.0 / float(np.median(times)),
-        "p50_step_ms": float(np.median(times)) * 1e3,
+        "mpc_steps_per_sec": 1.0 / per_step,
+        "step_ms_mean": per_step * 1e3,
     }
-    # p50 time per consensus/ADMM iteration — the BASELINE.json metric. Only
+    # Time per consensus/ADMM iteration — the BASELINE.json metric. Only
     # meaningful for the distributed solvers (centralized reports iters = -1,
     # reference SolverStatistics semantics).
-    if any(k > 0 for k in iters):
-        per_iter = [t / k for t, k in zip(times, iters) if k > 0]
-        out["p50_iters"] = float(np.median([k for k in iters if k > 0]))
-        out["p50_ms_per_consensus_iter"] = float(np.median(per_iter)) * 1e3
+    if (iters > 0).any():
+        p50_iters = float(np.median(iters[iters > 0]))
+        out["p50_iters"] = p50_iters
+        out["ms_per_consensus_iter"] = per_step * 1e3 / p50_iters
     return out
 
 
@@ -245,7 +371,7 @@ def sweep():
             print(f"# {key}: {results[key]}", flush=True)
     # Batched throughput (the TPU's actual operating point) at the same Ns.
     for ctrl in ("cadmm", "dd"):
-        for n, ns in ((4, 256), (16, 128), (64, 32)):
+        for n, ns in ((4, 256), (16, 128), (64, 64)):
             key = f"{ctrl}_n{n}_batch{ns}"
             rate = _batched(ctrl, n, ns)
             results[key] = {"scenario_mpc_steps_per_sec": rate,
@@ -256,20 +382,41 @@ def sweep():
     results["swarm_128x8"] = {"scenario_mpc_steps_per_sec": rate,
                               "agent_mpc_steps_per_sec": rate * 8}
     print(f"# swarm_128x8: {results['swarm_128x8']}", flush=True)
+    # North-star ratio (BASELINE.json): TPU throughput vs the reference-
+    # architecture CPU baseline at 64 agents.
+    for n, ns in ((8, 256), (64, 64)):
+        try:
+            ref = ref_arch_cpu_rate(n=n, n_steps=3)
+        except Exception as e:  # native solver unavailable/failed: keep the
+            print(f"# ref_arch_cpu_rate(n={n}) failed: {e}", flush=True)
+            ref = None  # TPU measurements already collected above.
+        if ref:
+            key = f"cadmm_n{n}_batch{ns}"
+            if key in results:
+                tpu = results[key]["scenario_mpc_steps_per_sec"]
+            else:
+                tpu = _batched("cadmm", n, ns)
+            results[f"north_star_n{n}"] = {
+                "tpu_scenario_mpc_steps_per_sec": tpu,
+                "ref_arch_cpu_mpc_steps_per_sec": ref,
+                "ratio": tpu / ref,
+            }
+            print(f"# north_star_n{n}: {results[f'north_star_n{n}']}",
+                  flush=True)
 
     with open("BENCH_SWEEP.json", "w") as fh:
         json.dump(results, fh, indent=1)
 
     # Markdown table for BASELINE.md.
-    print("\n| Config | MPC steps/s | p50 step ms | p50 ms/consensus-iter |")
+    print("\n| Config | MPC steps/s | mean step ms | ms/consensus-iter |")
     print("|---|---|---|---|")
     for ctrl in ("centralized", "cadmm", "dd"):
         for n in (4, 16, 64):
             r = results[f"{ctrl}_n{n}_single"]
-            per_iter = r.get("p50_ms_per_consensus_iter")
+            per_iter = r.get("ms_per_consensus_iter")
             per_iter_s = f"{per_iter:.2f}" if per_iter is not None else "—"
             print(f"| {ctrl} n={n} single-stream | "
-                  f"{r['mpc_steps_per_sec']:.1f} | {r['p50_step_ms']:.2f} | "
+                  f"{r['mpc_steps_per_sec']:.1f} | {r['step_ms_mean']:.2f} | "
                   f"{per_iter_s} |")
     for key in [k for k in results if "batch" in k or "swarm" in k]:
         r = results[key]
@@ -277,13 +424,87 @@ def sweep():
               f"({r['agent_mpc_steps_per_sec']:.0f} agent-steps/s) | — | — |")
 
 
+def components():
+    """Component split of the headline batched step (SURVEY.md §5.1):
+    env query / consensus solve / low-level+physics / QP build, each timed as
+    its own jitted computation at the headline config."""
+    from tpu_aerial_transport.control import cadmm
+
+    params, col, state0, forest, f_eq, ll, acc_des = _setup(N_AGENTS)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=20, inner_iters=25,
+    )
+    states = _scenario_batch(state0, N_SCENARIOS)
+    css = jax.vmap(lambda _: cadmm.init_cadmm_state(params, cfg))(
+        jnp.arange(N_SCENARIOS)
+    )
+    dev = jax.devices()[0]
+
+    def timed(name, fn, *args, reps=3, inner=10):
+        # ``inner`` repetitions run inside one jitted lax.scan: per-dispatch
+        # latency through the device tunnel is ~10-100 ms (and varies), which
+        # would swamp any per-call timing of a ~ms-scale component. ``fn``
+        # takes an ``eps`` scalar first and must fold it into its inputs; the
+        # carry threads a data-dependent (runtime-zero) eps through the scan
+        # so XLA cannot hoist the loop-invariant body and run it once.
+        def scanned(*xs):
+            def body(eps, _):
+                out = fn(eps, *xs)
+                tot = sum(jnp.sum(jnp.abs(l)) for l in jax.tree.leaves(out))
+                return tot * 1e-38, None  # flushes to ~0, not provably 0.
+
+            eps, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=inner)
+            return eps
+
+        f = jax.jit(scanned)
+        out = f(*args)
+        jax.block_until_ready(out)
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = f(*args)
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
+        ms = float(np.median(ts)) * 1e3 / inner
+        print(f"{name:40s} {ms:8.2f} ms")
+        return ms
+
+    def jitter(ss, eps):
+        return jax.vmap(lambda s: s.replace(xl=s.xl + eps))(ss)
+
+    timed("env query (per-agent vision CBFs)",
+          lambda eps, ss: jax.vmap(
+              lambda s: cadmm.agent_env_cbfs(params, cfg, forest, s)
+          )(jitter(ss, eps)), states)
+    timed("cadmm control (full, incl. env)",
+          lambda eps, a, ss: jax.vmap(
+              lambda ai, si: cadmm.control(
+                  params, cfg, f_eq, ai, si, acc_des, forest
+              )
+          )(a, jitter(ss, eps))[0], css, states)
+    timed("cadmm control (no env)",
+          lambda eps, a, ss: jax.vmap(
+              lambda ai, si: cadmm.control(
+                  params, cfg, f_eq, ai, si, acc_des, None
+              )
+          )(a, jitter(ss, eps))[0], css, states)
+    timed("low-level + 10x physics",
+          lambda eps, ss: jax.vmap(
+              lambda s: _substeps(params, ll, s, f_eq).xl
+          )(jitter(ss, eps)), states)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--components", action="store_true")
     ap.add_argument("--profile", default=None, metavar="DIR")
     args = ap.parse_args()
     if args.sweep:
         sweep()
+    elif args.components:
+        components()
     else:
         headline(args.profile)
 
