@@ -17,6 +17,96 @@ import os
 import numpy as np
 
 QUAD_ARM = 0.15  # [m] drawn arm length for the quadrotor cross.
+CONE_HEIGHT = 2.0  # [m] foliage cone on each bark (reference env_forest.py:24).
+CONE_RADIUS = 1.0
+
+
+def quadrotor_mesh(arm: float = 0.15, rotor_radius: float = 0.08,
+                   body: float = 0.06, segments: int = 8):
+    """Procedural quadrotor mesh ``(verts (V, 3), faces (F, 3))`` — the
+    replacement for the reference's ``objs/quadrotor.obj`` asset
+    (rigid_quadrotor_payload.py:17,308): a box body, four diagonal arms, and
+    four rotor discs. Built from primitives rather than shipping a mesh file.
+    """
+    verts: list[np.ndarray] = []
+    faces: list[list[int]] = []
+
+    def add_box(center, half):
+        i0 = len(verts)
+        for dx in (-1, 1):
+            for dy in (-1, 1):
+                for dz in (-1, 1):
+                    verts.append(center + half * np.array([dx, dy, dz]))
+        quads = [(0, 1, 3, 2), (4, 6, 7, 5), (0, 4, 5, 1),
+                 (2, 3, 7, 6), (0, 2, 6, 4), (1, 5, 7, 3)]
+        for a, b, c, d in quads:
+            faces.append([i0 + a, i0 + b, i0 + c])
+            faces.append([i0 + a, i0 + c, i0 + d])
+
+    def add_disc(center, radius, z):
+        i0 = len(verts)
+        verts.append(center + np.array([0.0, 0.0, z]))
+        for k in range(segments):
+            a = 2 * np.pi * k / segments
+            verts.append(center + np.array(
+                [radius * np.cos(a), radius * np.sin(a), z]
+            ))
+        for k in range(segments):
+            faces.append([i0, i0 + 1 + k, i0 + 1 + (k + 1) % segments])
+
+    add_box(np.zeros(3), np.array([body, body, body * 0.5]))
+    for sx, sy in ((1, 1), (1, -1), (-1, 1), (-1, -1)):
+        d = np.array([sx, sy, 0.0]) / np.sqrt(2.0)
+        add_box(d * arm / 2, np.array([arm / 2 * abs(d[0]) + 0.01,
+                                       arm / 2 * abs(d[1]) + 0.01, 0.008]))
+        add_disc(d * arm, rotor_radius, 0.02)
+    return np.asarray(verts), np.asarray(faces, np.int32)
+
+
+def draw_forest_3d(ax, forest, ground: bool = True, max_trees: int | None = None):
+    """Forest scene elements for the 3-D matplotlib backend (reference
+    ``Forest.visualize_env``, env_forest.py:90-137): bark cylinders (drawn as
+    thick lines), green foliage cones, the ground plane, and the spherical-cap
+    mountain wireframe."""
+    import numpy as _np
+
+    num = int(forest.num_trees)
+    if max_trees is not None:
+        num = min(num, max_trees)
+    pos = np.asarray(forest.tree_pos[:num])
+    h = forest.bark_height
+    for p in pos:
+        ax.plot([p[0], p[0]], [p[1], p[1]], [p[2] - h / 2, p[2] + h / 2],
+                color="saddlebrown", lw=2, alpha=0.8)
+        # Foliage cone: a small triangle fan.
+        tip = np.array([p[0], p[1], p[2] + h / 2 + CONE_HEIGHT])
+        ring = [
+            np.array([p[0] + CONE_RADIUS * np.cos(a),
+                      p[1] + CONE_RADIUS * np.sin(a), p[2] + h / 2])
+            for a in np.linspace(0, 2 * np.pi, 9)
+        ]
+        from mpl_toolkits.mplot3d.art3d import Poly3DCollection
+
+        tris = [[tip, ring[k], ring[k + 1]] for k in range(8)]
+        ax.add_collection3d(
+            Poly3DCollection(tris, facecolor="forestgreen", alpha=0.5)
+        )
+    if ground:
+        # Spherical-cap mountain surface (coarse) + flat ground ring.
+        from tpu_aerial_transport.envs.forest import (
+            MOUNTAIN_CENTER, MOUNTAIN_RADIUS,
+        )
+
+        th = _np.linspace(0, 2 * np.pi, 24)
+        rr = _np.linspace(0, MOUNTAIN_RADIUS, 8)
+        R, TH = _np.meshgrid(rr, th)
+        X = MOUNTAIN_CENTER[0] + R * _np.cos(TH)
+        Y = MOUNTAIN_CENTER[1] + R * _np.sin(TH)
+        sr = float(forest.mountain_sphere_radius)
+        cd = float(forest.mountain_center_depth)
+        Z = _np.sqrt(_np.maximum(sr**2 - R**2, 0.0)) - cd
+        Z = _np.maximum(Z, 0.0)
+        ax.plot_wireframe(X, Y, Z, color="#70AB94", lw=0.4, alpha=0.5)
 
 
 def _mpl():
@@ -28,12 +118,15 @@ def _mpl():
     return plt
 
 
-def draw_snapshot(ax, params, payload_vertices, state, forest=None, alpha=1.0):
+def draw_snapshot(ax, params, payload_vertices, state, forest=None, alpha=1.0,
+                  quad_mesh=False):
     """Draw one scene state into a 3-D matplotlib axis.
 
     ``state`` needs ``xl, Rl`` and optionally per-agent ``R``; agent positions
     are the attachment points ``xl + Rl r_i`` (rigid attachment, RQP model).
     ``alpha < 1`` renders a ghost (multi-snapshot scenes, rqp_plots.py:112-147).
+    ``quad_mesh=True`` draws the full procedural quadrotor mesh instead of the
+    cross-of-arms sketch.
     """
     from mpl_toolkits.mplot3d.art3d import Poly3DCollection
 
@@ -55,25 +148,33 @@ def draw_snapshot(ax, params, payload_vertices, state, forest=None, alpha=1.0):
     except Exception:
         ax.scatter(*verts.T, color="tab:gray", alpha=alpha, s=4)
 
-    # Quadrotors: attachment points + body-frame arms.
+    # Quadrotors: attachment points + body-frame arms (or the full procedural
+    # mesh when ``quad_mesh=True`` — the reference's .obj-mesh path).
     quad_pos = xl + r @ Rl.T
     ax.scatter(*quad_pos.T, color="tab:blue", s=18 * alpha, alpha=alpha)
     if hasattr(state, "R") and state.R is not None:
+        from mpl_toolkits.mplot3d.art3d import Poly3DCollection as _P3D
+
         R = np.asarray(state.R)
-        for i in range(n):
-            for axis in (R[i, :, 0], R[i, :, 1]):
-                seg = np.stack(
-                    [quad_pos[i] - QUAD_ARM * axis, quad_pos[i] + QUAD_ARM * axis]
-                )
-                ax.plot(*seg.T, color="k", lw=0.8, alpha=alpha)
+        if quad_mesh:
+            mv, mf = quadrotor_mesh()
+            for i in range(n):
+                v = mv @ R[i].T + quad_pos[i]
+                ax.add_collection3d(_P3D(
+                    [v[f] for f in mf], facecolor="#1590A0",
+                    alpha=0.6 * alpha, edgecolor="none",
+                ))
+        else:
+            for i in range(n):
+                for axis in (R[i, :, 0], R[i, :, 1]):
+                    seg = np.stack([
+                        quad_pos[i] - QUAD_ARM * axis,
+                        quad_pos[i] + QUAD_ARM * axis,
+                    ])
+                    ax.plot(*seg.T, color="k", lw=0.8, alpha=alpha)
 
     if forest is not None:
-        num = int(forest.num_trees)
-        pos = np.asarray(forest.tree_pos[:num])
-        h = forest.bark_height
-        for p in pos:
-            ax.plot([p[0], p[0]], [p[1], p[1]], [p[2] - h / 2, p[2] + h / 2],
-                    color="saddlebrown", lw=2, alpha=0.6)
+        draw_forest_3d(ax, forest)
 
 
 def draw_pmrl_snapshot(ax, params, payload_vertices, state, alpha=1.0):
@@ -164,7 +265,10 @@ def render_ghost_snapshot(
         s = _S()
         s.xl, s.Rl, s.R = xl_seq[t], Rl_seq[t], R_seq[t]
         alpha = 0.3 + 0.7 * (k + 1) / len(times)
-        draw_snapshot(ax, params, payload_vertices, s, forest, alpha=alpha)
+        # Forest drawn once (first ghost) — re-drawing stacks translucent
+        # foliage/mountain artists toward opaque and multiplies render time.
+        draw_snapshot(ax, params, payload_vertices, s,
+                      forest if k == 0 else None, alpha=alpha)
     ax.plot(*xl_seq[: max(times) + 1].T, color="tab:blue", lw=0.8, ls="--")
     lo = xl_seq[times].min(axis=0) - 3
     hi = xl_seq[times].max(axis=0) + 3
@@ -175,38 +279,103 @@ def render_ghost_snapshot(
     plt.close(fig)
 
 
+_Z_UP = np.array([[1, 0, 0], [0, 0, -1], [0, 1, 0]], float).T  # y-up -> z-up.
+
+
 class MeshcatBackend:
     """Live three.js viewer path, used only when meshcat is installed (the
-    reference's default backend). Mirrors ``RQPVisualizer``'s scene graph:
-    payload hull mesh, per-quad bodies, forest cylinders."""
+    reference's default backend). Mirrors ``RQPVisualizer``'s scene graph
+    (rigid_quadrotor_payload.py:313-418): payload hull mesh, per-quad
+    quadrotor meshes (procedural, replacing objs/quadrotor.obj), and the full
+    forest scene — bark cylinders, foliage cones, ground plane, mountain —
+    from ``Forest.visualize_env`` (env_forest.py:90-137). ``replay`` drives
+    the smoothed follow camera of ``rqp_plots._visualization`` (:44-109)."""
 
     def __init__(self):
         import meshcat  # noqa: F401 — optional dependency.
 
         self.vis = meshcat.Visualizer()
+        self._objs: set[str] = set()
 
     def open(self):
         self.vis.open()
         return self
 
-    def visualize_env(self, forest):
+    def visualize_env(self, forest, ground_extent: float = 60.0):
         import meshcat.geometry as gm
         import meshcat.transformations as tf
 
+        from tpu_aerial_transport.envs.forest import MOUNTAIN_CENTER
+
+        # Ground plane (reference :115-121: a thin box).
+        self.vis["ground"].set_object(
+            gm.Box([2 * ground_extent, 2 * ground_extent, 0.02])
+        )
+        self.vis["ground"].set_transform(
+            tf.translation_matrix([0.0, 0.0, -0.011])
+        )
+        # Mountain spherical cap, approximated as in the reference (:123-137)
+        # by a sphere sunk below ground level. Center depth matches the
+        # physics model (forest.ground_height) so the rendered surface is the
+        # surface the terrain-following reference trajectory flies over.
+        sr = float(forest.mountain_sphere_radius)
+        cd = float(forest.mountain_center_depth)
+        self.vis["mountain"].set_object(gm.Sphere(sr))
+        self.vis["mountain"].set_transform(tf.translation_matrix(
+            [MOUNTAIN_CENTER[0], MOUNTAIN_CENTER[1], -cd]
+        ))
         num = int(forest.num_trees)
         for i, p in enumerate(np.asarray(forest.tree_pos[:num])):
+            # Bark cylinder (:99-106).
             self.vis[f"bark_{i}"].set_object(
                 gm.Cylinder(height=forest.bark_height, radius=forest.bark_radius)
             )
             T = tf.translation_matrix(p)
-            # meshcat cylinders are y-up; rotate to z-up.
-            T[:3, :3] = np.array([[1, 0, 0], [0, 0, -1], [0, 1, 0]], float).T
+            T[:3, :3] = _Z_UP
             self.vis[f"bark_{i}"].set_transform(T)
+            # Foliage cone on top (:107-114); meshcat Cylinder with zero top
+            # radius is a cone, y-up like all meshcat cylinders.
+            self.vis[f"cone_{i}"].set_object(gm.Cylinder(
+                height=CONE_HEIGHT, radiusBottom=CONE_RADIUS, radiusTop=0.0
+            ))
+            Tc = tf.translation_matrix(
+                p + np.array([0.0, 0.0, forest.bark_height / 2 + CONE_HEIGHT / 2])
+            )
+            Tc[:3, :3] = _Z_UP
+            self.vis[f"cone_{i}"].set_transform(Tc)
 
-    def update(self, params, state, prefix: str = ""):
+    def _ensure_objects(self, params, payload_vertices, prefix: str):
         import meshcat.geometry as gm
+
+        name = prefix + "payload"
+        if name not in self._objs and payload_vertices is not None:
+            try:
+                from tpu_aerial_transport.utils.geometry import (
+                    faces_from_vertex_rep,
+                )
+
+                verts = np.asarray(payload_vertices)
+                self.vis[name].set_object(gm.TriangularMeshGeometry(
+                    verts, faces_from_vertex_rep(verts)
+                ))
+                self._objs.add(name)
+            except Exception:
+                pass
+        missing = [
+            i for i in range(np.asarray(params.r).shape[0])
+            if prefix + f"quad_{i}" not in self._objs
+        ]
+        if missing:  # build the procedural mesh only when actually needed.
+            mv, mf = quadrotor_mesh()
+            for i in missing:
+                qn = prefix + f"quad_{i}"
+                self.vis[qn].set_object(gm.TriangularMeshGeometry(mv, mf))
+                self._objs.add(qn)
+
+    def update(self, params, state, prefix: str = "", payload_vertices=None):
         import meshcat.transformations as tf
 
+        self._ensure_objects(params, payload_vertices, prefix)
         xl = np.asarray(state.xl)
         Rl = np.asarray(state.Rl)
         T = tf.translation_matrix(xl)
@@ -214,13 +383,42 @@ class MeshcatBackend:
         self.vis[prefix + "payload"].set_transform(T)
         r = np.asarray(params.r)
         R = np.asarray(state.R)
-        if not hasattr(self, "_objs"):
-            self._objs = set()
         for i in range(r.shape[0]):
             Ti = tf.translation_matrix(xl + Rl @ r[i])
             Ti[:3, :3] = R[i]
-            name = prefix + f"quad_{i}"
-            if name not in self._objs:
-                self.vis[name].set_object(gm.Sphere(0.08))
-                self._objs.add(name)
-            self.vis[name].set_transform(Ti)
+            self.vis[prefix + f"quad_{i}"].set_transform(Ti)
+
+    def replay(self, logs: dict, params, payload_vertices=None, forest=None,
+               speedup: float = 5.0, min_fps: float = 24.0):
+        """Replay a rollout log with the smoothed follow camera (reference
+        ``_visualization``, rqp_plots.py:44-109: savgol-smoothed camera track,
+        fast-forward, minimum frame pacing)."""
+        import time as _time
+
+        if forest is not None:
+            self.visualize_env(forest)
+        xl_seq = np.asarray(logs["state_seq"]["xl"])
+        Rl_seq = np.asarray(logs["state_seq"]["Rl"])
+        R_seq = np.asarray(logs["state_seq"]["R"])
+        dt_frame = logs["dt"] * logs["hl_rel_freq"] / speedup
+        stride = max(1, int(round(1.0 / (min_fps * dt_frame))))
+        k = 25  # camera smoothing window (savgol stand-in).
+        pad = np.pad(xl_seq, ((k, k), (0, 0)), mode="edge")
+        smooth = np.stack([
+            pad[i: i + 2 * k + 1].mean(axis=0) for i in range(len(xl_seq))
+        ])
+
+        class _S:
+            pass
+
+        for t in range(0, len(xl_seq), stride):
+            s = _S()
+            s.xl, s.Rl, s.R = xl_seq[t], Rl_seq[t], R_seq[t]
+            self.update(params, s, payload_vertices=payload_vertices)
+            cam = smooth[t] + np.array([-3.0, -3.0, 1.5])
+            try:
+                self.vis.set_cam_pos(cam)
+                self.vis.set_cam_target(smooth[t])
+            except Exception:
+                pass  # older meshcat versions lack camera helpers.
+            _time.sleep(max(dt_frame * stride, 1.0 / min_fps))
