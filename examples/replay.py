@@ -1,0 +1,91 @@
+"""Replay a saved rollout log: frames, ghost snapshot, and paper figures.
+
+TPU-native counterpart of reference ``example/rqp_plots.py:main()`` (:496-527):
+loads the run artifact (npz written by ``examples/rqp_forest.py --out``),
+reconstructs the forest from the logged tree positions (reference :503-505 —
+the procedural env is reproducible from the log), and renders:
+
+- PNG replay frames with the smoothed follow camera (``viz.scene.render_frames``;
+  use ``--meshcat`` for the live three.js viewer with camera pacing),
+- a multi-ghost snapshot scene (reference ``_snapshot``),
+- the paper figures: 600-dpi xy trajectory with key-frame overlays and the
+  min-distance log plot.
+
+Usage:
+  python examples/rqp_forest.py --controller cadmm -T 10 --out run.npz
+  python examples/replay.py run.npz --controller cadmm --outdir replay_out
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def load_log(path: str) -> dict:
+    """Inverse of the flattened npz layout written by rqp_forest.py."""
+    raw = np.load(path, allow_pickle=False)
+    logs = {k: raw[k] for k in raw.files if not k.startswith("state_")}
+    logs["state_seq"] = {
+        k[len("state_"):]: raw[k] for k in raw.files if k.startswith("state_")
+    }
+    for k in ("n", "dt", "T", "hl_rel_freq", "log_freq", "num_trees"):
+        if k in logs:
+            logs[k] = logs[k].item()
+    return logs
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("log", help="npz log from rqp_forest.py --out")
+    p.add_argument("--controller", default="cadmm",
+                   choices=["centralized", "cadmm", "dd"])
+    p.add_argument("--outdir", default="replay_out")
+    p.add_argument("--stride", type=int, default=25, help="frame stride")
+    p.add_argument("--meshcat", action="store_true",
+                   help="live meshcat replay instead of PNG frames")
+    args = p.parse_args()
+
+    from tpu_aerial_transport.envs import forest as forest_mod
+    from tpu_aerial_transport.harness import setup
+    from tpu_aerial_transport.viz import plots, scene
+
+    logs = load_log(args.log)
+    n = int(logs["n"])
+    params, col, _ = setup.rqp_setup(n)
+    forest = None
+    if "tree_pos" in logs:
+        forest = forest_mod.forest_from_tree_pos(
+            logs["tree_pos"], logs.get("num_trees", len(logs["tree_pos"]))
+        )
+
+    os.makedirs(args.outdir, exist_ok=True)
+
+    if args.meshcat:
+        backend = scene.MeshcatBackend().open()
+        backend.replay(logs, params, payload_vertices=col.payload_vertices,
+                       forest=forest)
+    else:
+        frames = scene.render_frames(
+            logs, params, col.payload_vertices,
+            os.path.join(args.outdir, "frames"), forest=forest,
+            stride=args.stride,
+        )
+        print(f"{len(frames)} frames -> {args.outdir}/frames")
+
+    T = logs["state_seq"]["xl"].shape[0]
+    scene.render_ghost_snapshot(
+        logs, params, col.payload_vertices,
+        os.path.join(args.outdir, "ghosts.png"),
+        times=[int(f * (T - 1)) for f in (0.1, 0.4, 0.7, 0.95)],
+        forest=forest,
+    )
+    plots.save_figures(logs, args.outdir, args.controller,
+                       params=params, collision=col)
+    print(f"figures -> {args.outdir}")
+
+
+if __name__ == "__main__":
+    main()

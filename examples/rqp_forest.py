@@ -115,17 +115,8 @@ def main() -> None:
     if args.plots:
         from tpu_aerial_transport.viz import plots
 
-        ctype = {"centralized": "centralized", "cadmm": "consensus-admm",
-                 "dd": "dual-decomposition"}[args.controller]
-        plots.plot_tracking_errors(log_dict, f"tracking_{args.controller}.png")
-        plots.plot_solver_stats(log_dict, f"stats_{args.controller}.png",
-                                dist_eps)
-        plots.plot_xy_trajectory(
-            log_dict, f"xy_{args.controller}.png",
-            params=params, collision=col, controller_type=ctype,
-        )
-        plots.plot_min_dist(log_dict, f"min_dist_{args.controller}.png",
-                            dist_eps)
+        plots.save_figures(log_dict, "", args.controller,
+                           params=params, collision=col, dist_eps=dist_eps)
         print("figures saved (xy + min-dist at 600 dpi)")
 
 

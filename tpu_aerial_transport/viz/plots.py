@@ -218,6 +218,34 @@ def plot_xy_trajectory(
     plt.close(fig)
 
 
+CONTROLLER_TYPE = {
+    "centralized": "centralized",
+    "cadmm": "consensus-admm",
+    "dd": "dual-decomposition",
+}
+
+
+def save_figures(logs: dict, out: str, controller: str, params=None,
+                 collision=None, dist_eps: float = 0.1):
+    """Render the full reference figure set from one rollout log: tracking
+    errors, solver stats, the 600-dpi xy trajectory (with key-frame overlays
+    when ``params``/``collision`` are given), and the 600-dpi min-dist plot.
+    ``out`` is a directory or filename prefix; ``controller`` is the CLI name
+    (centralized/cadmm/dd). Shared by examples/rqp_forest.py and
+    examples/replay.py."""
+    import os
+
+    prefix = os.path.join(out, "") if os.path.isdir(out) else out
+    ctype = CONTROLLER_TYPE[controller]
+    plot_tracking_errors(logs, f"{prefix}tracking_{controller}.png")
+    plot_solver_stats(logs, f"{prefix}stats_{controller}.png", dist_eps)
+    plot_xy_trajectory(
+        logs, f"{prefix}xy_{controller}.png",
+        params=params, collision=collision, controller_type=ctype,
+    )
+    plot_min_dist(logs, f"{prefix}min_dist_{controller}.png", dist_eps)
+
+
 def plot_min_dist(logs: dict, path: str, dist_eps: float = 0.1,
                   t_final_frac: float = 0.85, dpi: int = _SAVE_DPI):
     """Min-obstacle-distance paper figure (reference ``_plot_min_dist``,
