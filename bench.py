@@ -79,12 +79,14 @@ def _substeps(params, ll, state, f_des, n_sub=10, dt=1e-3):
 
 
 def make_mpc_step(controller: str, n: int, max_iter: int = 20,
-                  inner_iters: int = 20):
-    # inner_iters = 20 is the measured knee: below it the warm-started agent
-    # solves miss the 5e-3 primal tolerance and the controllers fall back to
-    # equilibrium forces (visible as an exactly-zero consensus residual);
-    # at 20 the forces match an inner=80 solve to < 1e-4 N and the step is
-    # ~15% faster than the round-1 budget of 25.
+                  inner_iters: int | None = None):
+    # Default inner ADMM budgets are the measured knees. C-ADMM: 20 — below
+    # it the warm-started agent solves miss the 5e-3 primal tolerance and
+    # fall back to equilibrium forces (visible as an exactly-zero consensus
+    # residual); at 20 forces match an inner=80 solve to < 1e-4 N. DD: 40 —
+    # its quasi-Newton dual ascent needs tighter primal optima (at 20 it
+    # rails against the outer iteration cap), and its 18-var QPs make inner
+    # iterations ~20x cheaper than C-ADMM's (9+3n)-var ones.
     """Build ``(mpc_step(cs, state) -> (cs, state, stats), cs0, state0)`` for one
     scenario with the given high-level controller."""
     from tpu_aerial_transport.control import cadmm, centralized, dd
@@ -95,7 +97,8 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
     if controller == "cadmm":
         cfg = cadmm.make_config(
             params, col.collision_radius, col.max_deceleration,
-            max_iter=max_iter, inner_iters=inner_iters,
+            max_iter=max_iter,
+            inner_iters=inner_iters if inner_iters is not None else 20,
         )
         cs0 = cadmm.init_cadmm_state(params, cfg)
 
@@ -108,7 +111,8 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
     elif controller == "dd":
         cfg = dd.make_config(
             params, col.collision_radius, col.max_deceleration,
-            max_iter=max_iter, inner_iters=inner_iters,
+            max_iter=max_iter,
+            inner_iters=inner_iters if inner_iters is not None else 40,
         )
         cs0 = dd.init_dd_state(params, cfg)
 

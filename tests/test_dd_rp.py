@@ -133,3 +133,39 @@ def test_rp_centralized_closedloop_circle():
     assert float(jnp.max(errs[300:])) < 0.3
     # Tilt stays within the 30 deg CBF bound.
     assert float(final.Rl[2, 2]) > float(jnp.cos(jnp.pi / 6)) - 0.02
+
+
+def test_dd_runtime_hooks():
+    """The leader/tolerance/iteration runtime hooks work on the DD config
+    wrapper too (reference rqp_dd.py:507-511, 754-764): setters descend into
+    cfg.base, and unset_leader removes the tracking cost."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_aerial_transport.control import cadmm as hooks
+    from tpu_aerial_transport.control import centralized, dd
+    from tpu_aerial_transport.harness import setup
+
+    params, col, state = setup.rqp_setup(3)
+    cfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=20, inner_iters=40,
+    )
+    assert hooks.set_leader(cfg, 1).base.leader_idx == 1
+    assert hooks.unset_leader(cfg).base.leader_idx == -1
+    t = hooks.set_tolerance(cfg, 5e-2)
+    assert t.base.res_tol == 5e-2 and t.prim_inf_tol == 5e-2
+    assert hooks.set_max_iter(cfg, 7).base.max_iter == 7
+
+    # Behavior: with no leader, no agent carries the tracking cost, so the
+    # solution stays closer to equilibrium than the led solve.
+    f_eq = centralized.equilibrium_forces(params)
+    acc = (jnp.array([0.6, 0.0, 0.0]), jnp.zeros(3))
+    ds = dd.init_dd_state(params, cfg)
+    step = jax.jit(
+        lambda c, d, s: dd.control(params, c, f_eq, d, s, acc)
+    )
+    f_led, _, _ = step(cfg, ds, state)
+    f_unled, _, _ = step(hooks.unset_leader(cfg), ds, state)
+    assert float(jnp.abs(f_unled - f_eq).max()) \
+        < float(jnp.abs(f_led - f_eq).max())
