@@ -139,12 +139,7 @@ def test_dd_runtime_hooks():
     """The leader/tolerance/iteration runtime hooks work on the DD config
     wrapper too (reference rqp_dd.py:507-511, 754-764): setters descend into
     cfg.base, and unset_leader removes the tracking cost."""
-    import jax
-    import jax.numpy as jnp
-
     from tpu_aerial_transport.control import cadmm as hooks
-    from tpu_aerial_transport.control import centralized, dd
-    from tpu_aerial_transport.harness import setup
 
     params, col, state = setup.rqp_setup(3)
     cfg = dd.make_config(

@@ -90,6 +90,11 @@ def make_config(
     inner_iters: int = 60,
     res_tol: float = 1e-2,
 ) -> RQPCADMMConfig:
+    """Defaults are reference-conservative (max_iter mirrors the reference's
+    100-iteration cap). For warm-started receding-horizon use, the measured
+    inner-iteration knee is ~20 (below it the agent solves miss ``solver_tol``
+    and trip the equilibrium fallback; at 20 forces match an inner=80 solve to
+    < 1e-4 N) — see bench.py / BASELINE.md."""
     n = params.n
     mTg = float(params.mT) * GRAVITY
     return RQPCADMMConfig(

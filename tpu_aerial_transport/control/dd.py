@@ -58,6 +58,10 @@ def make_config(
     inner_iters: int = 60,
     prim_inf_tol: float = 1e-2,
 ) -> RQPDDConfig:
+    """Defaults are reference-conservative. For warm-started receding-horizon
+    use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
+    needs tighter primal optima than C-ADMM's consensus (at 20 it rails
+    against the outer cap) — see bench.py / BASELINE.md."""
     from tpu_aerial_transport.control import cadmm as cadmm_mod
 
     base = cadmm_mod.make_config(
