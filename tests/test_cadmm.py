@@ -182,3 +182,24 @@ def test_leader_change_mid_rollout():
     # Consensus converged on both sides of the handoff.
     assert int(iters.max()) <= acfg.max_iter
     assert float(res[-1]) < 1e-2
+
+
+def test_two_phase_inner_budget_agrees():
+    """inner_iters_warm (cheaper solves for consensus iterations >= 2, whose
+    warm start is the same step's previous iterate) must converge to the same
+    forces as the single-budget path within the consensus tolerance."""
+    n = 3
+    params, col, _, ccfg, acfg, f_eq = _setup(n)
+    state = _random_state(jax.random.PRNGKey(7), n)
+    acc_des = (jnp.array([0.4, 0.0, 0.1]), jnp.zeros(3))
+
+    a0 = cadmm.init_cadmm_state(params, acfg)
+    f_one, _, st_one = cadmm.control(params, acfg, f_eq, a0, state, acc_des)
+
+    two = acfg.replace(inner_iters_warm=30)
+    a0b = cadmm.init_cadmm_state(params, two)
+    f_two, _, st_two = cadmm.control(params, two, f_eq, a0b, state, acc_des)
+
+    assert int(st_two.iters) <= two.max_iter
+    assert float(st_two.solve_res) < two.res_tol
+    assert float(jnp.abs(f_two - f_one).max()) < 5e-3

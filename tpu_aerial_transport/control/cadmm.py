@@ -77,6 +77,10 @@ class RQPCADMMConfig:
     n_env_cbfs: int = struct.field(pytree_node=False, default=10)
     max_iter: int = struct.field(pytree_node=False, default=100)
     inner_iters: int = struct.field(pytree_node=False, default=60)
+    # Inner ADMM budget for consensus iterations >= 2, whose warm start is the
+    # SAME control step's previous iterate (far closer than the cross-step
+    # warm start the first iteration sees). 0 = use ``inner_iters``.
+    inner_iters_warm: int = struct.field(pytree_node=False, default=0)
     solver_tol: float = struct.field(pytree_node=False, default=5e-3)
     max_f_ang: float = struct.field(pytree_node=False, default=jnp.pi / 6)
 
@@ -89,6 +93,7 @@ def make_config(
     max_iter: int = 100,
     inner_iters: int = 60,
     res_tol: float = 1e-2,
+    inner_iters_warm: int = 0,
 ) -> RQPCADMMConfig:
     """Defaults are reference-conservative (max_iter mirrors the reference's
     100-iteration cap). For warm-started receding-horizon use, the measured
@@ -123,6 +128,7 @@ def make_config(
         n_env_cbfs=n_env_cbfs,
         max_iter=max_iter,
         inner_iters=inner_iters,
+        inner_iters_warm=inner_iters_warm,
     )
 
 
@@ -448,15 +454,21 @@ def control(
     )(lb, ub)
     op = socp.kkt_operator(P, A, rho_vec)
 
-    solve_one = jax.vmap(
-        lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_: socp.solve_socp(
-            P_, q_, A_, lb_, ub_,
-            n_box=n_box, soc_dims=(4, 4), iters=cfg.inner_iters,
-            warm=warm_, shift=shift_, op=op_,
+    def make_solve(iters):
+        return jax.vmap(
+            lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_: socp.solve_socp(
+                P_, q_, A_, lb_, ub_,
+                n_box=n_box, soc_dims=(4, 4), iters=iters,
+                warm=warm_, shift=shift_, op=op_,
+            )
         )
-    )
 
-    def consensus_iter(carry):
+    solve_cold = make_solve(cfg.inner_iters)
+    warm_iters = cfg.inner_iters_warm or cfg.inner_iters
+    two_phase = warm_iters != cfg.inner_iters
+    solve_warm = make_solve(warm_iters) if two_phase else solve_cold
+
+    def consensus_iter(solve_one, carry):
         f, lam, f_mean, warm, it, res, err_buf = carry
         # Primal: augmented linear term <lam_i, f> - rho <f_mean, f>.
         q_extra = (lam - rho * f_mean[None, :, :]).reshape(n_local, 3 * n)
@@ -502,8 +514,19 @@ def control(
         admm_state.f, admm_state.lam, admm_state.f_mean, admm_state.warm,
         jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype), err_buf0,
     )
+    if not two_phase:
+        carry = init
+    else:
+        # Two-phase budget: the first consensus iteration always runs (res
+        # starts at inf), so peel it with the cold solver budget; the loop
+        # body then uses the warm budget — its warm start is THIS step's
+        # previous iterate, far closer than the cross-step start iteration 1
+        # sees. (A lax.cond on the iteration index would NOT work: under
+        # vmap it becomes a select that executes both solver branches for
+        # every lane.)
+        carry = consensus_iter(solve_cold, init)
     f, lam, f_mean, warm, iters, res, err_buf = lax.while_loop(
-        cond, lambda c: consensus_iter(c), init
+        cond, lambda c: consensus_iter(solve_warm, c), carry
     )
 
     # Applied forces: agent i applies its own column (reference :669-675).
