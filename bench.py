@@ -41,8 +41,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
+# Importing jax does NOT initialize any backend (that happens lazily on first
+# device use) — safe before the watchdog probe in ensure_backend_or_die().
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -51,6 +56,115 @@ N_AGENTS = 8
 N_SCENARIOS = 256
 TIMED_STEPS = 10
 CPU_TIMED_STEPS = 4
+
+PROBE_TIMEOUT_S = 60
+PROBE_ATTEMPTS = 2
+
+HEADLINE_METRIC = (
+    f"scenario_mpc_steps_per_sec_{N_SCENARIOS}x{N_AGENTS}_cadmm_forest"
+)
+
+
+def _fail_headline(error: str, metric: str = HEADLINE_METRIC) -> None:
+    """Emit a machine-readable failure JSON and exit nonzero — a diagnosable
+    record instead of a silent hang. ``metric`` names the mode that failed so
+    a probe failure during ``--sweep``/``--components`` is not filed as a
+    failed *headline* measurement (the unit only applies to the headline)."""
+    print(json.dumps({
+        "metric": metric,
+        "value": None,
+        "unit": ("scenario-MPC-steps/s" if metric == HEADLINE_METRIC
+                 else None),
+        "vs_baseline": None,
+        "error": error,
+    }), flush=True)
+    raise SystemExit(1)
+
+
+def ensure_backend_or_die(metric: str = HEADLINE_METRIC) -> str:
+    """Probe JAX backend availability in a subprocess under a watchdog; return
+    the platform name the probe saw (e.g. ``"axon"``/``"tpu"``/``"cpu"``).
+
+    Backend init happens lazily on first device use; when the TPU tunnel is
+    unreachable a bare ``jax.devices()`` can block far past any useful budget
+    (the round-2 driver lost its whole bench window to exactly this, see
+    BENCH_r02.json rc:1 after hanging). The probe pays one extra backend init
+    (~5-20 s when healthy) to guarantee the failure mode is a fast, diagnosable
+    JSON line rather than a timeout.
+
+    A silent JAX fallback to host CPU (accelerator plugin absent) would pass a
+    naive probe and publish CPU throughput under the TPU headline metric — so
+    a ``cpu`` platform is treated as a failure unless the caller explicitly
+    *leads* with cpu in ``JAX_PLATFORMS`` (a fallback list like ``"axon,cpu"``
+    is a TPU request, not a CPU one).
+
+    The axon site hook rewrites ``jax_platforms`` to ``"axon,cpu"`` at
+    interpreter startup, overriding the env var (see conftest.py) — both the
+    probe subprocess and :func:`_honor_jax_platforms_env` in the parent
+    counter it with a config-level override so ``JAX_PLATFORMS=cpu`` really
+    does select CPU.
+    """
+    code = (
+        "import os, jax\n"
+        "envp = os.environ.get('JAX_PLATFORMS')\n"
+        "if envp: jax.config.update('jax_platforms', envp)\n"
+        "d = jax.devices()\n"
+        "print('BACKEND_OK', d[0].platform, len(d))"
+    )
+    errors = []
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+                env=dict(os.environ),
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(
+                f"attempt {attempt + 1}: backend probe timed out after "
+                f"{PROBE_TIMEOUT_S}s (chip unreachable/wedged)"
+            )
+            continue
+        token = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("BACKEND_OK")]
+        if proc.returncode == 0 and token:
+            platform = token[0].split()[1]
+            if platform == "cpu" and not _cpu_explicitly_requested():
+                _fail_headline(
+                    "JAX silently fell back to host CPU (accelerator plugin "
+                    "absent?) and JAX_PLATFORMS does not lead with cpu — "
+                    "refusing to publish CPU throughput as the TPU headline",
+                    metric=metric,
+                )
+            return platform
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+        errors.append(
+            f"attempt {attempt + 1}: rc={proc.returncode}: " + " | ".join(tail)
+        )
+    _fail_headline("backend unavailable: " + " ;; ".join(errors),
+                   metric=metric)
+
+
+def _cpu_explicitly_requested() -> bool:
+    """True iff JAX_PLATFORMS' FIRST entry is cpu — ``"axon,cpu"`` is a
+    priority list preferring TPU, not an explicit CPU request."""
+    first = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
+    return first == "cpu"
+
+
+def _honor_jax_platforms_env() -> None:
+    """Re-apply the JAX_PLATFORMS env var at the config level in THIS process,
+    countering the axon site hook's startup rewrite, so an explicit
+    ``JAX_PLATFORMS=cpu python bench.py`` actually measures on CPU."""
+    envp = os.environ.get("JAX_PLATFORMS")
+    if envp:
+        jax.config.update("jax_platforms", envp)
+
+
+def _finite_or_none(x: float, digits: int = 2):
+    """NaN/inf -> None so the headline stays strictly valid JSON
+    (``json.dumps(float('nan'))`` emits the bare token ``NaN``)."""
+    return round(x, digits) if np.isfinite(x) else None
 
 
 def _setup(n):
@@ -281,7 +395,7 @@ def ref_arch_cpu_rate(n=N_AGENTS, max_iter=20, inner_iters=20, n_steps=5):
     return n_steps / t_total
 
 
-def headline(profile_dir: str | None = None):
+def headline(profile_dir: str | None = None, platform: str = "unknown"):
     step, css, states = build()
     if profile_dir:
         # Warm up outside the trace so the profile shows steady-state execution.
@@ -308,14 +422,17 @@ def headline(profile_dir: str | None = None):
         vs_ref = float("nan")
 
     print(json.dumps({
-        "metric": f"scenario_mpc_steps_per_sec_{N_SCENARIOS}x{N_AGENTS}_cadmm_forest",
-        "value": round(tpu_rate, 1),
+        "metric": HEADLINE_METRIC,
+        "value": _finite_or_none(tpu_rate, 1),
         "unit": "scenario-MPC-steps/s",
+        "platform": platform,
         # vs the reference's execution model (sequential native per-agent
-        # solves on CPU, BASELINE.json's 'cvxpy/Clarabel CPU baseline');
-        # vs_xla_cpu is this framework's own fused program on host CPU.
-        "vs_baseline": round(vs_ref, 2),
-        "vs_xla_cpu": round(vs_xla_cpu, 2),
+        # solves on CPU, BASELINE.json's 'cvxpy/Clarabel CPU baseline').
+        # Denominator history: r1 used TPU/XLA-CPU; r2+ use TPU/ref-arch-CPU —
+        # the explicit aliases below disambiguate cross-round comparisons.
+        "vs_baseline": _finite_or_none(vs_ref),
+        "vs_ref_arch_cpu": _finite_or_none(vs_ref),
+        "vs_xla_cpu": _finite_or_none(vs_xla_cpu),
     }))
 
 
@@ -510,12 +627,17 @@ def main():
     ap.add_argument("--components", action="store_true")
     ap.add_argument("--profile", default=None, metavar="DIR")
     args = ap.parse_args()
+    _honor_jax_platforms_env()
+    mode_metric = ("bench_sweep" if args.sweep
+                   else "bench_components" if args.components
+                   else HEADLINE_METRIC)
+    platform = ensure_backend_or_die(metric=mode_metric)
     if args.sweep:
         sweep()
     elif args.components:
         components()
     else:
-        headline(args.profile)
+        headline(args.profile, platform=platform)
 
 
 if __name__ == "__main__":
