@@ -215,10 +215,13 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             inner_iters=inner_iters if inner_iters is not None else 20,
         )
         cs0 = cadmm.init_cadmm_state(params, cfg)
+        # Precompute the state-independent Schur plan once, outside the
+        # rollout scan (None at n = 3, where the full-QP path runs).
+        plan = cadmm.make_plan(params, cfg)
 
         def mpc_step(cs, state):
             f_app, cs, stats = cadmm.control(
-                params, cfg, f_eq, cs, state, acc_des, forest
+                params, cfg, f_eq, cs, state, acc_des, forest, plan=plan
             )
             return cs, _substeps(params, ll, state, f_app), stats
 
@@ -229,10 +232,11 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             inner_iters=inner_iters if inner_iters is not None else 40,
         )
         cs0 = dd.init_dd_state(params, cfg)
+        plan = dd.make_dd_plan(params, cfg)  # state-independent QN cores.
 
         def mpc_step(cs, state):
             f_des, cs, stats = dd.control(
-                params, cfg, f_eq, cs, state, acc_des, forest
+                params, cfg, f_eq, cs, state, acc_des, forest, plan=plan
             )
             return cs, _substeps(params, ll, state, f_des), stats
 
@@ -621,21 +625,183 @@ def components():
           )(jitter(ss, eps)), states)
 
 
+# One v5e chip (the bench device): peak dense f32 MXU throughput and HBM
+# bandwidth used for %-of-peak numbers. The package pins matmul precision to
+# f32 ("highest" — see tpu_aerial_transport/__init__.py), so the f32 peak is
+# the honest ceiling; the bf16 peak is 4x higher but unusable for the stiff
+# small-inertia dynamics here.
+PEAK_F32_FLOPS = 49e12
+PEAK_HBM_BPS = 819e9
+
+
+def roofline(out_path: str = "artifacts/roofline.json"):
+    """FLOPs / HBM-bytes attribution and %-of-peak for the headline step and
+    its components, from XLA's own compiled-program cost model
+    (``compiled.cost_analysis()``) plus measured wall time. Writes JSON and
+    prints a markdown table for BASELINE.md (SURVEY.md §5.1 tracing tier)."""
+    from tpu_aerial_transport.control import cadmm
+    from tpu_aerial_transport.models import rqp
+
+    dev = jax.devices()[0]
+    results = {}
+
+    def analyze(name, fn, args, n_units, unit_desc, inner: int = 1):
+        """n_units = logical steps per call (for per-step normalization).
+        ``inner`` > 1 re-runs fn inside a jitted lax.scan to amortize the
+        ~100 ms per-dispatch latency through the device tunnel (a
+        runtime-zero eps threads the carry so XLA cannot hoist the body);
+        FLOPs/bytes come from the UN-scanned program's cost analysis.
+        Caveat: XLA's cost model counts a while_loop body ONCE (trip count
+        unknown at compile time), so FLOPs/bytes for the consensus loop are
+        per-iteration lower bounds."""
+        jitted = jax.jit(fn) if not hasattr(fn, "lower") else fn
+        ca = jitted.lower(*args).compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else (ca or {})
+        flops = float(ca.get("flops", float("nan")))
+        hbm = float(ca.get("bytes accessed", float("nan")))
+        if inner > 1:
+            def scanned(*xs):
+                def body(eps, _):
+                    # eps (runtime zero) perturbs every float input so the
+                    # body is loop-variant — XLA cannot hoist it and run once.
+                    xs_eps = jax.tree.map(
+                        lambda a: a + eps
+                        if (hasattr(a, "dtype")
+                            and jnp.issubdtype(a.dtype, jnp.floating)) else a,
+                        xs,
+                    )
+                    out = fn(*xs_eps)
+                    leaves = [l for l in jax.tree.leaves(out)
+                              if hasattr(l, "dtype")
+                              and jnp.issubdtype(l.dtype, jnp.floating)]
+                    tot = sum(jnp.sum(jnp.abs(l)) for l in leaves) + eps
+                    return tot * 1e-38, None
+
+                eps, _ = jax.lax.scan(
+                    body, jnp.float32(0.0), None, length=inner
+                )
+                return eps
+
+            timed_fn = jax.jit(scanned)
+        else:
+            timed_fn = jitted
+        out = timed_fn(*args)
+        jax.block_until_ready(out)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = timed_fn(*args)
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
+        sec = float(np.median(ts)) / inner
+        ai = flops / hbm
+        rec = {
+            "unit": unit_desc,
+            "flops_per_unit": flops / n_units,
+            "hbm_bytes_per_unit": hbm / n_units,
+            "arithmetic_intensity_flops_per_byte": ai,
+            "wall_s_per_call": sec,
+            "achieved_gflops": flops / sec / 1e9,
+            "achieved_hbm_gbps": hbm / sec / 1e9,
+            "pct_of_f32_peak_flops": 100.0 * flops / sec / PEAK_F32_FLOPS,
+            "pct_of_hbm_peak": 100.0 * hbm / sec / PEAK_HBM_BPS,
+            # Machine balance (f32): ~60 flops/byte on v5e. Below it the
+            # kernel is bandwidth-bound, above it compute-bound.
+            "roofline_side": ("compute-bound" if ai > PEAK_F32_FLOPS / PEAK_HBM_BPS
+                              else "bandwidth-bound"),
+        }
+        results[name] = rec
+        print(f"# {name}: {json.dumps(rec)}", flush=True)
+
+    # Headline: 256 x 8 C-ADMM forest rollout, TIMED_STEPS MPC steps.
+    step, css, states = build()
+    css = jax.device_put(css, dev)
+    states = jax.device_put(states, dev)
+    analyze(
+        "headline_256x8_cadmm_rollout",
+        step, (css, states, TIMED_STEPS),
+        TIMED_STEPS * N_SCENARIOS, "scenario-MPC-step",
+    )
+
+    # Components at the headline config (same split as --components).
+    params, col, state0, forest, f_eq, ll, acc_des = _setup(N_AGENTS)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=20, inner_iters=20,
+    )
+    plan = cadmm.make_plan(params, cfg)
+    states_b = _scenario_batch(state0, N_SCENARIOS)
+    css_b = jax.vmap(lambda _: cadmm.init_cadmm_state(params, cfg))(
+        jnp.arange(N_SCENARIOS)
+    )
+    analyze(
+        "cadmm_control_batch256",
+        lambda a, s: jax.vmap(
+            lambda ai_, si: cadmm.control(
+                params, cfg, f_eq, ai_, si, acc_des, forest, plan=plan
+            )[0]
+        )(a, s),
+        (css_b, states_b), N_SCENARIOS, "scenario-control-step", inner=10,
+    )
+    analyze(
+        "env_query_batch256",
+        lambda s: jax.vmap(
+            lambda si: cadmm.agent_env_cbfs(params, cfg, forest, si).lhs
+        )(s),
+        (states_b,), N_SCENARIOS, "scenario-env-query", inner=10,
+    )
+    analyze(
+        "lowlevel_physics_x10_batch256",
+        lambda s: jax.vmap(lambda si: _substeps(params, ll, si, f_eq).xl)(s),
+        (states_b,), N_SCENARIOS, "scenario-physics-period", inner=10,
+    )
+
+    import os
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump({
+            "peak_f32_flops": PEAK_F32_FLOPS,
+            "peak_hbm_bytes_per_s": PEAK_HBM_BPS,
+            "machine_balance_flops_per_byte": PEAK_F32_FLOPS / PEAK_HBM_BPS,
+            "note": ("flops / 'bytes accessed' from XLA cost_analysis of the "
+                     "compiled program; wall time measured on the chip; "
+                     "dispatch overhead amortized over the scan/batch"),
+            "results": results,
+        }, fh, indent=1)
+    print(f"roofline written to {out_path}")
+
+    print("\n| Component | FLOPs/unit | HBM B/unit | AI (F/B) | GFLOP/s "
+          "| %f32 peak | GB/s | %HBM peak | side |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    for name, r in results.items():
+        print(f"| {name} | {r['flops_per_unit']:.3g} | "
+              f"{r['hbm_bytes_per_unit']:.3g} | "
+              f"{r['arithmetic_intensity_flops_per_byte']:.1f} | "
+              f"{r['achieved_gflops']:.0f} | "
+              f"{r['pct_of_f32_peak_flops']:.1f} | "
+              f"{r['achieved_hbm_gbps']:.0f} | {r['pct_of_hbm_peak']:.1f} | "
+              f"{r['roofline_side']} |")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sweep", action="store_true")
     ap.add_argument("--components", action="store_true")
+    ap.add_argument("--roofline", action="store_true")
     ap.add_argument("--profile", default=None, metavar="DIR")
     args = ap.parse_args()
     _honor_jax_platforms_env()
     mode_metric = ("bench_sweep" if args.sweep
                    else "bench_components" if args.components
+                   else "bench_roofline" if args.roofline
                    else HEADLINE_METRIC)
     platform = ensure_backend_or_die(metric=mode_metric)
     if args.sweep:
         sweep()
     elif args.components:
         components()
+    elif args.roofline:
+        roofline()
     else:
         headline(args.profile, platform=platform)
 

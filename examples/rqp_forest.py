@@ -33,6 +33,9 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=0, help="forest seed")
     p.add_argument("--out", default=None, help="npz log path")
     p.add_argument("--plots", action="store_true", help="save figures")
+    p.add_argument("--time-chunk", type=int, default=10, metavar="C",
+                   help="MPC steps per timed scan chunk for the wall-clock "
+                        "statistics (0 disables the timing pass)")
     args = p.parse_args()
 
     from tpu_aerial_transport.control import cadmm, centralized, dd, lowlevel
@@ -68,14 +71,18 @@ def main() -> None:
             params, col.collision_radius, col.max_deceleration
         )
         cs0 = cadmm.init_cadmm_state(params, cfg)
+        plan = cadmm.make_plan(params, cfg)
         hl = lambda cs, s, acc: cadmm.control(
-            params, cfg, f_eq, cs, s, acc, forest
+            params, cfg, f_eq, cs, s, acc, forest, plan=plan
         )
         dist_eps = cfg.dist_eps
     else:
         cfg = dd.make_config(params, col.collision_radius, col.max_deceleration)
         cs0 = dd.init_dd_state(params, cfg)
-        hl = lambda cs, s, acc: dd.control(params, cfg, f_eq, cs, s, acc, forest)
+        dd_plan = dd.make_dd_plan(params, cfg)
+        hl = lambda cs, s, acc: dd.control(
+            params, cfg, f_eq, cs, s, acc, forest, plan=dd_plan
+        )
         dist_eps = cfg.base.dist_eps
 
     n_hl_steps = int(args.T / (args.dt * args.hl_rel_freq))
@@ -101,6 +108,38 @@ def main() -> None:
                             compute_aggregate_statistics(iters[iters >= 0]))
         print(f"Solver iterations: min: {mn:5.2f}, max: {mx:5.2f}, "
               f"avg: {avg:5.2f}, std: {std:5.2f}")
+
+    # Per-MPC-step wall-clock statistics (the reference prints Clarabel's
+    # per-solve times the same way, rqp_example.py:62-80). Host timing of a
+    # single fused step would mostly measure ~100 ms dispatch latency through
+    # the device tunnel, so the rollout re-runs as jitted scan CHUNKS of
+    # --time-chunk MPC steps, each timed on the host; every sample below is a
+    # chunk's wall time divided by its step count.
+    if args.time_chunk > 0:
+        chunk = min(args.time_chunk, n_hl_steps)
+        run_chunk = jax.jit(
+            lambda s0, c0: ro.rollout(
+                hl, ll.control, params, s0, c0, n_hl_steps=chunk,
+                hl_rel_freq=args.hl_rel_freq, dt=args.dt,
+                acc_des_fn=acc_des_fn,
+            )
+        )
+        s, c, _ = run_chunk(state0, cs0)  # compile at the chunk length.
+        jax.block_until_ready(s.xl)
+        s, c = state0, cs0
+        samples = []
+        for _ in range(max(2, n_hl_steps // chunk)):
+            t0 = time.perf_counter()
+            s, c, _ = run_chunk(s, c)
+            jax.block_until_ready(s.xl)
+            samples.append((time.perf_counter() - t0) / chunk)
+        mn, mx, avg, std = (
+            1e3 * float(x)
+            for x in compute_aggregate_statistics(np.asarray(samples))
+        )
+        print(f"Solve time per MPC step [ms] (chunks of {chunk}): "
+              f"min: {mn:6.3f}, max: {mx:6.3f}, avg: {avg:6.3f}, "
+              f"std: {std:6.3f}")
     print(f"final payload position: {np.asarray(final.xl)}")
     print(f"min env distance over run: {float(np.min(np.asarray(logs.min_env_dist))):.3f} m "
           f"(eps = {dist_eps})")

@@ -133,9 +133,13 @@ def test_leader_hooks_and_setters():
     f0, _, _ = step(acfg, a0, state)
 
     # Same compiled step, different leader — no retrace (leader_idx is a leaf).
-    n_traces = step._cache_size()
+    # _cache_size is a private jax API: skip the retrace assertion (not the
+    # test) if a jax upgrade removes it, rather than failing the suite.
+    has_cache_api = hasattr(step, "_cache_size")
+    n_traces = step._cache_size() if has_cache_api else None
     f1, _, _ = step(cadmm.set_leader(acfg, 1), a0, state)
-    assert step._cache_size() == n_traces, "leader change retraced the step"
+    if has_cache_api:
+        assert step._cache_size() == n_traces, "leader change retraced the step"
     assert not bool(jnp.allclose(f0, f1, atol=1e-4)), \
         "leader change did not alter the solution"
 

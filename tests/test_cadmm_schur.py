@@ -1,0 +1,183 @@
+"""Schur-reduced C-ADMM per-agent QP tests (n >= 4 path).
+
+The reduction eliminates the other agents' unconstrained force columns from
+each agent's per-iteration solve by exact partial minimization (see
+cadmm.SchurQP); these tests pin the exactness claim: the reduced QP +
+reconstruction must reproduce the full (9+3n)-var QP's solution, and the
+reduced consensus loop must agree with the centralized controller."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_aerial_transport.control import cadmm, centralized
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.ops import lie, socp
+
+
+def _setup(n):
+    params, col, state = setup.rqp_setup(n)
+    acfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=60, inner_iters=80, res_tol=1e-3,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    return params, col, state, acfg, f_eq
+
+
+def _random_state(key, n):
+    ks = jax.random.split(key, 4)
+    return rqp.rqp_state(
+        R=lie.expm_so3(0.1 * jax.random.normal(ks[0], (n, 3))),
+        w=0.1 * jax.random.normal(ks[1], (n, 3)),
+        xl=jnp.zeros(3),
+        vl=0.3 * jax.random.normal(ks[2], (3,)),
+        Rl=lie.expm_so3(0.05 * jax.random.normal(ks[3], (3,))),
+        wl=0.05 * jax.random.normal(jax.random.fold_in(key, 9), (3,)),
+    )
+
+
+def test_reduced_qp_matches_full_qp():
+    """Direct exactness check: for random states and consensus linear terms,
+    the 12-var reduced QP + closed-form reconstruction of the eliminated
+    columns reproduces the full (9+3n)-var QP solution."""
+    n = 5
+    params, col, _, acfg, f_eq = _setup(n)
+    from tpu_aerial_transport.control.types import inactive_env_cbf
+
+    for seed in range(3):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+        state = _random_state(ks[0], n)
+        acc_des = (0.4 * jax.random.normal(ks[1], (3,)), jnp.zeros(3))
+        lam = 0.1 * jax.random.normal(ks[2], (n, 3))
+        f_mean = f_eq + 0.05 * jax.random.normal(ks[3], (n, 3))
+        rho = jnp.float32(acfg.rho0)
+        cbf = inactive_env_cbf(
+            acfg.n_env_cbfs, acfg.vision_radius, acfg.dist_eps,
+            acfg.alpha_env_cbf, dtype=jnp.float32,
+        )
+        agent_id = jnp.int32(1)
+        is_leader = jnp.float32(0.0)
+        delta = lam - rho * f_mean  # (n, 3)
+
+        # Full QP for agent 1.
+        onehot = jax.nn.one_hot(agent_id, n, dtype=jnp.float32)
+        P, q0, A, lb, ub, shift = cadmm._build_agent_qp(
+            params, acfg, f_eq, state, acc_des, cbf, onehot, is_leader, rho
+        )
+        q = q0.at[9:].add(delta.reshape(-1))
+        sol_full = socp.solve_socp(
+            P, q, A, lb, ub, n_box=13 + acfg.n_env_cbfs, soc_dims=(4, 4),
+            iters=4000, shift=shift,
+        )
+        f_full = sol_full.x[9:].reshape(n, 3)
+        c_full = sol_full.x[:9]
+
+        # Reduced QP (payload-frame plan) + reconstruction.
+        plan = cadmm.make_schur_plan(params, acfg)
+        pk = jax.tree.map(lambda x: x[0, int(agent_id)], plan)
+        Rl = state.Rl
+        Ecc, e0s, xq = cadmm._schur_state_pieces(
+            params, acfg, state, plan.scale[0, 0]
+        )
+        Pr, q0r, Ar, lbr, ubr, shiftr = cadmm._schur_step_qp(
+            params, acfg, pk, f_eq, state, acc_des, cbf, agent_id,
+            is_leader, rho, Ecc, e0s, xq,
+        )
+        dperm = delta[pk.perm]
+        d_u = dperm[0]
+        d_v = jnp.einsum("ij,nj->ni", Rl.T, dperm[1:]).reshape(-1)
+        q_red = q0r + jnp.concatenate(
+            [-Ecc.T @ (pk.J.T @ d_v), d_u - Rl @ (pk.Mu @ d_v)]
+        )
+        sol_red = socp.solve_socp(
+            Pr, q_red, Ar, lbr, ubr,
+            n_box=7 + acfg.n_env_cbfs, soc_dims=(4, 4), iters=4000,
+            shift=shiftr,
+        )
+        c_red, u = sol_red.x[:9], sol_red.x[9:12]
+        ut = Rl.T @ u
+        d6 = e0s - Ecc @ c_red - pk.Eu @ ut
+        vt = -pk.Nsum @ xq - pk.N @ d_v - pk.NCt @ ut + pk.J @ d6
+        v = jnp.einsum("ij,nj->ni", Rl, vt.reshape(n - 1, 3))
+        f_red = jnp.zeros((n, 3)).at[pk.perm].set(
+            jnp.concatenate([u[None], v])
+        )
+
+        err_f = float(jnp.abs(f_full - f_red).max())
+        err_c = float(jnp.abs(c_full - c_red).max())
+        assert err_f < 5e-3, f"seed {seed}: force mismatch {err_f}"
+        # Accel vars are only pinned through (scaled) equality rows, so the
+        # f32 ADMM fixed point leaves them ~2x looser than the forces.
+        assert err_c < 2e-2, f"seed {seed}: accel mismatch {err_c}"
+
+
+def test_reduced_control_agrees_with_centralized():
+    """n = 5 uses the reduced path by default; consensus forces must match the
+    centralized QP solution (the reference's own implicit invariant)."""
+    n = 5
+    params, col, _, acfg, f_eq = _setup(n)
+    assert cadmm._use_reduced(acfg, n)
+    ccfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=250
+    )
+    for seed in range(2):
+        ks = jax.random.split(jax.random.PRNGKey(seed + 10), 2)
+        state = _random_state(ks[0], n)
+        acc_des = (0.5 * jax.random.normal(ks[1], (3,)), jnp.zeros(3))
+        cs = centralized.init_ctrl_state(params, ccfg)
+        f_cent, _, _ = centralized.control(params, ccfg, f_eq, cs, state, acc_des)
+        astate = cadmm.init_cadmm_state(params, acfg)
+        f_admm, astate, stats = cadmm.control(
+            params, acfg, f_eq, astate, state, acc_des
+        )
+        assert int(stats.iters) < 61, "consensus did not converge"
+        err = float(jnp.abs(f_admm - f_cent).max())
+        assert err < 5e-2, f"seed {seed}: |f_admm - f_cent| = {err}"
+
+
+def test_reduced_matches_full_control():
+    """Forcing reduced_qp True/False at the same n must give the same
+    consensus forces (both formulations solve identical per-agent problems)."""
+    n = 5
+    params, col, _, acfg, f_eq = _setup(n)
+    state = _random_state(jax.random.PRNGKey(42), n)
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+
+    cfg_red = acfg.replace(reduced_qp=True)
+    cfg_full = acfg.replace(reduced_qp=False)
+    a_red = cadmm.init_cadmm_state(params, cfg_red)
+    a_full = cadmm.init_cadmm_state(params, cfg_full)
+    f_red, _, st_red = cadmm.control(params, cfg_red, f_eq, a_red, state, acc_des)
+    f_full, _, st_full = cadmm.control(
+        params, cfg_full, f_eq, a_full, state, acc_des
+    )
+    assert int(st_red.iters) < acfg.max_iter
+    assert int(st_full.iters) < acfg.max_iter
+    err = float(jnp.abs(f_red - f_full).max())
+    assert err < 1e-2, f"|f_reduced - f_full| = {err}"
+
+
+def test_reduced_warm_start_shapes_and_rollout():
+    """init_cadmm_state sizes the warm start for the reduced QP; a short jitted
+    closed-loop rollout at n = 6 stays finite and converges."""
+    n = 6
+    params, col, state0, acfg, f_eq = _setup(n)
+    astate = cadmm.init_cadmm_state(params, acfg)
+    assert astate.warm.x.shape == (n, 12)
+    assert astate.warm.y.shape == (n, 7 + acfg.n_env_cbfs + 8)
+    acc_des = (jnp.array([0.2, 0.0, 0.0]), jnp.zeros(3))
+
+    def body(carry, _):
+        astate, state = carry
+        f, astate, stats = cadmm.control(params, acfg, f_eq, astate, state, acc_des)
+        fz = jnp.sum(f * state.R[..., :, 2], axis=-1)
+        state = rqp.integrate(params, state, (fz, jnp.zeros((n, 3))), 1e-3)
+        return (astate, state), (f, stats.iters)
+
+    (a_fin, s_fin), (fs, iters) = jax.jit(
+        lambda c: jax.lax.scan(body, c, None, length=4)
+    )((astate, state0))
+    assert bool(jnp.all(jnp.isfinite(fs)))
+    assert bool(jnp.all(jnp.isfinite(s_fin.xl)))
+    assert int(iters.max()) < acfg.max_iter

@@ -1,0 +1,98 @@
+"""C-ADMM rho schedule parity (reference rqp_cadmm.py:565-567, :657):
+``rho_{k+1} = min(rho_k tau_incr, rho_max)``. tau_incr = 1 (the reference
+default) must reproduce the constant-rho path exactly; tau_incr > 1 must still
+reach consensus agreeing with the centralized solution."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_aerial_transport.control import cadmm, centralized
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.ops import lie
+
+
+def _setup(n):
+    params, col, state = setup.rqp_setup(n)
+    acfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=60, inner_iters=80, res_tol=1e-3,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    return params, col, state, acfg, f_eq
+
+
+def _random_state(key, n):
+    ks = jax.random.split(key, 4)
+    return rqp.rqp_state(
+        R=lie.expm_so3(0.1 * jax.random.normal(ks[0], (n, 3))),
+        w=0.1 * jax.random.normal(ks[1], (n, 3)),
+        xl=jnp.zeros(3),
+        vl=0.3 * jax.random.normal(ks[2], (3,)),
+        Rl=lie.expm_so3(0.05 * jax.random.normal(ks[3], (3,))),
+        wl=jnp.zeros(3),
+    )
+
+
+def test_schedule_values():
+    params, col, _, acfg, _ = _setup(3)
+    assert cadmm._rho_schedule(acfg) == [1.0]
+    sched = cadmm._rho_schedule(acfg.replace(tau_incr=1.5))
+    # 1.0 -> 1.5 -> capped at 2.0, then saturates.
+    assert sched == [1.0, 1.5, 2.0]
+    assert cadmm._rho_schedule(acfg.replace(tau_incr=1.5, rho0=2.0)) == [2.0]
+
+
+def test_tau_one_reproduces_constant_rho_path():
+    """tau_incr = 1 must be bit-identical to the (previous) constant-rho
+    build — the schedule machinery collapses to a single precomputed QP."""
+    for n in (3, 5):  # full and reduced formulations.
+        params, col, _, acfg, f_eq = _setup(n)
+        state = _random_state(jax.random.PRNGKey(n), n)
+        acc_des = (jnp.array([0.4, 0.0, 0.1]), jnp.zeros(3))
+        a0 = cadmm.init_cadmm_state(params, acfg)
+        f_a, _, st_a = cadmm.control(params, acfg, f_eq, a0, state, acc_des)
+        explicit = acfg.replace(tau_incr=1.0, rho_max=2.0)
+        f_b, _, st_b = cadmm.control(params, explicit, f_eq, a0, state, acc_des)
+        assert float(jnp.abs(f_a - f_b).max()) == 0.0, n
+        assert int(st_a.iters) == int(st_b.iters), n
+
+
+def test_tau_incr_agrees_with_centralized():
+    """An increasing rho schedule changes the ADMM trajectory but must still
+    converge to the same (centralized) solution."""
+    for n in (3, 5):
+        params, col, _, acfg, f_eq = _setup(n)
+        ccfg = centralized.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            solver_iters=250,
+        )
+        state = _random_state(jax.random.PRNGKey(n + 20), n)
+        acc_des = (0.5 * jax.random.normal(jax.random.PRNGKey(n + 30), (3,)),
+                   jnp.zeros(3))
+        cs = centralized.init_ctrl_state(params, ccfg)
+        f_cent, _, _ = centralized.control(params, ccfg, f_eq, cs, state, acc_des)
+        sched = acfg.replace(tau_incr=1.2, rho_max=2.0)
+        a0 = cadmm.init_cadmm_state(params, sched)
+        f_admm, _, stats = cadmm.control(params, sched, f_eq, a0, state, acc_des)
+        assert int(stats.iters) <= sched.max_iter, n
+        err = float(jnp.abs(f_admm - f_cent).max())
+        assert err < 5e-2, f"n={n}: |f_admm - f_cent| = {err}"
+        # The schedule actually visited multiple rho values.
+        assert len(cadmm._rho_schedule(sched)) > 1
+
+
+def test_config_guards():
+    import pytest
+
+    params, col, _, acfg, _ = _setup(3)
+    # Decaying schedules are rejected loudly (the reference only increases).
+    with pytest.raises(ValueError, match="tau_incr"):
+        cadmm._rho_schedule(acfg.replace(tau_incr=0.5))
+    # The Schur plan refuses n = 3 (singular E_v) instead of returning NaNs.
+    with pytest.raises(ValueError, match="n >= 4"):
+        cadmm.make_schur_plan(params, acfg)
+    # Public factory: None selects the full path at n = 3.
+    assert cadmm.make_plan(params, acfg) is None
+    params5, _, _, acfg5, _ = _setup(5)[:5]
+    assert cadmm.make_plan(params5, acfg5) is not None

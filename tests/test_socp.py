@@ -181,3 +181,65 @@ def test_early_exit_matches_fixed():
         P, q, A, lb, ub, n_box=9, iters=1000, check_every=50, tol=1e-4
     )
     assert jnp.abs(fixed.x - early.x).max() < 5e-3
+
+
+def test_explicit_inverse_matches_f64_cholesky_on_production_kkt():
+    """Accuracy regression for the explicit f32 KKT inverse (see the design
+    note in ops/socp.py): on the PRODUCTION per-agent KKT matrices (whose
+    conditioning depends on EQ_RHO_SCALE and the problem scaling), the f32
+    ``Minv @ rhs`` must track a float64 Cholesky solve. If a config change
+    worsens conditioning, this fails loudly instead of agents silently
+    tripping the equilibrium-fallback path."""
+    import numpy as np
+    import scipy.linalg
+
+    from tpu_aerial_transport.control import cadmm, centralized
+    from tpu_aerial_transport.control.types import inactive_env_cbf
+    from tpu_aerial_transport.harness import setup
+
+    rng = np.random.default_rng(0)
+    for n in (3, 8):  # full (n=3) and Schur-reduced (n=8) formulations.
+        params, col, state = setup.rqp_setup(n)
+        acfg = cadmm.make_config(
+            params, col.collision_radius, col.max_deceleration
+        )
+        f_eq = centralized.equilibrium_forces(params)
+        cbf = inactive_env_cbf(
+            acfg.n_env_cbfs, acfg.vision_radius, acfg.dist_eps,
+            acfg.alpha_env_cbf, dtype=jnp.float32,
+        )
+        rho = jnp.float32(acfg.rho0)
+        if cadmm._use_reduced(acfg, n):
+            plan = cadmm.make_schur_plan(params, acfg)
+            pk = jax.tree.map(lambda x: x[0, 0], plan)
+            Ecc, e0s, xq = cadmm._schur_state_pieces(
+                params, acfg, state, plan.scale[0, 0]
+            )
+            P, _, A, lb, ub, _ = cadmm._schur_step_qp(
+                params, acfg, pk, f_eq, state, (jnp.zeros(3), jnp.zeros(3)),
+                cbf, jnp.int32(0), jnp.float32(1.0), rho, Ecc, e0s, xq,
+            )
+            n_box = 7 + acfg.n_env_cbfs
+        else:
+            onehot = jax.nn.one_hot(0, n, dtype=jnp.float32)
+            P, _, A, lb, ub, _ = cadmm._build_agent_qp(
+                params, acfg, f_eq, state, (jnp.zeros(3), jnp.zeros(3)), cbf,
+                onehot, jnp.float32(1.0), rho,
+            )
+            n_box = 13 + acfg.n_env_cbfs
+        m = A.shape[0]
+        rho_vec = socp.make_rho_vec(m, n_box, lb, ub, 0.4, jnp.float32)
+        op = socp.kkt_operator(P, A, rho_vec)
+
+        M64 = (np.asarray(P, np.float64)
+               + float(op.sigma) * np.eye(P.shape[0])
+               + np.asarray(A, np.float64).T
+               @ np.diag(np.asarray(rho_vec, np.float64))
+               @ np.asarray(A, np.float64))
+        cho = scipy.linalg.cho_factor(M64)
+        for _ in range(5):
+            rhs = rng.normal(size=P.shape[0])
+            x32 = np.asarray(op.Minv, np.float64) @ rhs
+            x64 = scipy.linalg.cho_solve(cho, rhs)
+            rel = np.abs(x32 - x64).max() / max(np.abs(x64).max(), 1e-12)
+            assert rel < 1e-3, f"n={n}: f32 inverse rel err {rel:.2e}"

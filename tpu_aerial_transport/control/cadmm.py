@@ -15,10 +15,17 @@ Per outer iteration (reference ``control``, :631-675):
 TPU mapping (SURVEY.md §2.10): the reference's sequential per-agent loop becomes a
 ``vmap`` over the agent axis (one fused kernel for all n primal SOCPs); the
 consensus mean/max are ``jnp`` reductions on-chip (and ``lax.psum``/``pmax`` over a
-mesh axis in the ``parallel`` layer). Because the reference's default rho schedule
-is constant (``rho0 = 1, tau_incr = 1``, :565-567), each agent's KKT matrix is
-fixed within a control step: we factor all n of them once (vmapped Cholesky) and
-reuse across every consensus iteration — only the linear term moves.
+mesh axis in the ``parallel`` layer). The rho schedule
+``rho_{k+1} = min(rho_k tau_incr, rho_max)`` (:565-567, :657) visits a small
+static set of values (one, at the reference default tau_incr = 1), so every
+agent's KKT operator is precomputed per distinct rho once per control step and
+selected per iteration — only the linear term moves between iterations.
+
+For n >= 4 each agent's per-iteration QP is Schur-reduced to a constant 12
+variables (see :class:`SchurQP`): the other agents' force columns carry no
+constraints of their own and are eliminated by exact partial minimization,
+then reconstructed in closed form for the consensus step — the per-agent
+solve cost is O(1) in n instead of O((9+3n)^2).
 
 All controller state (local copies, duals, means, per-agent warm starts) persists
 across control steps in :class:`CADMMState`, matching the reference's warm-start
@@ -26,6 +33,8 @@ behavior (:576-580 and cvxpy ``warm_start=True``).
 """
 
 from __future__ import annotations
+
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +45,10 @@ from tpu_aerial_transport.control.types import EnvCBF, SolverStats, inactive_env
 from tpu_aerial_transport.envs import forest as forest_mod
 from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
 from tpu_aerial_transport.ops import lie, socp
-from tpu_aerial_transport.control.centralized import equilibrium_forces
+from tpu_aerial_transport.control.centralized import (
+    equilibrium_forces,
+    smooth_block,
+)
 
 
 @struct.dataclass
@@ -65,18 +77,42 @@ class RQPCADMMConfig:
     k_feq: float
     k_dvl: float
     k_dwl: float
-    rho0: float
-    res_tol: float
+    # ADMM penalty schedule (reference rqp_cadmm.py:565-567, :657):
+    # rho_{k+1} = min(rho_k * tau_incr, rho_max), constant by default
+    # (tau_incr = 1). STATIC fields: the set of distinct rho values the capped
+    # schedule can visit must be concrete at trace time — per-agent KKT
+    # operators are precomputed for each distinct value and selected per
+    # consensus iteration (memory scales with that count, so keep tau_incr
+    # coarse; the reference default visits exactly one value).
+    rho0: float = struct.field(pytree_node=False, default=1.0)
+    tau_incr: float = struct.field(pytree_node=False, default=1.0)
+    rho_max: float = struct.field(pytree_node=False, default=2.0)
+    res_tol: float = 1e-2
     # Dynamic leader index (reference static index 0, rqp_cadmm.py:556-558,
     # with runtime set_leader/unset_leader hooks :503-507). A pytree LEAF, not
     # a static field, so a leader change mid-rollout (via :func:`set_leader`)
     # re-uses the compiled step; -1 means no leader (no agent carries the
     # tracking cost).
     leader_idx: int = 0
+    # Optional force-smoothing cost on the agent's OWN column (reference
+    # rqp_cadmm.py:455-462 / rqp_dd.py:451-457, default 0 with the in-code
+    # note "Controller is more stable without smoothing"):
+    #   k_smooth ||(R_i exp3(w_i dt))[:, :2]^T f_i||^2.
+    k_smooth: float = 0.0
+    dt: float = 1e-3  # smoothing-axis prediction horizon (reference :287-293).
     # Static fields.
     n_env_cbfs: int = struct.field(pytree_node=False, default=10)
     max_iter: int = struct.field(pytree_node=False, default=100)
     inner_iters: int = struct.field(pytree_node=False, default=60)
+    # Per-agent QP formulation: None = auto (Schur-reduced constant-size QP
+    # for n >= 4, full (9+3n)-var QP otherwise), True/False forces. The
+    # reduction eliminates the other agents' force columns — which carry no
+    # constraints of their own (reference rqp_cadmm.py:394-404; they enter
+    # only the dynamics equalities and quadratic costs) — by exact partial
+    # minimization, leaving a 12-var QP per agent regardless of n. n = 3 is
+    # excluded: its 6x6 coupling block E_v is built from hat(r_j - r_k)
+    # pairs and is singular, so the elimination needs the full path there.
+    reduced_qp: bool | None = struct.field(pytree_node=False, default=None)
     # Inner ADMM budget for consensus iterations >= 2, whose warm start is the
     # SAME control step's previous iterate (far closer than the cross-step
     # warm start the first iteration sees). 0 = use ``inner_iters``.
@@ -94,6 +130,12 @@ def make_config(
     inner_iters: int = 60,
     res_tol: float = 1e-2,
     inner_iters_warm: int = 0,
+    reduced_qp: bool | None = None,
+    k_smooth: float = 0.0,
+    dt: float = 1e-3,
+    rho0: float = 1.0,
+    tau_incr: float = 1.0,
+    rho_max: float = 2.0,
 ) -> RQPCADMMConfig:
     """Defaults are reference-conservative (max_iter mirrors the reference's
     100-iteration cap). For warm-started receding-horizon use, the measured
@@ -123,13 +165,41 @@ def make_config(
         k_feq=0.1,
         k_dvl=1.0,
         k_dwl=1.0,
-        rho0=1.0,
+        rho0=rho0,
+        tau_incr=tau_incr,
+        rho_max=rho_max,
         res_tol=res_tol,
+        k_smooth=k_smooth,
+        dt=dt,
         n_env_cbfs=n_env_cbfs,
         max_iter=max_iter,
         inner_iters=inner_iters,
         inner_iters_warm=inner_iters_warm,
+        reduced_qp=reduced_qp,
     )
+
+
+def _use_reduced(cfg: RQPCADMMConfig, n: int) -> bool:
+    """Static (trace-time) decision for the per-agent QP formulation."""
+    return cfg.reduced_qp if cfg.reduced_qp is not None else n >= 4
+
+
+def _rho_schedule(cfg: RQPCADMMConfig) -> list[float]:
+    """The distinct rho values ``rho_k = min(rho0 tau_incr^k, rho_max)`` can
+    visit before saturating (reference rqp_cadmm.py:657) — a concrete Python
+    list (rho0/tau_incr/rho_max are static fields), length 1 when tau_incr
+    <= 1 (the reference default: constant rho)."""
+    if cfg.tau_incr < 1.0:
+        raise ValueError(
+            f"tau_incr={cfg.tau_incr} < 1: the reference schedule only ever "
+            "increases rho toward rho_max (rqp_cadmm.py:657); a decaying "
+            "schedule is not supported"
+        )
+    rhos = [float(cfg.rho0)]
+    if cfg.tau_incr > 1.0:
+        while rhos[-1] < cfg.rho_max and len(rhos) <= cfg.max_iter:
+            rhos.append(min(rhos[-1] * cfg.tau_incr, cfg.rho_max))
+    return rhos
 
 
 def set_leader(cfg, leader_idx):
@@ -186,12 +256,22 @@ def init_cadmm_state(params: RQPParams, cfg: RQPCADMMConfig) -> CADMMState:
     n = params.n
     f_eq = equilibrium_forces(params)
     dtype = f_eq.dtype
-    nv = 9 + 3 * n
-    n_box = 13 + cfg.n_env_cbfs
-    m = n_box + 8
-    x0 = jnp.concatenate([jnp.zeros(9, dtype), f_eq.reshape(-1)])
+    if _use_reduced(cfg, n):
+        # Reduced per-agent QP: [dv_com | dvl | dwl | own force] (12 vars).
+        n_box = 7 + cfg.n_env_cbfs
+        m = n_box + 8
+        x0 = jnp.concatenate(
+            [jnp.tile(jnp.zeros(9, dtype), (n, 1)), f_eq], axis=1
+        )
+    else:
+        nv = 9 + 3 * n
+        n_box = 13 + cfg.n_env_cbfs
+        m = n_box + 8
+        x0 = jnp.tile(
+            jnp.concatenate([jnp.zeros(9, dtype), f_eq.reshape(-1)]), (n, 1)
+        )
     warm = socp.SOCPSolution(
-        x=jnp.tile(x0, (n, 1)),
+        x=x0,
         y=jnp.zeros((n, m), dtype),
         z=jnp.zeros((n, m), dtype),
         prim_res=jnp.zeros((n,), dtype),
@@ -252,6 +332,10 @@ def _build_agent_qp(
         + 2.0 * cfg.k_feq * jnp.diag(own)
         + rho * jnp.eye(3 * n, dtype=dtype)  # (rho/2)||f||^2.
     )
+    # Own-column force-smoothing cost (reference :455-462, default 0).
+    R_i = jnp.einsum("n,nij->ij", onehot, state.R)
+    w_i = jnp.einsum("n,ni->i", onehot, state.w)
+    Pff = Pff + jnp.kron(jnp.diag(onehot), smooth_block(cfg, R_i, w_i))
     P = P.at[9:, 9:].add(Pff)
     q = q.at[9:].add(
         -2.0 * cfg.k_f * (S.T @ (params.mT * GRAVITY * e3))
@@ -329,6 +413,290 @@ def _build_agent_qp(
     return P, q, A_full, lb, ub, shift
 
 
+class SchurPlan(NamedTuple):
+    """State-INDEPENDENT Schur-elimination cores for the reduced per-agent
+    QP, in the payload-frame force parametrization ``f_j = Rl ft_j``.
+
+    Derivation: split the full per-agent variables into z = (c, u) with
+    c = [dv_com, dvl, dwl], u = the agent's own force column (world frame),
+    and v = the other n-1 force columns. v carries no constraints of its own
+    (reference rqp_cadmm.py:394-404): it appears only in the 6 coupling
+    equalities (translational + rotational dynamics) and the quadratic costs,
+    so partial minimization over v subject to those equalities is exact and
+    closed-form, leaving a reduced 12-var QP in z whose Hessian is the Schur
+    complement (validated numerically against an SLSQP solve of the full
+    problem). With L = Q_vv^-1, Y = E_v L E_v^T, J = L E_v^T Y^-1,
+    N = L - J E_v L:
+
+        H_cc = P_cc + E_cc^T Y^-1 E_cc
+        H_uu = Q_uu - Q_uv N Q_uv^T + E_u^T Y^-1 E_u - 2 sym(Q_uv J E_u)
+        H_cu = E_cc^T Y^-1 E_u - E_cc^T J^T Q_uv^T
+        q_c  = q_c0 - E_cc^T J^T q_v - E_cc^T Y^-1 e0
+        q_u  = q_u0 - (Q_uv N + E_u^T J^T) q_v + Q_uv J e0 - E_u^T Y^-1 e0
+        v*   = -N (q_v + Q_uv^T u) + J (e0 - E_cc c - E_u u)
+
+    The payload-frame twist is what makes this TPU-cheap: expressing the
+    eliminated columns in the payload frame (``v = (I kron Rl) vt``) and
+    pre-rotating the translational equality rows by Rl^T makes Q_vv, E_v
+    orthogonally invariant — every expensive core (the (3(n-1))^2 inverse
+    behind L, N, J) depends ONLY on (params, rho) and is computed here ONCE,
+    outside the rollout. Per control step the state enters only through
+    Rl-conjugations of 3x3/6x9 blocks and a handful of big-matrix matvecs;
+    without this, n batched (3(n-1))^2 inversions ran every step (~13 ms of
+    the ~14 ms n=64 step).
+
+    Leaf axes: (n_rho, n_local, ...) — rho-schedule axis first, agents second.
+    """
+
+    J: jnp.ndarray      # (.., V, 6)   V = 3(n-1)
+    N: jnp.ndarray      # (.., V, V)
+    Yinv: jnp.ndarray   # (.., 6, 6)
+    Eu: jnp.ndarray     # (.., 6, 3)   scaled E~_u core: E~_u = Eu @ Rl^T.
+    Mu: jnp.ndarray     # (.., 3, V)   C N + Eu^T J^T (per-iteration q_u map).
+    NCt: jnp.ndarray    # (.., V, 3)   N C^T (reconstruction).
+    Nsum: jnp.ndarray   # (.., V, 3)   sum of N's 3-col blocks (q_v0 folding).
+    Jsum: jnp.ndarray   # (.., 3, 6)   sum of J's 3-row blocks.
+    Musum: jnp.ndarray  # (.., 3, 3)   C Nsum + Eu^T Jsum^T.
+    CJ: jnp.ndarray     # (.., 3, 6)   C J.
+    YinvEu: jnp.ndarray  # (.., 6, 3)  Yinv Eu.
+    UUcore: jnp.ndarray  # (.., 3, 3)  Eu^T Yinv Eu - C N C^T - 2 sym(C J Eu)
+    #                                  + 2 k_m hat(r_u)^T hat(r_u).
+    CUcore: jnp.ndarray  # (.., 6, 3)  Yinv Eu - J^T C^T.
+    perm: jnp.ndarray   # (.., n) int32: [own agent, others...] column order.
+    scale: jnp.ndarray  # (.., 6) equality-row equilibration (state-free).
+
+
+def make_plan(
+    params: RQPParams,
+    cfg: RQPCADMMConfig,
+    agent_ids: jnp.ndarray | None = None,
+) -> SchurPlan | None:
+    """Public plan factory for ``control(plan=...)``: the precomputed Schur
+    plan when the reduced formulation is active for this (cfg, n), else None
+    (the full-QP path needs no plan). Build it once outside the rollout scan
+    and close over it so the elimination cores never enter the compiled step."""
+    if not _use_reduced(cfg, params.n):
+        return None
+    return make_schur_plan(params, cfg, agent_ids)
+
+
+def make_schur_plan(
+    params: RQPParams,
+    cfg: RQPCADMMConfig,
+    agent_ids: jnp.ndarray | None = None,
+) -> SchurPlan:
+    """Precompute the state-independent elimination cores for every agent in
+    ``agent_ids`` (default: all n) and every rho the schedule visits.
+    Requires n >= 4: at n = 3 the coupling block E_v (built from
+    hat(r_j - r_k) pairs) is singular, so n = 3 uses the full QP path."""
+    n = params.n
+    if n < 4:
+        raise ValueError(
+            f"the Schur-reduced formulation needs n >= 4 (got n={n}): at "
+            "n = 3 the 6x6 coupling block E_v is singular — use the full "
+            "QP path (reduced_qp=False / the n < 4 default)"
+        )
+    dtype = params.r.dtype
+    if agent_ids is None:
+        agent_ids = jnp.arange(n)
+
+    def one_agent(agent_id, rho):
+        V = 3 * (n - 1)
+        others = jnp.arange(n - 1) + (jnp.arange(n - 1) >= agent_id)
+        perm = jnp.concatenate([agent_id[None], others]).astype(jnp.int32)
+        r_perm = params.r_com[perm]  # (n, 3)
+        hat_perm = jax.vmap(lie.hat)(r_perm)  # (n, 3, 3)
+        hat_u, hat_v = hat_perm[0], hat_perm[1:]
+
+        # Payload-frame blocks (all state-free; see class docstring).
+        Sv = jnp.tile(jnp.eye(3, dtype=dtype), (1, n - 1))  # (3, V)
+        Gv = jnp.concatenate(list(hat_v), axis=1)  # (3, V)
+        Qvv = (
+            2.0 * cfg.k_f * (Sv.T @ Sv) + 2.0 * cfg.k_m * (Gv.T @ Gv)
+            + rho * jnp.eye(V, dtype=dtype)
+        )
+        C = 2.0 * cfg.k_f * Sv + 2.0 * cfg.k_m * (hat_u.T @ Gv)  # (3, V)
+        Ev = jnp.concatenate([-Sv, -params.JT_inv @ Gv], axis=0)  # (6, V)
+        Eu = jnp.concatenate(
+            [-jnp.eye(3, dtype=dtype), -params.JT_inv @ hat_u], axis=0
+        )  # (6, 3)
+        # Row equilibration (row norms are Rl-invariant, so computed on the
+        # payload-frame blocks once): rows mix mT ~ O(1) and JT_inv ~ O(1e2).
+        Ecc_proxy = jnp.zeros((6, 9), dtype)
+        Ecc_proxy = Ecc_proxy.at[0:3, 0:3].set(
+            params.mT * jnp.eye(3, dtype=dtype)
+        )
+        Ecc_proxy = Ecc_proxy.at[3:6, 6:9].set(jnp.eye(3, dtype=dtype))
+        scale = 1.0 / jnp.linalg.norm(
+            jnp.concatenate([Ecc_proxy, Eu, Ev], axis=1), axis=1
+        )
+        Ev = Ev * scale[:, None]
+        Eu = Eu * scale[:, None]
+
+        L = jnp.linalg.inv(Qvv)
+        L = 0.5 * (L + L.T)
+        EvL = Ev @ L
+        Y = EvL @ Ev.T
+        Yinv = jnp.linalg.inv(0.5 * (Y + Y.T))
+        Yinv = 0.5 * (Yinv + Yinv.T)
+        J = EvL.T @ Yinv  # (V, 6)
+        N = L - J @ EvL
+        N = 0.5 * (N + N.T)
+
+        NCt = N @ C.T
+        Nsum = jnp.sum(N.reshape(V, n - 1, 3), axis=1)  # (V, 3)
+        Jsum = jnp.sum(J.reshape(n - 1, 3, 6), axis=0)  # (3, 6)
+        Mu = C @ N + Eu.T @ J.T  # (3, V)
+        Musum = C @ Nsum + Eu.T @ Jsum.T  # (3, 3)
+        CJ = C @ J  # (3, 6)
+        YinvEu = Yinv @ Eu  # (6, 3)
+        sym_term = C @ (J @ Eu)
+        UUcore = (
+            Eu.T @ YinvEu - C @ NCt - (sym_term + sym_term.T)
+            + 2.0 * cfg.k_m * (hat_u.T @ hat_u)
+        )
+        CUcore = YinvEu - J.T @ C.T  # (6, 3)
+        return SchurPlan(
+            J=J, N=N, Yinv=Yinv, Eu=Eu, Mu=Mu, NCt=NCt, Nsum=Nsum,
+            Jsum=Jsum, Musum=Musum, CJ=CJ, YinvEu=YinvEu, UUcore=UUcore,
+            CUcore=CUcore, perm=perm, scale=scale,
+        )
+
+    rhos = jnp.asarray(_rho_schedule(cfg), dtype)
+    return jax.vmap(
+        lambda rho: jax.vmap(lambda aid: one_agent(aid, rho))(agent_ids)
+    )(rhos)
+
+
+def _schur_state_pieces(params: RQPParams, cfg: RQPCADMMConfig,
+                        state: RQPState, scale: jnp.ndarray):
+    """Per-step, agent-shared pieces of the reduced QP: the (scaled)
+    payload-frame equality blocks on c and the static linear-term vectors."""
+    dtype = state.xl.dtype
+    e3 = jnp.array([0.0, 0.0, 1.0], dtype=dtype)
+    Rt = state.Rl.T
+    Ecc = jnp.zeros((6, 9), dtype)
+    Ecc = Ecc.at[0:3, 0:3].set(params.mT * Rt)
+    Ecc = Ecc.at[3:6, 6:9].set(jnp.eye(3, dtype=dtype))
+    Ecc = Ecc * scale[:, None]
+    e0s = scale * jnp.concatenate(
+        [Rt @ (-params.mT * GRAVITY * e3),
+         -params.JT_inv @ jnp.cross(state.wl, params.JT @ state.wl)]
+    )
+    xq = -2.0 * cfg.k_f * params.mT * GRAVITY * (Rt @ e3)  # q~_v0 block.
+    return Ecc, e0s, xq
+
+
+def _schur_step_qp(
+    params: RQPParams,
+    cfg: RQPCADMMConfig,
+    pk: SchurPlan,
+    f_eq: jnp.ndarray,
+    state: RQPState,
+    acc_des,
+    env_cbf: EnvCBF,
+    agent_id: jnp.ndarray,
+    is_leader: jnp.ndarray,
+    rho,
+    Ecc: jnp.ndarray,
+    e0s: jnp.ndarray,
+    xq: jnp.ndarray,
+):
+    """Assemble one agent's reduced 12-var QP ``(P, q0, A, lb, ub, shift)``
+    from the precomputed plan slice ``pk`` — only small Rl-conjugations, no
+    large linear algebra (see :class:`SchurPlan`)."""
+    n = params.n
+    dtype = state.xl.dtype
+    dvl_des, dwl_des = acc_des
+    e3 = jnp.array([0.0, 0.0, 1.0], dtype=dtype)
+    Rl = state.Rl
+
+    # --- Reduced Hessian.
+    k_dvl = cfg.k_dvl * is_leader
+    k_dwl = cfg.k_dwl * is_leader
+    P_cc = jnp.zeros((9, 9), dtype)
+    P_cc = P_cc.at[3:6, 3:6].set(2.0 * k_dvl * jnp.eye(3, dtype=dtype))
+    P_cc = P_cc.at[6:9, 6:9].set(2.0 * k_dwl * jnp.eye(3, dtype=dtype))
+    H_cc = P_cc + Ecc.T @ pk.Yinv @ Ecc
+    H_uu = (
+        (2.0 * cfg.k_f + 2.0 * cfg.k_feq + rho) * jnp.eye(3, dtype=dtype)
+        + Rl @ pk.UUcore @ Rl.T
+        + smooth_block(cfg, state.R[agent_id], state.w[agent_id])
+    )
+    H_cu = Ecc.T @ pk.CUcore @ Rl.T
+    P_red = jnp.block([[H_cc, H_cu], [H_cu.T, H_uu]])
+    P_red = 0.5 * (P_red + P_red.T)
+
+    # --- Static linear term.
+    q_c0 = jnp.concatenate(
+        [jnp.zeros(3, dtype), -2.0 * k_dvl * dvl_des, -2.0 * k_dwl * dwl_des]
+    )
+    q_u0 = (
+        -2.0 * cfg.k_f * params.mT * GRAVITY * e3
+        - 2.0 * cfg.k_feq * f_eq[agent_id]
+    )
+    q_red0 = jnp.concatenate([
+        q_c0 - Ecc.T @ (pk.Jsum.T @ xq + pk.Yinv @ e0s),
+        q_u0 + Rl @ (-pk.Musum @ xq + pk.CJ @ e0s - pk.YinvEu.T @ e0s),
+    ])
+
+    # --- Constraint rows on z = [c | u] (identical math to the full build).
+    n_box = 7 + cfg.n_env_cbfs
+    A = jnp.zeros((n_box, 12), dtype)
+    lb = jnp.zeros((n_box,), dtype)
+    ub = jnp.zeros((n_box,), dtype)
+
+    R_w_hat = Rl @ lie.hat(state.wl)
+    R_w_hat_sq = Rl @ lie.hat_square(state.wl, state.wl)
+    # CoM -> payload-point kinematics equality (full rows 6:9).
+    A = A.at[0:3, 0:3].set(-jnp.eye(3, dtype=dtype))
+    A = A.at[0:3, 3:6].set(jnp.eye(3, dtype=dtype))
+    A = A.at[0:3, 6:9].set(-Rl @ lie.hat(params.x_com))
+    kin_rhs = -R_w_hat_sq @ params.x_com
+    lb = lb.at[0:3].set(kin_rhs)
+    ub = ub.at[0:3].set(kin_rhs)
+    # Own f_z lower bound.
+    A = A.at[3, 11].set(1.0)
+    lb = lb.at[3].set(cfg.min_fz)
+    ub = ub.at[3].set(socp.INF)
+    # Payload tilt second-order CBF.
+    A = A.at[4, 6:9].set(-(Rl[2] @ lie.hat(e3)))
+    tilt_rhs = (
+        -R_w_hat_sq[2, 2]
+        - (cfg.alpha1_p_cbf + cfg.alpha2_p_cbf) * R_w_hat[2, 2]
+        - cfg.alpha1_p_cbf * cfg.alpha2_p_cbf * (Rl[2, 2] - cfg.cos_max_p_ang)
+    )
+    lb = lb.at[4].set(tilt_rhs)
+    ub = ub.at[4].set(socp.INF)
+    # Angular-velocity norm CBF.
+    A = A.at[5, 6:9].set(-2.0 * state.wl)
+    lb = lb.at[5].set(
+        -cfg.alpha_wl_cbf * (cfg.max_wl_sq - jnp.dot(state.wl, state.wl))
+    )
+    ub = ub.at[5].set(socp.INF)
+    # Velocity norm CBF.
+    A = A.at[6, 3:6].set(-2.0 * state.vl)
+    lb = lb.at[6].set(
+        -cfg.alpha_vl_cbf * (cfg.max_vl_sq - jnp.dot(state.vl, state.vl))
+    )
+    ub = ub.at[6].set(socp.INF)
+    # Environment collision CBFs.
+    A = A.at[7 : 7 + cfg.n_env_cbfs, 3:6].set(env_cbf.lhs)
+    lb = lb.at[7 : 7 + cfg.n_env_cbfs].set(env_cbf.rhs)
+    ub = ub.at[7 : 7 + cfg.n_env_cbfs].set(socp.INF)
+    # SOC rows: own thrust cone + own norm cap.
+    soc = jnp.zeros((8, 12), dtype)
+    shift_soc = jnp.zeros((8,), dtype)
+    soc = soc.at[0, 11].set(cfg.sec_max_f_ang)
+    soc = soc.at[1:4, 9:12].set(jnp.eye(3, dtype=dtype))
+    shift_soc = shift_soc.at[4].set(cfg.max_f)
+    soc = soc.at[5:8, 9:12].set(jnp.eye(3, dtype=dtype))
+
+    A_full = jnp.concatenate([A, soc], axis=0)
+    shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    return P_red, q_red0, A_full, lb, ub, shift
+
+
 def agent_env_cbfs(
     params: RQPParams,
     cfg: RQPCADMMConfig,
@@ -400,9 +768,17 @@ def control(
     acc_des,
     forest: forest_mod.Forest | None = None,
     axis_name: str | None = None,
+    plan: SchurPlan | None = None,
 ):
     """One distributed control step: ``-> (f_app (n_local, 3), CADMMState,
     SolverStats)`` (reference ``RQPCADMMController.control``, :631-675).
+
+    ``plan``: optional precomputed :func:`make_schur_plan` for the reduced
+    (n >= 4) formulation, covering exactly this call's local agents. When
+    None it is computed inline — the cores depend only on (params, cfg), so
+    inside a jitted rollout scan XLA's loop-invariant code motion hoists the
+    computation out of the loop; passing an explicit plan merely saves
+    compile time and makes the cost model obvious.
 
     With ``axis_name=None`` all n agents run in one program (vmap; single chip).
     Inside ``shard_map`` over a mesh axis named ``axis_name``, each shard holds a
@@ -412,7 +788,6 @@ def control(
     ``f_eq`` are replicated."""
     n = params.n
     dtype = state.xl.dtype
-    rho = jnp.asarray(cfg.rho0, dtype)
 
     n_local = admm_state.f.shape[0]
     if axis_name is None:
@@ -438,21 +813,124 @@ def control(
     r_local = jnp.take(params.r, agent_ids, axis=0)
 
     env_cbfs = agent_env_cbfs_for(params, cfg, forest, state, r_local)
-    onehots = jax.nn.one_hot(agent_ids, n, dtype=dtype)
     leaders = (agent_ids == cfg.leader_idx).astype(dtype)
+    use_reduced = _use_reduced(cfg, n)
 
-    P, q0, A, lb, ub, shift = jax.vmap(
-        lambda oh, ld, cbf: _build_agent_qp(
-            params, cfg, f_eq, state, acc_des, cbf, oh, ld, rho
-        )
-    )(onehots, leaders, env_cbfs)
+    if use_reduced:
+        # Constant-size (12-var) Schur-reduced per-agent QPs: the eliminated
+        # force columns are reconstructed after each solve so the consensus
+        # mean/residual/dual updates see the same full local copies as the
+        # reference (rqp_cadmm.py:569-574). All expensive elimination cores
+        # come from the state-independent plan (see SchurPlan docstring).
+        n_box = 7 + cfg.n_env_cbfs
+        m = n_box + 8
+        if plan is None:
+            plan = make_schur_plan(params, cfg, agent_ids)
+        elif plan.J.shape[1] != n_local:
+            # A full-n plan inside a shard: gather this shard's agent rows
+            # (cheap indexing; the plan itself is replicated).
+            plan = jax.tree.map(lambda x: jnp.take(x, agent_ids, axis=1), plan)
+        Rl = state.Rl
+        Ecc, e0s, xq = _schur_state_pieces(params, cfg, state, plan.scale[0, 0])
 
-    n_box = 13 + cfg.n_env_cbfs
-    m = n_box + 8
-    rho_vec = jax.vmap(
-        lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
-    )(lb, ub)
-    op = socp.kkt_operator(P, A, rho_vec)
+        def build_qp(rho_k, pk):
+            P, q0, A, lb, ub, shift = jax.vmap(
+                lambda p, aid, ld, cbf: _schur_step_qp(
+                    params, cfg, p, f_eq, state, acc_des, cbf, aid, ld,
+                    rho_k, Ecc, e0s, xq,
+                )
+            )(pk, agent_ids, leaders, env_cbfs)
+            rho_vec = jax.vmap(
+                lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
+            )(lb, ub)
+            return (pk, (P, q0, A, lb, ub, shift),
+                    socp.kkt_operator(P, A, rho_vec))
+
+        def primal_solve(solve_one, data, rho_k, lam, f_mean, warm):
+            pk, (P, q0, A, lb, ub, shift), op = data
+            inv_perm = jnp.argsort(pk.perm, axis=1)
+            delta = lam - rho_k * f_mean[None, :, :]  # (n_local, n, 3)
+            dperm = jnp.take_along_axis(delta, pk.perm[:, :, None], axis=1)
+            d_u = dperm[:, 0, :]
+            # Other columns, rotated into the payload frame (ft = Rl^T f).
+            d_v = jnp.einsum("ij,anj->ani", Rl.T, dperm[:, 1:, :]).reshape(
+                n_local, 3 * (n - 1)
+            )
+            jv = jnp.einsum("avk,av->ak", pk.J, d_v)  # (a, 6)
+            q = q0 + jnp.concatenate([
+                -jnp.einsum("kc,ak->ac", Ecc, jv),
+                d_u - jnp.einsum("ij,aj->ai", Rl,
+                                 jnp.einsum("ajv,av->aj", pk.Mu, d_v)),
+            ], axis=1)
+            sols = solve_one(P, q, A, lb, ub, shift, op, warm)
+            c, u = sols.x[:, :9], sols.x[:, 9:12]
+            ut = jnp.einsum("ij,aj->ai", Rl.T, u)
+            d6 = (e0s[None, :] - jnp.einsum("kc,ac->ak", Ecc, c)
+                  - jnp.einsum("akj,aj->ak", pk.Eu, ut))
+            vt = (
+                -pk.Nsum @ xq
+                - jnp.einsum("avw,aw->av", pk.N, d_v)
+                - jnp.einsum("avj,aj->av", pk.NCt, ut)
+                + jnp.einsum("avk,ak->av", pk.J, d6)
+            )
+            v = jnp.einsum("ij,anj->ani", Rl, vt.reshape(n_local, n - 1, 3))
+            f_perm = jnp.concatenate([u[:, None, :], v], axis=1)
+            f_new = jnp.take_along_axis(f_perm, inv_perm[:, :, None], axis=1)
+            return f_new, sols
+    else:
+        onehots = jax.nn.one_hot(agent_ids, n, dtype=dtype)
+        n_box = 13 + cfg.n_env_cbfs
+        m = n_box + 8
+
+        def build_qp(rho_k):
+            P, q0, A, lb, ub, shift = jax.vmap(
+                lambda oh, ld, cbf: _build_agent_qp(
+                    params, cfg, f_eq, state, acc_des, cbf, oh, ld, rho_k
+                )
+            )(onehots, leaders, env_cbfs)
+            rho_vec = jax.vmap(
+                lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
+            )(lb, ub)
+            return (P, q0, A, lb, ub, shift), socp.kkt_operator(P, A, rho_vec)
+
+        def primal_solve(solve_one, data, rho_k, lam, f_mean, warm):
+            (P, q0, A, lb, ub, shift), op = data
+            # Augmented linear term <lam_i, f> - rho <f_mean, f>.
+            q_extra = (lam - rho_k * f_mean[None, :, :]).reshape(n_local, 3 * n)
+            q = q0.at[:, 9:].add(q_extra)
+            sols = solve_one(P, q, A, lb, ub, shift, op, warm)
+            f_new = sols.x[:, 9:].reshape(n_local, n, 3)
+            return f_new, sols
+
+    # rho schedule (reference :565-567, :657): precompute the per-agent QP
+    # data + KKT operators for every distinct rho the capped schedule visits,
+    # select per iteration. The default (tau_incr = 1) visits exactly one
+    # value — no stacking, identical to a constant-rho build.
+    rhos = _rho_schedule(cfg)
+    n_rho = len(rhos)
+    rho_arr = jnp.asarray(rhos, dtype)
+    if n_rho == 1:
+        data0 = (build_qp(rho_arr[0], jax.tree.map(lambda x: x[0], plan))
+                 if use_reduced else build_qp(rho_arr[0]))
+
+        def qp_at(it):
+            return data0
+
+        def rho_at(it):
+            return rho_arr[0]
+    else:
+        stack = (jax.vmap(build_qp)(rho_arr, plan)
+                 if use_reduced else jax.vmap(build_qp)(rho_arr))
+
+        def qp_at(it):
+            idx = jnp.minimum(it, n_rho - 1)
+            return jax.tree.map(
+                lambda x: lax.dynamic_index_in_dim(x, idx, 0, keepdims=False),
+                stack,
+            )
+
+        def rho_at(it):
+            return rho_arr[jnp.minimum(it, n_rho - 1)]
 
     def make_solve(iters):
         return jax.vmap(
@@ -468,13 +946,11 @@ def control(
     two_phase = warm_iters != cfg.inner_iters
     solve_warm = make_solve(warm_iters) if two_phase else solve_cold
 
-    def consensus_iter(solve_one, carry):
-        f, lam, f_mean, warm, it, res, err_buf = carry
-        # Primal: augmented linear term <lam_i, f> - rho <f_mean, f>.
-        q_extra = (lam - rho * f_mean[None, :, :]).reshape(n_local, 3 * n)
-        q = q0.at[:, 9:].add(q_extra)
-        sols = solve_one(P, q, A, lb, ub, shift, op, warm)
-        f_new = sols.x[:, 9:].reshape(n_local, n, 3)
+    def _consensus_iter_impl(solve_one, carry):
+        f, lam, f_mean, warm, it, res, err_buf, okf = carry
+        f_new, sols = primal_solve(
+            solve_one, qp_at(it), rho_at(it), lam, f_mean, warm
+        )
         # Failed agents fall back to equilibrium forces (reference :491-494).
         ok = (sols.prim_res < cfg.solver_tol)[:, None, None] & jnp.all(
             jnp.isfinite(f_new), axis=(1, 2), keepdims=True
@@ -495,24 +971,39 @@ def control(
         res_new = _max_over_agents(jnp.abs(f_new - f_mean_new[None, :, :]))
         err_buf = err_buf.at[it].set(res_new)
         it = it + 1
-        # Dual update. Deliberate deviation from the reference: the reference
-        # breaks out of its loop *before* updating lambda on the converged
-        # iteration (:661-665); here the update runs unconditionally, so the
-        # warm-started duals for the NEXT control step include one extra
-        # rho*(f - f_mean) term, bounded by rho*res_tol — it only perturbs warm
-        # starts, never the applied forces (and err_seq gains the final
-        # converged residual the reference omits).
-        lam_new = lam + rho * (f_new - f_mean_new[None, :, :])
-        return f_new, lam_new, f_mean_new, sols, it, res_new, err_buf
+        # Dual update, gated exactly like the reference's loop (:655-665):
+        # rho advances after the solves, the loop breaks BEFORE the dual
+        # update when converged or past the cap, and the update uses the
+        # advanced rho.
+        do_dual = (res_new >= cfg.res_tol) & (it <= cfg.max_iter)
+        lam_new = jnp.where(
+            do_dual, lam + rho_at(it) * (f_new - f_mean_new[None, :, :]), lam
+        )
+        # Worst-iteration solve-success fraction (observability of the
+        # equilibrium-fallback path).
+        okf = jnp.minimum(okf, _mean_over_agents(ok_flat.astype(dtype)))
+        return f_new, lam_new, f_mean_new, sols, it, res_new, err_buf, okf
+
+    def consensus_iter(solve_one, carry):
+        # Per-lane convergence freeze: once THIS scenario's residual is under
+        # tolerance, pass the carry through untouched. Inside a vmapped batch
+        # the while_loop runs every lane until the slowest converges; without
+        # the freeze, converged lanes would keep iterating (drifting iterates,
+        # inflated iteration counts) — with it, each lane's result is exactly
+        # what a solo run would produce.
+        new = _consensus_iter_impl(solve_one, carry)
+        active = carry[5] >= cfg.res_tol
+        return jax.tree.map(lambda a, b: jnp.where(active, a, b), new, carry)
 
     def cond(carry):
-        *_, it, res, _buf = carry
+        *_, it, res, _buf, _okf = carry
         return (res >= cfg.res_tol) & (it <= cfg.max_iter)
 
     err_buf0 = jnp.full((cfg.max_iter + 1,), jnp.nan, dtype)
     init = (
         admm_state.f, admm_state.lam, admm_state.f_mean, admm_state.warm,
         jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype), err_buf0,
+        jnp.ones((), dtype),
     )
     if not two_phase:
         carry = init
@@ -525,7 +1016,7 @@ def control(
         # vmap it becomes a select that executes both solver branches for
         # every lane.)
         carry = consensus_iter(solve_cold, init)
-    f, lam, f_mean, warm, iters, res, err_buf = lax.while_loop(
+    f, lam, f_mean, warm, iters, res, err_buf, ok_frac = lax.while_loop(
         cond, lambda c: consensus_iter(solve_warm, c), carry
     )
 
@@ -539,5 +1030,6 @@ def control(
         collision=collision,
         min_env_dist=_min_over_agents(env_cbfs.min_dist),
         err_seq=err_buf,
+        ok_frac=ok_frac,
     )
     return f_app, new_state, stats

@@ -23,6 +23,7 @@ become an explicit ``CtrlState`` carried through ``lax.scan``.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from flax import struct
 
@@ -58,6 +59,13 @@ class RQPCentralizedConfig:
     k_feq: float
     k_dvl: float
     k_dwl: float
+    # Optional force-smoothing cost (reference :215-225; carried at its
+    # reference default k_smooth = 0, with the reference's own in-code note
+    # "Controller is more stable without smoothing"): penalizes the force
+    # component orthogonal to each quad's predicted next-step thrust axis,
+    #   k_smooth * sum_i ||(R_i exp3(w_i dt))[:, :2]^T f_i||^2   (:117-121).
+    k_smooth: float = 0.0
+    dt: float = 1e-3  # smoothing-axis prediction horizon (reference :268-271).
     # Static sizes / solver budget.
     n_env_cbfs: int = struct.field(pytree_node=False, default=10)
     solver_iters: int = struct.field(pytree_node=False, default=150)
@@ -72,6 +80,8 @@ def make_config(
     n_env_cbfs: int = 10,
     solver_iters: int = 150,
     max_f_ang: float = float(jnp.pi / 6.0),
+    k_smooth: float = 0.0,
+    dt: float = 1e-3,
 ) -> RQPCentralizedConfig:
     """Defaults from reference :182-225 (RQP: max payload tilt 15 deg)."""
     n = params.n
@@ -96,10 +106,24 @@ def make_config(
         k_feq=0.1,
         k_dvl=1.0,
         k_dwl=1.0,
+        k_smooth=k_smooth,
+        dt=dt,
         n_env_cbfs=n_env_cbfs,
         solver_iters=solver_iters,
         max_f_ang=max_f_ang,
     )
+
+
+def smooth_block(cfg, R_i: jnp.ndarray, w_i: jnp.ndarray) -> jnp.ndarray:
+    """Hessian block ``2 k_smooth Rq_orth Rq_orth^T`` of the optional
+    force-smoothing cost on one agent's force (reference
+    rqp_centralized.py:421-424 / rqp_cadmm.py:455-462, :287-293):
+    ``Rq = R_i exp3(w_i dt)`` is the quad's predicted next-step attitude,
+    ``Rq_orth`` its first two columns. ``cfg`` is any controller config
+    carrying ``k_smooth``/``dt`` (centralized and distributed share both)."""
+    Rq = R_i @ lie.expm_so3(w_i * cfg.dt)
+    Rq_orth = Rq[:, :2]
+    return 2.0 * cfg.k_smooth * (Rq_orth @ Rq_orth.T)
 
 
 def equilibrium_forces(params: RQPParams) -> jnp.ndarray:
@@ -191,6 +215,13 @@ def _build_qp(
         -2.0 * cfg.k_f * (S.T @ (params.mT * GRAVITY * e3))
         - 2.0 * cfg.k_feq * f_eq.reshape(-1)
     )
+    # Force-smoothing cost (reference :421-424, default k_smooth = 0):
+    # k_smooth ||Rq_orth_i^T f_i||^2 with Rq_i = R_i exp3(w_i dt) (:268-271),
+    # added block-diagonally over the agent force blocks in one op.
+    blocks = jax.vmap(lambda R_i, w_i: smooth_block(cfg, R_i, w_i))(
+        state.R, state.w
+    )
+    P = P.at[9:, 9:].add(jax.scipy.linalg.block_diag(*blocks))
 
     # --- Box constraint rows.
     n_box, _, _ = qp_dims(n, cfg.n_env_cbfs)
@@ -332,5 +363,6 @@ def control(
         solve_res=sol.prim_res,
         collision=env_cbf.collision,
         min_env_dist=env_cbf.min_dist,
+        ok_frac=ok.astype(sol.x.dtype),
     )
     return f_out, new_state, stats

@@ -21,6 +21,8 @@ control step (only the price vector moves between dual iterations).
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -30,7 +32,10 @@ from tpu_aerial_transport.control.cadmm import (
     RQPCADMMConfig,
     agent_env_cbfs_for,
 )
-from tpu_aerial_transport.control.centralized import equilibrium_forces
+from tpu_aerial_transport.control.centralized import (
+    equilibrium_forces,
+    smooth_block as cadmm_smooth_block,
+)
 from tpu_aerial_transport.control.types import EnvCBF, SolverStats
 from tpu_aerial_transport.envs import forest as forest_mod
 from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
@@ -57,6 +62,8 @@ def make_config(
     max_iter: int = 100,
     inner_iters: int = 60,
     prim_inf_tol: float = 1e-2,
+    k_smooth: float = 0.0,
+    dt: float = 1e-3,
 ) -> RQPDDConfig:
     """Defaults are reference-conservative. For warm-started receding-horizon
     use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
@@ -67,6 +74,7 @@ def make_config(
     base = cadmm_mod.make_config(
         params, collision_radius, max_deceleration,
         n_env_cbfs=n_env_cbfs, max_iter=max_iter, inner_iters=inner_iters,
+        k_smooth=k_smooth, dt=dt,
     )
     return RQPDDConfig(base=base, prim_inf_tol=prim_inf_tol)
 
@@ -120,6 +128,8 @@ def _build_agent_qp(
     cfg: RQPCADMMConfig,
     fi_eq: jnp.ndarray,
     r_com_i: jnp.ndarray,
+    R_i: jnp.ndarray,
+    w_i: jnp.ndarray,
     state: RQPState,
     acc_des,
     env_cbf: EnvCBF,
@@ -162,6 +172,8 @@ def _build_agent_qp(
     # k_feq ||f_i - fi_eq||^2.
     P = P.at[9:12, 9:12].add(2.0 * cfg.k_feq * jnp.eye(3, dtype=dtype))
     q = q.at[9:12].add(-2.0 * cfg.k_feq * fi_eq)
+    # Own-force smoothing cost (reference rqp_dd.py:451-457, default 0).
+    P = P.at[9:12, 9:12].add(cadmm_smooth_block(cfg, R_i, w_i))
 
     n_box = 13 + cfg.n_env_cbfs
     A = jnp.zeros((n_box, nv), dtype)
@@ -241,6 +253,8 @@ def strong_convexity_matrix(
     cfg: RQPCADMMConfig,
     state: RQPState,
     r_com_i: jnp.ndarray,
+    R_i: jnp.ndarray,
+    w_i: jnp.ndarray,
     is_leader: jnp.ndarray,
     eps: float,
 ):
@@ -259,6 +273,8 @@ def strong_convexity_matrix(
     zero = jnp.zeros((3, 3), dtype)
     # k_feq on f_i.
     mat = add(mat, eye, zero, zero, cfg.k_feq)
+    # k_smooth on f_i (reference :518-524, default 0).
+    mat = mat.at[0:3, 0:3].add(cadmm_smooth_block(cfg, R_i, w_i))
     # k_f on f_i + F_i.
     mat = add(mat, eye, eye, zero, cfg.k_f)
     # k_m on M_i + hat(r_i) Rl^T f_i.
@@ -279,17 +295,18 @@ def strong_convexity_matrix(
     return mat
 
 
-def _consensus_matrix(params: RQPParams, state: RQPState):
+def _consensus_matrix(params: RQPParams, Rl: jnp.ndarray):
     """Global consensus constraint matrix ``A (6n, 9n)`` (reference :643-653):
     row block i reads ``[F_i - sum_{j!=i} f_j ; M_i - sum_{j!=i} r_j x Rl^T f_j]``
-    off the stacked per-agent primal ``(f_j, F_j, M_j)``.
+    off the stacked per-agent primal ``(f_j, F_j, M_j)``. With ``Rl = I`` this
+    is the payload-frame matrix (state-free — see :class:`DDPlan`).
 
     Built as a block tensor ``(i, row_half, 3, j, var_block, 3)`` with masked
     einsums — an O(n^2) Python scatter loop here emitted tens of thousands of
     HLO ops at n = 64 and crashed the TPU compiler."""
     n = params.n
-    dtype = state.xl.dtype
-    G = jax.vmap(lambda r: lie.hat(r) @ state.Rl.T)(params.r_com)  # (n, 3, 3)
+    dtype = Rl.dtype
+    G = jax.vmap(lambda r: lie.hat(r) @ Rl.T)(params.r_com)  # (n, 3, 3)
     I3 = jnp.eye(3, dtype=dtype)
     eyen = jnp.eye(n, dtype=dtype)
     offd = 1.0 - eyen
@@ -303,6 +320,91 @@ def _consensus_matrix(params: RQPParams, state: RQPState):
     return blocks.reshape(6 * n, 9 * n)
 
 
+class DDPlan(NamedTuple):
+    """State-independent quasi-Newton preparation for the DD dual ascent.
+
+    In the payload frame — primal blocks ``(ft_i, Ft_i) = (Rl^T f_i,
+    Rl^T F_i)`` (the moment aggregates ``M_i`` are already payload-frame) and
+    the F-consensus rows pre-rotated by ``Rl^T`` — both the per-agent
+    strong-convexity matrices and the consensus matrix become independent of
+    the state: every ``Rl`` in their blocks either cancels (orthogonal
+    conjugation inside a squared norm) or multiplies a whole row block whose
+    Gram product drops it. The expensive per-control-step work the reference
+    re-does each step (reference :634-657: n 9x9 inverses + the 6n x 6n
+    Cholesky) therefore precomputes ONCE here; rotating the per-iteration
+    violations into the payload frame and the dual step back out reproduces
+    the world-frame quasi-Newton step EXACTLY (orthogonal change of basis).
+
+    The dynamic leader (``leader_idx`` is a runtime pytree leaf) adds
+    tracking-cost curvature to one agent's 9x9 block; that enters as a
+    rank-9 Woodbury correction of the precomputed base inverse per step.
+
+    The optional ``k_smooth`` curvature (reference :518-524) is omitted from
+    the preconditioner (it is state-dependent); since the strong-convexity
+    matrix is a curvature LOWER bound used as a dual-ascent scaling, omitting
+    a PSD term only makes the dual steps more conservative. k_smooth defaults
+    to 0, where the preconditioner is exact.
+    """
+
+    qn_inv_base: jnp.ndarray  # (6n, 6n) inverse of Ac Qinv_base Ac^T + beta I.
+    D: jnp.ndarray  # (n, 9, 9) Qinv_leader - Qinv_base per would-be leader.
+    Ac: jnp.ndarray  # (6n, 9n) payload-frame consensus matrix.
+
+
+def make_dd_plan(params: RQPParams, cfg: RQPDDConfig) -> DDPlan:
+    """Precompute the payload-frame QN cores (see :class:`DDPlan`)."""
+    n = params.n
+    base = cfg.base
+    dtype = params.r.dtype
+    eye3 = jnp.eye(3, dtype=dtype)
+    # Payload-frame strong-convexity matrices == world ones at Rl = I; the
+    # k_smooth term is state-dependent and excluded (class docstring).
+    frame_state = _identity_rl_state(n, dtype)
+    cfg_nosmooth = base.replace(k_smooth=0.0)
+
+    def q_pair(r_i, R_i, w_i):
+        q_base = strong_convexity_matrix(
+            params, cfg_nosmooth, frame_state, r_i, R_i, w_i,
+            jnp.zeros((), dtype), cfg.sc_eps,
+        )
+        q_lead = strong_convexity_matrix(
+            params, cfg_nosmooth, frame_state, r_i, R_i, w_i,
+            jnp.ones((), dtype), cfg.sc_eps,
+        )
+        return q_base, q_lead
+
+    Q_base, Q_lead = jax.vmap(q_pair)(
+        params.r_com, frame_state.R, frame_state.w
+    )
+    Qinv_base = jnp.linalg.inv(Q_base)
+    Qinv_base = 0.5 * (Qinv_base + jnp.swapaxes(Qinv_base, -1, -2))
+    Qinv_lead = jnp.linalg.inv(Q_lead)
+    Qinv_lead = 0.5 * (Qinv_lead + jnp.swapaxes(Qinv_lead, -1, -2))
+
+    Ac = _consensus_matrix(params, eye3)  # payload frame.
+    Ac_blocks = Ac.reshape(6 * n, n, 9)
+    AQinv = jnp.einsum("mnj,njk->mnk", Ac_blocks, Qinv_base).reshape(
+        6 * n, 9 * n
+    )
+    qn = AQinv @ Ac.T + cfg.beta * jnp.eye(6 * n, dtype=dtype)
+    qn_inv = jnp.linalg.inv(qn)
+    qn_inv = 0.5 * (qn_inv + qn_inv.T)
+    return DDPlan(qn_inv_base=qn_inv, D=Qinv_lead - Qinv_base, Ac=Ac)
+
+
+def _identity_rl_state(n: int, dtype) -> RQPState:
+    """A placeholder state with Rl = I and identity quad attitudes, used to
+    evaluate state-free payload-frame blocks through the world-frame builders."""
+    from tpu_aerial_transport.models import rqp as rqp_mod
+
+    eye = jnp.eye(3, dtype=dtype)
+    return rqp_mod.rqp_state(
+        R=jnp.tile(eye, (n, 1, 1)), w=jnp.zeros((n, 3), dtype),
+        xl=jnp.zeros(3, dtype), vl=jnp.zeros(3, dtype),
+        Rl=eye, wl=jnp.zeros(3, dtype),
+    )
+
+
 def control(
     params: RQPParams,
     cfg: RQPDDConfig,
@@ -312,9 +414,14 @@ def control(
     acc_des,
     forest: forest_mod.Forest | None = None,
     axis_name: str | None = None,
+    plan: DDPlan | None = None,
 ):
     """One DD control step: ``-> (f (n_local, 3), DDState, SolverStats)``
     (reference ``RQPDDController.control``, :695-752).
+
+    ``plan``: optional precomputed :func:`make_dd_plan` (state-independent
+    QN cores). When None it is computed inline; passing it explicitly keeps
+    the big 6n x 6n inverse out of the compiled step.
 
     With ``axis_name=None`` all n agents run in one program (vmap; single
     chip). Inside ``shard_map`` over a mesh axis named ``axis_name``, each
@@ -363,14 +470,15 @@ def control(
     env_cbfs = agent_env_cbfs_for(params, base, forest, state, r_local)
     # Equality test (not .at[idx]) so leader_idx = -1 (unset_leader) yields no
     # leader rather than wrapping to the last agent.
-    leaders_full = (jnp.arange(n) == base.leader_idx).astype(dtype)
     leaders = (agent_ids == base.leader_idx).astype(dtype)
 
+    R_local = jnp.take(state.R, agent_ids, axis=0)
+    w_local = jnp.take(state.w, agent_ids, axis=0)
     P, q0, A, lb, ub, shift = jax.vmap(
-        lambda fi_eq, r_i, ld, cbf: _build_agent_qp(
-            params, base, fi_eq, r_i, state, acc_des, cbf, ld
+        lambda fi_eq, r_i, R_i, w_i, ld, cbf: _build_agent_qp(
+            params, base, fi_eq, r_i, R_i, w_i, state, acc_des, cbf, ld
         )
-    )(f_eq_local, r_com_local, leaders, env_cbfs)
+    )(f_eq_local, r_com_local, R_local, w_local, leaders, env_cbfs)
 
     n_box = 13 + base.n_env_cbfs
     m = n_box + 8
@@ -379,25 +487,24 @@ def control(
     )(lb, ub)
     op = socp.kkt_operator(P, A, rho_vec)
 
-    # Quasi-Newton preparation, once per control step (reference :634-657).
-    # Replicated on every shard: it needs only the (replicated) params/state,
-    # and the resulting 6n x 6n inverse is tiny.
-    Q = jax.vmap(
-        lambda r_i, ld: strong_convexity_matrix(
-            params, base, state, r_i, ld, cfg.sc_eps
-        )
-    )(params.r_com, leaders_full)
-    Q_inv = jnp.linalg.inv(Q)
-    Q_inv = 0.5 * (Q_inv + jnp.swapaxes(Q_inv, -1, -2))
-    Ac = _consensus_matrix(params, state)  # (6n, 9n)
-    # Block-diagonal Q^{-1}: apply per 9-block instead of materializing 9n x 9n.
-    Ac_blocks = Ac.reshape(6 * n, n, 9)
-    AQinv = jnp.einsum("mnj,njk->mnk", Ac_blocks, Q_inv).reshape(6 * n, 9 * n)
-    qn_mat = AQinv @ Ac.T + cfg.beta * jnp.eye(6 * n, dtype=dtype)
-    # Explicit inverse: the QN solve runs once per dual iteration inside the
-    # while_loop; a precomputed inverse keeps it a single matmul (MXU) instead
-    # of two serial triangular solves (see ops/socp.py design note).
-    qn_inv = jnp.linalg.inv(qn_mat)
+    # Quasi-Newton preparation (reference :634-657, where n 9x9 inverses and
+    # a 6n x 6n factorization re-ran every control step): the state-free
+    # payload-frame cores come from the plan (see :class:`DDPlan`); per step
+    # only the dynamic leader's rank-9 Woodbury correction runs. Replicated
+    # on every shard — it needs only replicated inputs and the result is tiny.
+    if plan is None:
+        plan = make_dd_plan(params, cfg)
+    l_idx = jnp.asarray(base.leader_idx, jnp.int32)
+    has_leader = ((l_idx >= 0) & (l_idx < n)).astype(dtype)
+    li = jnp.clip(l_idx, 0, n - 1)
+    A_l = lax.dynamic_slice(plan.Ac, (jnp.int32(0), 9 * li), (6 * n, 9))
+    Dl = jnp.take(plan.D, li, axis=0) * has_leader
+    Pb = plan.qn_inv_base
+    PA = Pb @ A_l  # (6n, 9)
+    # (B + A_l D A_l^T)^{-1} = P - P A_l (I + D A_l^T P A_l)^{-1} D A_l^T P
+    # (Woodbury without D^{-1}; D = 0 when no leader makes this a no-op).
+    K9 = jnp.eye(9, dtype=dtype) + Dl @ (A_l.T @ PA)
+    qn_inv = Pb - PA @ jnp.linalg.solve(K9, Dl @ PA.T)
     qn_inv = 0.5 * (qn_inv + qn_inv.T)
 
     G_local = jax.vmap(lambda r: lie.hat(r) @ state.Rl.T)(r_com_local)
@@ -416,7 +523,7 @@ def control(
     fallback_M = -jnp.einsum("ij,njk,nk->ni", params.JT_inv, G_local, f_eq_local)
 
     def dd_iter(carry):
-        f, F, M, lam_F, lam_M, warm, it, err, err_buf = carry
+        f, F, M, lam_F, lam_M, warm, it, err, err_buf, okf = carry
         # Price assembly (the all-gather, reference :716-722) — two psum
         # reductions over the agent axis.
         sum_lF = _sum_over_agents(lam_F)
@@ -459,28 +566,45 @@ def control(
         # ``Ac @ prim`` equals the stacked per-agent consensus violations
         # [err_F_i; err_M_i], so each shard contributes its local blocks
         # (all_gather) and the tiny 6n-dim solve replicates on every shard.
+        # The F-violations rotate into the payload frame to match the
+        # precomputed QN basis and the F-step rotates back — an exact
+        # orthogonal change of basis, identical to the world-frame step.
+        # Gated like the reference's loop (:742-748): it breaks BEFORE the
+        # ascent when converged or past the iteration cap.
         dual_grad = _gather_blocks(
-            jnp.concatenate([err_F, err_M], axis=1)
+            jnp.concatenate([err_F @ state.Rl, err_M], axis=1)
         ).reshape(-1)
         step = (qn_inv @ dual_grad).reshape(n, 6)
         step = jnp.take(step, agent_ids, axis=0)
-        lam_F_new = lam_F + step[:, :3]
-        lam_M_new = lam_M + step[:, 3:]
+        do_dual = (err_new >= cfg.prim_inf_tol) & (it <= base.max_iter)
+        lam_F_new = jnp.where(do_dual, lam_F + step[:, :3] @ state.Rl.T, lam_F)
+        lam_M_new = jnp.where(do_dual, lam_M + step[:, 3:], lam_M)
+        okf = jnp.minimum(
+            okf, _sum_over_agents(ok.astype(dtype)) / n
+        )  # worst-iteration solve-success fraction.
         return (f_new, F_new, M_new, lam_F_new, lam_M_new, warm_new, it,
-                err_new, err_buf)
+                err_new, err_buf, okf)
+
+    def dd_iter_frozen(carry):
+        # Per-lane convergence freeze (same rationale as the C-ADMM loop):
+        # in a vmapped batch, converged scenarios pass through untouched while
+        # the while_loop drains the slowest lane.
+        new = dd_iter(carry)
+        active = carry[7] >= cfg.prim_inf_tol
+        return jax.tree.map(lambda a, b: jnp.where(active, a, b), new, carry)
 
     def cond(carry):
-        *_, it, err, _buf = carry
+        *_, it, err, _buf, _okf = carry
         return (err >= cfg.prim_inf_tol) & (it <= base.max_iter)
 
     err_buf0 = jnp.full((base.max_iter + 1,), jnp.nan, dtype)
     init = (
         dd_state.f, dd_state.F, dd_state.M, dd_state.lam_F, dd_state.lam_M,
         dd_state.warm, jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype),
-        err_buf0,
+        err_buf0, jnp.ones((), dtype),
     )
-    f, F, M, lam_F, lam_M, warm, iters, err, err_buf = lax.while_loop(
-        cond, dd_iter, init
+    f, F, M, lam_F, lam_M, warm, iters, err, err_buf, ok_frac = lax.while_loop(
+        cond, dd_iter_frozen, init
     )
 
     new_state = DDState(f=f, F=F, M=M, lam_F=lam_F, lam_M=lam_M, warm=warm)
@@ -491,5 +615,6 @@ def control(
         collision=collision,
         min_env_dist=_min_over_agents(env_cbfs.min_dist),
         err_seq=err_buf,
+        ok_frac=ok_frac,
     )
     return f, new_state, stats

@@ -20,6 +20,13 @@ class SolverStats:
     err_seq: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.zeros((0,))
     )  # (max_iters,) consensus residuals (distributed only).
+    # Worst-iteration fraction of per-agent solves that met solver_tol (the
+    # rest fell back to equilibrium forces, reference rqp_cadmm.py:491-494).
+    # 1.0 = no fallbacks. Surfaces silent solver-accuracy regressions that
+    # would otherwise only show as an exactly-zero consensus residual.
+    ok_frac: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.ones(())
+    )
 
 
 @struct.dataclass

@@ -64,6 +64,11 @@ class KKTOp(NamedTuple):
 
     Minv: jnp.ndarray  # (nv, nv) inverse of P + sigma I + A^T diag(rho) A.
     MinvAT: jnp.ndarray  # (nv, m) Minv @ A^T.
+    # The sigma the operator was built with: solve_socp uses THIS value in its
+    # x-update so a caller passing an op built with a different sigma than
+    # solve_socp's own argument cannot silently mix the two (which would
+    # converge to a slightly wrong fixed point).
+    sigma: jnp.ndarray = 1e-6
 
 
 class SOCPSolution(NamedTuple):
@@ -183,7 +188,9 @@ def solve_socp(
     #   A x+ = (A K) @ u - A Minv q    (needed by the z/y updates)
     # stack both into ONE (nv+m, nv+m) matmul per iteration — the entire
     # linear-algebra step of an ADMM iteration as a single MXU op.
-    K = jnp.concatenate([sigma * op.Minv, op.MinvAT], axis=-1)  # (nv, nv + m)
+    # op.sigma (not this function's sigma argument) keeps the x-update
+    # consistent with whatever sigma the operator was actually built with.
+    K = jnp.concatenate([op.sigma * op.Minv, op.MinvAT], axis=-1)  # (nv, nv+m)
     K2 = jnp.concatenate([K, A @ K], axis=0)  # (nv + m, nv + m)
     wq = op.Minv @ q
     w2 = jnp.concatenate([wq, A @ wq])  # (nv + m,)
@@ -262,7 +269,12 @@ def kkt_operator(P, A, rho_vec, sigma: float = 1e-6) -> KKTOp:
     M = P + sigma * jnp.eye(nv, dtype=P.dtype) + (AT * rho_vec[..., None, :]) @ A
     Minv = jnp.linalg.inv(M)
     Minv = 0.5 * (Minv + jnp.swapaxes(Minv, -1, -2))  # M is symmetric.
-    return KKTOp(Minv=Minv, MinvAT=Minv @ AT)
+    # sigma broadcast to the batch shape so a natively-batched operator stays
+    # a uniform pytree (every leaf with the same leading axes) for vmap.
+    return KKTOp(
+        Minv=Minv, MinvAT=Minv @ AT,
+        sigma=jnp.broadcast_to(jnp.asarray(sigma, P.dtype), P.shape[:-2]),
+    )
 
 
 def kkt_residuals(P, q, A, lb, ub, n_box, soc_dims, sol: SOCPSolution, shift=None):

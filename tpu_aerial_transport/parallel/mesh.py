@@ -65,6 +65,10 @@ def cadmm_control_sharded(
     n = params.n
     n_shards = mesh.shape[axis]
     assert n % n_shards == 0, (n, n_shards)
+    # State-independent Schur plan for ALL agents, computed once outside the
+    # shard_map (replicated capture); each shard gathers its agent rows
+    # inside cadmm.control.
+    plan = cadmm.make_plan(params, cfg)
 
     state_spec = cadmm.CADMMState(
         f=P(axis), lam=P(axis), f_mean=P(),
@@ -81,7 +85,7 @@ def cadmm_control_sharded(
     def step(admm_state, state, acc_des):
         return cadmm.control(
             params, cfg, f_eq, admm_state, state, acc_des, forest,
-            axis_name=axis,
+            axis_name=axis, plan=plan,
         )
 
     return step
@@ -106,6 +110,8 @@ def dd_control_sharded(
     n = params.n
     n_shards = mesh.shape[axis]
     assert n % n_shards == 0, (n, n_shards)
+    # State-independent QN plan, once, outside the shard_map (replicated).
+    plan = dd.make_dd_plan(params, cfg)
 
     state_spec = dd.DDState(
         f=P(axis), F=P(axis), M=P(axis), lam_F=P(axis), lam_M=P(axis),
@@ -122,7 +128,7 @@ def dd_control_sharded(
     def step(dd_state, state, acc_des):
         return dd.control(
             params, cfg, f_eq, dd_state, state, acc_des, forest,
-            axis_name=axis,
+            axis_name=axis, plan=plan,
         )
 
     return step
