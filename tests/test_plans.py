@@ -1,0 +1,170 @@
+"""Precomputed-plan tests.
+
+Both distributed controllers accept a state-independent plan built once
+outside the rollout (cadmm.make_plan / dd.make_dd_plan). Pinned here:
+(1) the payload-frame DD QN precompute against an independently computed
+    world-frame quasi-Newton step from the live state (the formulation the
+    plan replaced) — the non-tautological oracle, incl. the rank-9 Woodbury
+    leader correction; the C-ADMM plan's equivalent oracle is the
+    reduced-vs-full-QP exactness test in tests/test_cadmm_schur.py;
+(2) plan-vs-inline plumbing — explicitly passing the plan must not change
+    results (guards the local-slice gather and rho-axis indexing);
+(3) leader invariance of the consensus optimum: the tracking cost is carried
+    exactly once whichever agent leads (reference rqp_cadmm.py:231-233
+    scales k_f/k_m by 1/n), so switching leaders must not move the optimum."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_aerial_transport.control import cadmm, centralized, dd
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.ops import lie
+
+ACC = (jnp.array([0.5, 0.1, 0.0]), jnp.zeros(3))
+
+
+def _state(n, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    return rqp.rqp_state(
+        R=lie.expm_so3(0.1 * jax.random.normal(ks[0], (n, 3))),
+        w=0.1 * jax.random.normal(ks[1], (n, 3)),
+        xl=jnp.zeros(3),
+        vl=0.3 * jax.random.normal(ks[2], (3,)),
+        Rl=lie.expm_so3(0.05 * jax.random.normal(ks[3], (3,))),
+        wl=jnp.zeros(3),
+    )
+
+
+def test_dd_plan_qn_matches_world_frame():
+    """Direct pin of the payload-frame QN precompute: the plan-based dual
+    step (rotate violations in, apply qn_inv_base + rank-9 Woodbury leader
+    correction, rotate the F-step out) must equal the quasi-Newton step
+    computed entirely in the WORLD frame from the current state — the
+    per-step formulation the plan replaced (reference rqp_dd.py:634-657).
+    Non-default leader exercises the Woodbury path; k_smooth = 0 so the
+    preconditioner is exact."""
+    import numpy as np
+
+    n = 4
+    params, col, _ = setup.rqp_setup(n)
+    cfg = dd.make_config(params, col.collision_radius, col.max_deceleration)
+    cfg = cadmm.set_leader(cfg, 2)
+    base = cfg.base
+    state = _state(n, seed=5)
+    dtype = jnp.float32
+
+    # --- World-frame QN matrix from the live state.
+    leaders_full = (jnp.arange(n) == base.leader_idx).astype(dtype)
+    Q_w = jax.vmap(
+        lambda r_i, R_i, w_i, ld: dd.strong_convexity_matrix(
+            params, base, state, r_i, R_i, w_i, ld, cfg.sc_eps
+        )
+    )(params.r_com, state.R, state.w, leaders_full)
+    Qinv_w = jnp.linalg.inv(Q_w)
+    Ac_w = dd._consensus_matrix(params, state.Rl)
+    Ac_blocks = Ac_w.reshape(6 * n, n, 9)
+    AQinv = jnp.einsum("mnj,njk->mnk", Ac_blocks, Qinv_w).reshape(6 * n, 9 * n)
+    qn_w = AQinv @ Ac_w.T + cfg.beta * jnp.eye(6 * n, dtype=dtype)
+    grad_w = jax.random.normal(jax.random.PRNGKey(8), (n, 6))
+    step_w = jnp.linalg.solve(
+        qn_w, grad_w.reshape(-1)
+    ).reshape(n, 6)
+
+    # --- Plan path (mirrors dd.control's Woodbury block).
+    plan = dd.make_dd_plan(params, cfg)
+    li = int(base.leader_idx)
+    A_l = plan.Ac[:, 9 * li : 9 * li + 9]
+    Dl = plan.D[li]
+    Pb = plan.qn_inv_base
+    PA = Pb @ A_l
+    K9 = jnp.eye(9, dtype=dtype) + Dl @ (A_l.T @ PA)
+    qn_inv_p = Pb - PA @ jnp.linalg.solve(K9, Dl @ PA.T)
+    grad_t = jnp.concatenate(
+        [grad_w[:, :3] @ state.Rl, grad_w[:, 3:]], axis=1
+    )
+    step_t = (qn_inv_p @ grad_t.reshape(-1)).reshape(n, 6)
+    step_p = jnp.concatenate(
+        [step_t[:, :3] @ state.Rl.T, step_t[:, 3:]], axis=1
+    )
+
+    err = float(jnp.abs(step_p - step_w).max())
+    scale = float(jnp.abs(step_w).max())
+    assert err < 2e-3 * max(scale, 1.0), \
+        f"plan QN step deviates from world-frame QN step: {err} (scale {scale})"
+    assert np.isfinite(err)
+
+
+def test_cadmm_plan_vs_inline():
+    n = 5
+    params, col, _ = setup.rqp_setup(n)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=40, inner_iters=60, res_tol=1e-3,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    state = _state(n)
+    a0 = cadmm.init_cadmm_state(params, cfg)
+    f_inline, _, st_inline = cadmm.control(params, cfg, f_eq, a0, state, ACC)
+    plan = cadmm.make_plan(params, cfg)
+    assert plan is not None
+    f_plan, _, st_plan = cadmm.control(
+        params, cfg, f_eq, a0, state, ACC, plan=plan
+    )
+    assert float(jnp.abs(f_plan - f_inline).max()) < 1e-5
+    assert int(st_plan.iters) == int(st_inline.iters)
+
+
+def test_dd_plan_vs_inline():
+    n = 4
+    params, col, _ = setup.rqp_setup(n)
+    cfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=40, inner_iters=60,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    state = _state(n, seed=1)
+    d0 = dd.init_dd_state(params, cfg)
+    f_inline, _, st_inline = dd.control(params, cfg, f_eq, d0, state, ACC)
+    plan = dd.make_dd_plan(params, cfg)
+    f_plan, _, st_plan = dd.control(
+        params, cfg, f_eq, d0, state, ACC, plan=plan
+    )
+    assert float(jnp.abs(f_plan - f_inline).max()) < 1e-5
+    assert int(st_plan.iters) == int(st_inline.iters)
+
+
+def test_leader_switch_reaches_same_optimum():
+    """The tracking cost is carried exactly once whichever agent leads, so
+    the consensus optimum is leader-invariant. For DD this exercises the
+    rank-9 Woodbury correction at a non-default leader against the
+    precomputed base QN inverse."""
+    n = 5
+    params, col, _ = setup.rqp_setup(n)
+    f_eq = centralized.equilibrium_forces(params)
+    state = _state(n, seed=2)
+
+    acfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=60, inner_iters=80, res_tol=1e-3,
+    )
+    a0 = cadmm.init_cadmm_state(params, acfg)
+    f0, _, _ = cadmm.control(params, acfg, f_eq, a0, state, ACC)
+    f1, _, st1 = cadmm.control(
+        params, cadmm.set_leader(acfg, 3), f_eq, a0, state, ACC
+    )
+    assert int(st1.iters) <= acfg.max_iter
+    assert float(jnp.abs(f1 - f0).max()) < 3e-2, "cadmm leader variance"
+
+    dcfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=60, inner_iters=80,
+    )
+    plan = dd.make_dd_plan(params, dcfg)
+    d0 = dd.init_dd_state(params, dcfg)
+    g0, _, _ = dd.control(params, dcfg, f_eq, d0, state, ACC, plan=plan)
+    g1, _, st2 = dd.control(
+        params, cadmm.set_leader(dcfg, 3), f_eq, d0, state, ACC, plan=plan
+    )
+    assert int(st2.iters) <= dcfg.base.max_iter
+    assert float(jnp.abs(g1 - g0).max()) < 3e-2, "dd leader variance"

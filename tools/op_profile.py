@@ -1,0 +1,107 @@
+"""Op-level attribution table from a ``jax.profiler.trace`` capture.
+
+Parses the raw ``*.xplane.pb`` written by ``bench.py --profile DIR`` (the
+SURVEY.md §5.1 tracing tier) without TensorBoard: aggregates XLA op event
+durations per op name from the device planes and prints a markdown table of
+the top-k ops by total self time. The tensorboard profile plugin's converter
+is broken against this image's TF build, so this reads the xplane proto
+directly (``tensorflow.tsl.profiler.protobuf.xplane_pb2``).
+
+Usage:
+  python bench.py --profile /tmp/trace
+  python tools/op_profile.py /tmp/trace --top 30 [--json artifacts/op_profile.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+from collections import defaultdict
+
+
+def load_xplanes(trace_dir: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
+    spaces = []
+    for p in paths:
+        xs = xplane_pb2.XSpace()
+        with open(p, "rb") as fh:
+            xs.ParseFromString(fh.read())
+        spaces.append(xs)
+    return spaces
+
+
+def device_op_times(spaces) -> dict[str, dict]:
+    """name -> {total_us, count} aggregated over device-plane XLA op events.
+
+    Device planes are named like '/device:TPU:0'; each line's events carry
+    duration_ps and an event-metadata name (the XLA op / fusion name)."""
+    agg = defaultdict(lambda: {"total_us": 0.0, "count": 0})
+    for xs in spaces:
+        for plane in xs.planes:
+            # Compute planes: '/device:TPU:0' on accelerator captures,
+            # '/host:CPU' on host-only captures (metadata/task planes skipped).
+            is_compute = ("device:" in plane.name or "TPU" in plane.name
+                          or plane.name == "/host:CPU")
+            if not is_compute:
+                continue
+            meta = plane.event_metadata
+            # Prefer XLA-op lines (non-overlapping op events): 'XLA Ops' on
+            # TPU device planes, 'xla-cpu-codegen' on host captures. The
+            # 'python' line holds nested host frames that would double-count.
+            lines = [l for l in plane.lines
+                     if "XLA Ops" in l.name or "xla" in l.name.lower()]
+            if not lines:
+                lines = [l for l in plane.lines if l.name != "python"]
+            for line in lines:
+                for ev in line.events:
+                    name = meta[ev.metadata_id].name if ev.metadata_id in meta \
+                        else f"id{ev.metadata_id}"
+                    agg[name]["total_us"] += ev.duration_ps / 1e6
+                    agg[name]["count"] += 1
+    return dict(agg)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--json", default=None, metavar="PATH")
+    args = ap.parse_args()
+
+    agg = device_op_times(load_xplanes(args.trace_dir))
+    if not agg:
+        raise SystemExit("no device-plane op events found in the trace")
+    total = sum(v["total_us"] for v in agg.values())
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total_us"])[: args.top]
+
+    print(f"# device op self-time, top {args.top} of {len(agg)} ops "
+          f"({total / 1e3:.2f} ms total on-device)")
+    print("| op | total ms | calls | % of device time |")
+    print("|---|---|---|---|")
+    table = []
+    for name, v in rows:
+        pct = 100.0 * v["total_us"] / total
+        short = name if len(name) <= 90 else name[:87] + "..."
+        print(f"| `{short}` | {v['total_us'] / 1e3:.3f} | {v['count']} "
+              f"| {pct:.1f} |")
+        table.append({"op": name, "total_ms": v["total_us"] / 1e3,
+                      "calls": v["count"], "pct_device_time": pct})
+
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as fh:
+            json.dump({"device_total_ms": total / 1e3, "top_ops": table}, fh,
+                      indent=1)
+        print(f"written to {args.json}")
+
+
+if __name__ == "__main__":
+    main()

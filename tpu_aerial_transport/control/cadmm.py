@@ -984,16 +984,12 @@ def control(
         okf = jnp.minimum(okf, _mean_over_agents(ok_flat.astype(dtype)))
         return f_new, lam_new, f_mean_new, sols, it, res_new, err_buf, okf
 
-    def consensus_iter(solve_one, carry):
-        # Per-lane convergence freeze: once THIS scenario's residual is under
-        # tolerance, pass the carry through untouched. Inside a vmapped batch
-        # the while_loop runs every lane until the slowest converges; without
-        # the freeze, converged lanes would keep iterating (drifting iterates,
-        # inflated iteration counts) — with it, each lane's result is exactly
-        # what a solo run would produce.
-        new = _consensus_iter_impl(solve_one, carry)
-        active = carry[5] >= cfg.res_tol
-        return jax.tree.map(lambda a, b: jnp.where(active, a, b), new, carry)
+    # Per-lane batch semantics: no manual freeze is needed — lax.while_loop's
+    # batching rule re-evaluates the full per-lane cond inside the body and
+    # selects old-vs-new carry per lane, so in a vmapped batch a converged
+    # scenario's carry stays frozen while the loop drains the slowest lane,
+    # and each lane's result equals a solo run's exactly.
+    consensus_iter = _consensus_iter_impl
 
     def cond(carry):
         *_, it, res, _buf, _okf = carry

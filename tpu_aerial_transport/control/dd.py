@@ -585,13 +585,10 @@ def control(
         return (f_new, F_new, M_new, lam_F_new, lam_M_new, warm_new, it,
                 err_new, err_buf, okf)
 
-    def dd_iter_frozen(carry):
-        # Per-lane convergence freeze (same rationale as the C-ADMM loop):
-        # in a vmapped batch, converged scenarios pass through untouched while
-        # the while_loop drains the slowest lane.
-        new = dd_iter(carry)
-        active = carry[7] >= cfg.prim_inf_tol
-        return jax.tree.map(lambda a, b: jnp.where(active, a, b), new, carry)
+    # Per-lane batch semantics: lax.while_loop's batching rule already
+    # selects old-vs-new carry per lane from the full per-lane cond, so
+    # converged scenarios stay frozen inside a vmapped batch (see the
+    # matching note in cadmm.control) — no manual freeze wrapper.
 
     def cond(carry):
         *_, it, err, _buf, _okf = carry
@@ -604,7 +601,7 @@ def control(
         err_buf0, jnp.ones((), dtype),
     )
     f, F, M, lam_F, lam_M, warm, iters, err, err_buf, ok_frac = lax.while_loop(
-        cond, dd_iter_frozen, init
+        cond, dd_iter, init
     )
 
     new_state = DDState(f=f, F=F, M=M, lam_F=lam_F, lam_M=lam_M, warm=warm)
