@@ -491,30 +491,86 @@ def _batched(controller, n, n_scenarios, n_steps=10):
     return measure(step, css, states, jax.devices()[0], n_steps, n_scenarios)
 
 
-def sweep():
-    results = {}
+SWEEP_PARTIAL_PATH = "BENCH_SWEEP_PARTIAL.json"
+
+
+def _git_head() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)), timeout=10,
+        )
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _write_json_atomic(path: str, payload) -> None:
+    """Temp-file + os.replace so an abrupt death mid-write (the exact crash
+    the checkpoint exists to survive) cannot truncate the checkpoint."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=1)
+    os.replace(tmp, path)
+
+
+def sweep(resume: bool = False):
+    """Full BASELINE.json matrix. Each config's result is checkpointed to
+    ``BENCH_SWEEP_PARTIAL.json`` as soon as it is measured, and ``--resume``
+    skips already-measured configs — the axon tunnel has died mid-sweep
+    (~1.5-2 h of compiles) more than once, and without checkpointing every
+    completed config was lost with it. The checkpoint is stamped with the
+    git HEAD it was measured at; resuming across code changes is refused so
+    stale numbers cannot silently mix into BENCH_SWEEP.json."""
+    head = _git_head()
+    results = {"_meta": {"git_head": head}}
+    if resume and os.path.exists(SWEEP_PARTIAL_PATH):
+        with open(SWEEP_PARTIAL_PATH) as fh:
+            cached = json.load(fh)
+        cached_head = cached.get("_meta", {}).get("git_head", "missing")
+        if cached_head != head:
+            raise SystemExit(
+                f"refusing --resume: {SWEEP_PARTIAL_PATH} was measured at "
+                f"git {cached_head[:12]} but HEAD is {head[:12]} — the cached "
+                "numbers would silently mix with post-change ones. Delete "
+                "the partial file to start fresh."
+            )
+        results = cached
+        print(f"# resuming sweep: {len(results) - 1} configs cached "
+              f"({sorted(k for k in results if k != '_meta')})", flush=True)
+
+    def record(key, value):
+        results[key] = value
+        _write_json_atomic(SWEEP_PARTIAL_PATH, results)
+        print(f"# {key}: {value}", flush=True)
+
     # MPC steps/sec/chip at N in {4, 16, 64} for all three controllers.
     for ctrl in ("centralized", "cadmm", "dd"):
         for n in (4, 16, 64):
             key = f"{ctrl}_n{n}_single"
-            results[key] = _single_stream(ctrl, n)
-            print(f"# {key}: {results[key]}", flush=True)
+            if key in results:
+                continue
+            record(key, _single_stream(ctrl, n))
     # Batched throughput (the TPU's actual operating point) at the same Ns.
     for ctrl in ("cadmm", "dd"):
         for n, ns in ((4, 256), (16, 128), (64, 64)):
             key = f"{ctrl}_n{n}_batch{ns}"
+            if key in results:
+                continue
             rate = _batched(ctrl, n, ns)
-            results[key] = {"scenario_mpc_steps_per_sec": rate,
-                            "agent_mpc_steps_per_sec": rate * n}
-            print(f"# {key}: {results[key]}", flush=True)
+            record(key, {"scenario_mpc_steps_per_sec": rate,
+                         "agent_mpc_steps_per_sec": rate * n})
     # Swarm (BASELINE.json config 5): 128 payloads x 8 quads = 1024 agents.
-    rate = _batched("cadmm", 8, 128)
-    results["swarm_128x8"] = {"scenario_mpc_steps_per_sec": rate,
-                              "agent_mpc_steps_per_sec": rate * 8}
-    print(f"# swarm_128x8: {results['swarm_128x8']}", flush=True)
+    if "swarm_128x8" not in results:
+        rate = _batched("cadmm", 8, 128)
+        record("swarm_128x8", {"scenario_mpc_steps_per_sec": rate,
+                               "agent_mpc_steps_per_sec": rate * 8})
     # North-star ratio (BASELINE.json): TPU throughput vs the reference-
     # architecture CPU baseline at 64 agents.
     for n, ns in ((8, 256), (64, 64)):
+        ns_key = f"north_star_n{n}"
+        if ns_key in results:
+            continue
         try:
             ref = ref_arch_cpu_rate(n=n, n_steps=3)
         except Exception as e:  # native solver unavailable/failed: keep the
@@ -526,16 +582,15 @@ def sweep():
                 tpu = results[key]["scenario_mpc_steps_per_sec"]
             else:
                 tpu = _batched("cadmm", n, ns)
-            results[f"north_star_n{n}"] = {
+            record(ns_key, {
                 "tpu_scenario_mpc_steps_per_sec": tpu,
                 "ref_arch_cpu_mpc_steps_per_sec": ref,
                 "ratio": tpu / ref,
-            }
-            print(f"# north_star_n{n}: {results[f'north_star_n{n}']}",
-                  flush=True)
+            })
 
-    with open("BENCH_SWEEP.json", "w") as fh:
-        json.dump(results, fh, indent=1)
+    _write_json_atomic("BENCH_SWEEP.json", results)
+    if os.path.exists(SWEEP_PARTIAL_PATH):
+        os.remove(SWEEP_PARTIAL_PATH)
 
     # Markdown table for BASELINE.md.
     print("\n| Config | MPC steps/s | mean step ms | ms/consensus-iter |")
@@ -786,6 +841,9 @@ def roofline(out_path: str = "artifacts/roofline.json"):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--resume", action="store_true",
+                    help="with --sweep: skip configs already checkpointed "
+                         "in BENCH_SWEEP_PARTIAL.json")
     ap.add_argument("--components", action="store_true")
     ap.add_argument("--roofline", action="store_true")
     ap.add_argument("--profile", default=None, metavar="DIR")
@@ -797,7 +855,7 @@ def main():
                    else HEADLINE_METRIC)
     platform = ensure_backend_or_die(metric=mode_metric)
     if args.sweep:
-        sweep()
+        sweep(resume=args.resume)
     elif args.components:
         components()
     elif args.roofline:
