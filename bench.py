@@ -495,12 +495,25 @@ SWEEP_PARTIAL_PATH = "BENCH_SWEEP_PARTIAL.json"
 
 
 def _git_head() -> str:
+    """HEAD SHA with a ``-dirty`` suffix when the tree has uncommitted
+    changes (mid-debug edits must invalidate sweep checkpoints too);
+    ``unknown`` when git is unavailable (treated as never matching)."""
+    here = os.path.dirname(os.path.abspath(__file__))
     try:
         out = subprocess.run(
             ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)), timeout=10,
+            cwd=here, timeout=10,
         )
-        return out.stdout.strip() or "unknown"
+        head = out.stdout.strip()
+        if not head:
+            return "unknown"
+        # Tracked files only: the sweep's own untracked checkpoint file must
+        # not mark the tree dirty (that would always refuse resume).
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=no"],
+            capture_output=True, text=True, cwd=here, timeout=10,
+        ).stdout.strip()
+        return head + ("-dirty" if dirty else "")
     except Exception:
         return "unknown"
 
@@ -524,15 +537,24 @@ def sweep(resume: bool = False):
     stale numbers cannot silently mix into BENCH_SWEEP.json."""
     head = _git_head()
     results = {"_meta": {"git_head": head}}
+    if os.path.exists(SWEEP_PARTIAL_PATH) and not resume:
+        raise SystemExit(
+            f"{SWEEP_PARTIAL_PATH} exists (a crashed sweep's checkpoint, "
+            "possibly hours of measurements). Pass --resume to continue it, "
+            "or delete the file to start fresh — refusing to overwrite."
+        )
     if resume and os.path.exists(SWEEP_PARTIAL_PATH):
         with open(SWEEP_PARTIAL_PATH) as fh:
             cached = json.load(fh)
         cached_head = cached.get("_meta", {}).get("git_head", "missing")
-        if cached_head != head:
+        # 'unknown'/'-dirty' states never match safely: dirty trees can
+        # differ between the two runs even at the same SHA.
+        if cached_head != head or "unknown" in (cached_head, head) \
+                or head.endswith("-dirty"):
             raise SystemExit(
                 f"refusing --resume: {SWEEP_PARTIAL_PATH} was measured at "
-                f"git {cached_head[:12]} but HEAD is {head[:12]} — the cached "
-                "numbers would silently mix with post-change ones. Delete "
+                f"git {cached_head[:19]} but HEAD is {head[:19]} — the cached "
+                "numbers could silently mix with post-change ones. Delete "
                 "the partial file to start fresh."
             )
         results = cached

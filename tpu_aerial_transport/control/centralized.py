@@ -70,6 +70,12 @@ class RQPCentralizedConfig:
     n_env_cbfs: int = struct.field(pytree_node=False, default=10)
     solver_iters: int = struct.field(pytree_node=False, default=150)
     solver_tol: float = struct.field(pytree_node=False, default=5e-3)
+    # Early-exit cadence for the conic solve: check residuals every this
+    # many inner iterations and stop once both are under solver_tol (0 =
+    # always run the full solver_iters budget). Warm-started receding-
+    # horizon steps typically converge in a fraction of the budget, so this
+    # mirrors Clarabel's own tolerance-based termination in the reference.
+    solver_check_every: int = struct.field(pytree_node=False, default=25)
     max_f_ang: float = struct.field(pytree_node=False, default=jnp.pi / 6)
 
 
@@ -342,6 +348,8 @@ def control(
         iters=cfg.solver_iters,
         warm=ctrl_state.warm,
         shift=shift,
+        check_every=cfg.solver_check_every,
+        tol=cfg.solver_tol,
     )
     f = sol.x[9:].reshape(n, 3)
     ok = (sol.prim_res < cfg.solver_tol) & jnp.all(jnp.isfinite(sol.x))
