@@ -1,0 +1,115 @@
+"""PMRL centralized controller tests (a capability BEYOND the reference,
+which ships PMRL as dynamics+viz only — see control/pmrl_centralized.py).
+
+Oracles: (1) the jacfwd-extracted affine dynamics must reproduce the true
+forward dynamics exactly at the solved thrusts (the map IS affine);
+(2) closed-loop setpoint tracking stays finite, respects the tilt CBF, and
+converges toward the target; (3) equilibrium thrusts hover."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_aerial_transport.control import pmrl_centralized as ctrl
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.models import pmrl
+
+
+def _setup(n=3):
+    params, col, state = setup.pmrl_setup(n)
+    cfg = ctrl.make_config(params, solver_iters=250)
+    return params, col, state, cfg
+
+
+def test_affine_dynamics_is_exact():
+    """B f + c must equal forward_dynamics' payload accelerations at random
+    thrusts — jacfwd of an affine map is exact, machine precision."""
+    n = 4
+    params, col, state, cfg = _setup(n)
+    state = state.replace(
+        q=state.q + 0.1 * jax.random.normal(jax.random.PRNGKey(0), (n, 3)),
+        dq=0.2 * jax.random.normal(jax.random.PRNGKey(1), (n, 3)),
+        wl=jnp.array([0.1, -0.05, 0.2]),
+    )
+    state = pmrl.pmrl_state(state.q, state.dq, state.xl, state.vl,
+                            state.Rl, state.wl)
+    B, c, B_rob, c_rob = ctrl._affine_dynamics(params, state)
+    for seed in range(3):
+        f = 2.0 * jax.random.normal(jax.random.PRNGKey(10 + seed), (n, 3))
+        (ddq, dvl, dwl), _ = pmrl.forward_dynamics(params, state, f)
+        pred = B @ f.reshape(-1) + c
+        err = float(jnp.abs(pred - jnp.concatenate([dvl, dwl])).max())
+        assert err < 1e-3, f"affine payload map mismatch: {err}"
+        # Robot-acceleration map: ddx = dvl + L ddq + Rl(hat^2(wl)+hat(dwl)) r.
+        from tpu_aerial_transport.ops import lie
+        kin = (lie.hat_square(state.wl, state.wl) + lie.hat(dwl)) @ params.r.T
+        ddx = dvl[None] + ddq * params.L[:, None] + (state.Rl @ kin).T
+        pred_r = (B_rob @ f.reshape(-1) + c_rob).reshape(n, 3)
+        err_r = float(jnp.abs(pred_r - ddx).max())
+        assert err_r < 1e-3, f"affine robot map mismatch: {err_r}"
+
+
+def test_equilibrium_forces_hover():
+    """At the setup state (vertical links), the equilibrium thrusts must
+    produce ~zero payload acceleration and taut links (positive tension)."""
+    params, col, state, cfg = _setup(3)
+    f_eq = ctrl.equilibrium_forces(params, state)
+    (ddq, dvl, dwl), T = pmrl.forward_dynamics(params, state, f_eq)
+    assert float(jnp.abs(dvl).max()) < 1e-4
+    assert float(jnp.abs(dwl).max()) < 1e-4
+    assert bool(jnp.all(T > 0)), "links must be taut at equilibrium"
+
+
+def test_closed_loop_setpoint():
+    """Track a position setpoint with a PD outer loop: the payload must move
+    toward the target, stay finite, and keep the tilt CBF satisfied."""
+    n = 3
+    params, col, state0, cfg = _setup(n)
+    cs0 = ctrl.init_ctrl_state(params, cfg, state0)
+    target = jnp.array([0.4, -0.2, 0.3])
+    dt, n_steps = 1e-2, 800
+
+    def body(carry, _):
+        cs, s = carry
+        # Damping-heavy PD: the payload hangs below swinging links, so the
+        # lateral pendulum mode needs velocity damping to settle.
+        dvl_des = -3.0 * s.vl - 1.5 * (s.xl - target)
+        # Reference-style norm clamp (rqp_example.py:33-59 clamps at 1.0).
+        nrm = jnp.linalg.norm(dvl_des)
+        dvl_des = dvl_des * jnp.minimum(1.0, 1.0 / jnp.maximum(nrm, 1e-9))
+        f, cs, stats = ctrl.control(
+            params, cfg, cs, s, (dvl_des, jnp.zeros(3))
+        )
+        s = pmrl.integrate(params, s, f, dt)
+        return (cs, s), (s.xl, s.Rl[2, 2], stats.ok_frac)
+
+    (cs, s_fin), (xs, tilt, okf) = jax.jit(
+        lambda c: jax.lax.scan(body, c, None, length=n_steps)
+    )((cs0, state0))
+
+    assert bool(jnp.all(jnp.isfinite(xs)))
+    final_err = float(jnp.linalg.norm(s_fin.xl - target))
+    initial_err = float(jnp.linalg.norm(target))
+    assert final_err < 0.4 * initial_err, \
+        f"did not approach target: {final_err} vs {initial_err}"
+    # Tilt CBF: cos(payload tilt) stays above the 30-deg bound.
+    assert float(tilt.min()) > cfg.cos_max_p_ang - 1e-3
+    # Solver healthy throughout: no equilibrium/prev-force fallbacks.
+    assert float(okf.min()) == 1.0
+
+
+def test_jits_under_scan_any_n():
+    for n in (3, 5):
+        params, col, state0, cfg = (_setup(n) + (None,))[:4]
+        params, col, state0 = setup.pmrl_setup(n)
+        cfg = ctrl.make_config(params)
+        cs0 = ctrl.init_ctrl_state(params, cfg, state0)
+
+        def body(carry, _):
+            cs, s = carry
+            f, cs, _ = ctrl.control(params, cfg, cs, s, (jnp.zeros(3), jnp.zeros(3)))
+            return (cs, pmrl.integrate(params, s, f, 1e-2)), f
+
+        (_, s_fin), fs = jax.jit(
+            lambda c: jax.lax.scan(body, c, None, length=5)
+        )((cs0, state0))
+        assert bool(jnp.all(jnp.isfinite(fs))), n

@@ -1,0 +1,317 @@
+"""Centralized QP + CBF safety-filter controller for the PMRL model.
+
+The reference ships PMRL as dynamics + visualization only — no controller
+exists for it ("future-work model", SURVEY.md §2.3; reference
+system/point_mass_rigid_link.py). This module closes that gap with the same
+controller family the reference builds for RP/RQP (control/rp_centralized.py
+:11-22 problem shape, constants scaled to the PMRL assembly), designed
+TPU-first:
+
+- PMRL accelerations are **exactly affine** in the applied robot thrusts:
+  the link tensions solve a linear SPD system whose right-hand side is
+  affine in ``f`` (models/pmrl.py:100-143), so ``(dvl, dwl) = B f + c``
+  exactly. ``B`` is extracted with one ``jax.jacfwd`` over the true forward
+  dynamics — no hand linearization to drift out of sync with the model.
+- Decision variables ``[dvl | dwl | f_1..f_n]`` with the affine dynamics as
+  equality rows; tracking/regularization costs; payload tilt / |wl| / |vl|
+  CBF rows (identical math to rp_centralized.py:153-175); per-robot
+  min-vertical-thrust, thrust-cone, and norm-cap constraints. Point-mass
+  robots have no attitude, so the solved ``f`` applies directly — there is
+  no low-level attitude stage.
+- Equilibrium thrusts are state-dependent here (they depend on the current
+  link directions): tensions solve the static wrench balance
+  ``sum T_i q_i = ml g e3``, ``sum r_i x Rl^T (T_i q_i) = 0`` in least
+  squares, then ``f_eq,i = m_i g e3 + T_i q_i`` (the PMRL analogue of
+  reference rp_centralized.py:122-130).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from tpu_aerial_transport.control.types import SolverStats
+from tpu_aerial_transport.models import pmrl
+from tpu_aerial_transport.models.pmrl import GRAVITY, PMRLParams, PMRLState
+from tpu_aerial_transport.ops import lie, socp
+
+
+@struct.dataclass
+class PMRLCentralizedConfig:
+    min_fz: float
+    sec_max_f_ang: float
+    max_f: float
+    cos_max_p_ang: float
+    alpha1_p_cbf: float
+    alpha2_p_cbf: float
+    max_wl_sq: float
+    alpha_wl_cbf: float
+    max_vl_sq: float
+    alpha_vl_cbf: float
+    k_f: float
+    k_feq: float
+    k_dvl: float
+    k_dwl: float
+    # Robot-acceleration tracking weight. Essential for PMRL: link tensions
+    # act along the links, so at (near-)vertical links the payload has ~zero
+    # instantaneous lateral authority and a payload-acceleration cost alone
+    # cannot command the link swing that creates it. Tracking desired ROBOT
+    # accelerations (also exactly affine in f) swings the links, which then
+    # drives the payload — the standard cable/link-suspended flying pattern.
+    k_rob: float = 1.0
+    # Swing damping in the default robot-acceleration target:
+    # a_des,i = dvl_des - swing_damp * L_i dq_i. Undamped link swing drives
+    # payload-speed excursions whose |vl| CBF row can become infeasible
+    # against the thrust-cone limits (every such step falls back to the
+    # previous forces, which feeds the oscillation).
+    swing_damp: float = 2.0
+    solver_iters: int = struct.field(pytree_node=False, default=150)
+    solver_tol: float = struct.field(pytree_node=False, default=5e-3)
+    solver_check_every: int = struct.field(pytree_node=False, default=25)
+
+
+def make_config(params: PMRLParams,
+                solver_iters: int = 150) -> PMRLCentralizedConfig:
+    """RP-centralized constants (reference rp_centralized.py:147-175) scaled
+    to the PMRL assembly's total mass ``ml + sum m_i``."""
+    n = params.n
+    mTg = float(params.ml + jnp.sum(params.m)) * GRAVITY
+    return PMRLCentralizedConfig(
+        min_fz=mTg / (n * 10.0),
+        sec_max_f_ang=float(1.0 / jnp.cos(jnp.pi / 6.0)),
+        max_f=2.0 * mTg / n,
+        cos_max_p_ang=float(jnp.cos(jnp.pi / 6.0)),  # 30 deg, as for RP.
+        alpha1_p_cbf=1.0,
+        alpha2_p_cbf=1.0,
+        max_wl_sq=float((jnp.pi / 6.0) ** 2),
+        alpha_wl_cbf=1.0,
+        max_vl_sq=1.0,
+        alpha_vl_cbf=1.0,
+        k_f=0.1,
+        k_feq=0.1,
+        k_dvl=1.0,
+        k_dwl=1.0,
+        k_rob=1.0,
+        swing_damp=2.0,
+        solver_iters=solver_iters,
+    )
+
+
+def equilibrium_forces(params: PMRLParams, state: PMRLState) -> jnp.ndarray:
+    """State-dependent static thrusts ``(n, 3)``: least-squares tensions
+    balancing the payload wrench along the CURRENT link directions, plus each
+    robot's own weight (see module docstring)."""
+    q, Rl = state.q, state.Rl
+    e3 = jnp.array([0.0, 0.0, 1.0], dtype=q.dtype)
+    rcq = jnp.cross(params.r, q @ Rl)  # (n, 3) rows r_i x (Rl^T q_i).
+    A = jnp.concatenate([q.T, rcq.T], axis=0)  # (6, n)
+    b = jnp.concatenate([params.ml * GRAVITY * e3, jnp.zeros(3, q.dtype)])
+    T = jnp.linalg.lstsq(A, b)[0]  # (n,)
+    return params.m[:, None] * GRAVITY * e3[None, :] + T[:, None] * q
+
+
+@struct.dataclass
+class CtrlState:
+    prev_f: jnp.ndarray  # (n, 3)
+    warm: socp.SOCPSolution
+
+
+def qp_dims(n: int):
+    """(n_box, m, soc_dims): box rows [dyn-dvl 3 | dyn-dwl 3 | fz n | tilt 1 |
+    wl 1 | vl 1]; per robot SOC(4) cone + SOC(4) norm cap."""
+    n_box = 9 + n
+    soc_dims = (4,) * (2 * n)
+    return n_box, n_box + sum(soc_dims), soc_dims
+
+
+def init_ctrl_state(params: PMRLParams, cfg: PMRLCentralizedConfig,
+                    state: PMRLState) -> CtrlState:
+    n = params.n
+    _, m, _ = qp_dims(n)
+    f_eq = equilibrium_forces(params, state)
+    x0 = jnp.concatenate([jnp.zeros(6, f_eq.dtype), f_eq.reshape(-1)])
+    warm = socp.SOCPSolution(
+        x=x0,
+        y=jnp.zeros((m,), f_eq.dtype),
+        z=jnp.zeros((m,), f_eq.dtype),
+        prim_res=jnp.zeros((), f_eq.dtype),
+        dual_res=jnp.zeros((), f_eq.dtype),
+    )
+    return CtrlState(prev_f=f_eq, warm=warm)
+
+
+def _affine_dynamics(params: PMRLParams, state: PMRLState):
+    """Exact affine maps through the implicit tension solve (the dynamics
+    are affine in ``f``; models/pmrl.py:100-143): payload accelerations
+    ``[dvl; dwl] = B f + c`` (6, 3n) and robot accelerations
+    ``ddx = B_rob f + c_rob`` (3n, 3n), where
+    ``ddx_i = dvl + L_i ddq_i + Rl (hat^2(wl) + hat(dwl)) r_i`` is the
+    world-frame acceleration of robot i's point mass. ``c``s from a
+    zero-thrust evaluation, ``B``s via jacfwd (exact — the map is affine)."""
+    n = params.n
+    Rl, wl = state.Rl, state.wl
+    hat_sq = lie.hat_square(wl, wl)
+
+    def accs(f_flat):
+        (ddq, dvl, dwl), _ = pmrl.forward_dynamics(
+            params, state, f_flat.reshape(n, 3)
+        )
+        kin = (hat_sq + lie.hat(dwl)) @ params.r.T  # (3, n)
+        ddx = dvl[None, :] + ddq * params.L[:, None] + (Rl @ kin).T  # (n, 3)
+        return jnp.concatenate([dvl, dwl]), ddx.reshape(-1)
+
+    zero = jnp.zeros(3 * n, dtype=state.xl.dtype)
+    c, c_rob = accs(zero)
+    B, B_rob = jax.jacfwd(accs)(zero)  # (6, 3n), (3n, 3n).
+    return B, c, B_rob, c_rob
+
+
+def _build_qp(params: PMRLParams, cfg: PMRLCentralizedConfig, f_eq,
+              state: PMRLState, acc_des, rob_acc_des):
+    """Variables [dvl 0:3 | dwl 3:6 | f 6:6+3n]; rows per :func:`qp_dims`."""
+    n = params.n
+    dtype = state.xl.dtype
+    nv = 6 + 3 * n
+    dvl_des, dwl_des = acc_des
+    e3 = jnp.array([0.0, 0.0, 1.0], dtype=dtype)
+    Rl = state.Rl
+    mT = params.ml + jnp.sum(params.m)
+
+    P = jnp.zeros((nv, nv), dtype)
+    q = jnp.zeros((nv,), dtype)
+    P = P.at[0:3, 0:3].add(2.0 * cfg.k_dvl * jnp.eye(3, dtype=dtype))
+    q = q.at[0:3].add(-2.0 * cfg.k_dvl * dvl_des)
+    P = P.at[3:6, 3:6].add(2.0 * cfg.k_dwl * jnp.eye(3, dtype=dtype))
+    q = q.at[3:6].add(-2.0 * cfg.k_dwl * dwl_des)
+    S = jnp.tile(jnp.eye(3, dtype=dtype), (1, n))
+    P = P.at[6:, 6:].add(
+        2.0 * cfg.k_f * (S.T @ S) + 2.0 * cfg.k_feq * jnp.eye(3 * n, dtype=dtype)
+    )
+    q = q.at[6:].add(
+        -2.0 * cfg.k_f * (S.T @ (mT * GRAVITY * e3))
+        - 2.0 * cfg.k_feq * f_eq.reshape(-1)
+    )
+
+    n_box, _, _ = qp_dims(n)
+    A = jnp.zeros((n_box, nv), dtype)
+    lb = jnp.zeros((n_box,), dtype)
+    ub = jnp.zeros((n_box,), dtype)
+
+    B, c, B_rob, c_rob = _affine_dynamics(params, state)
+
+    # Robot-acceleration tracking (see k_rob docstring): quadratic in f only.
+    resid0 = c_rob - rob_acc_des.reshape(-1)
+    P = P.at[6:, 6:].add(2.0 * cfg.k_rob * (B_rob.T @ B_rob))
+    q = q.at[6:].add(2.0 * cfg.k_rob * (B_rob.T @ resid0))
+
+    # Exact affine dynamics rows: [dvl; dwl] - B f = c, row-equilibrated —
+    # the dwl rows carry Jl_inv ~ O(50) entries vs O(1) dvl rows, and the
+    # solver's EQ_RHO_SCALE amplifies the mismatch into f32 ADMM stalls as
+    # the links swing (same treatment as the C-ADMM Schur plan's coupling
+    # rows).
+    dyn = jnp.concatenate([jnp.eye(6, dtype=dtype), -B], axis=1)  # (6, nv)
+    scale = 1.0 / jnp.linalg.norm(dyn, axis=1)
+    A = A.at[0:6, :].set(dyn * scale[:, None])
+    lb = lb.at[0:6].set(c * scale)
+    ub = ub.at[0:6].set(c * scale)
+
+    # Per-robot vertical-thrust floor.
+    for i in range(n):
+        A = A.at[6 + i, 6 + 3 * i + 2].set(1.0)
+    lb = lb.at[6 : 6 + n].set(cfg.min_fz)
+    ub = ub.at[6 : 6 + n].set(socp.INF)
+
+    # Payload tilt / |wl| / |vl| CBF rows (identical math to
+    # rp_centralized.py:153-175).
+    R_w_hat = Rl @ lie.hat(state.wl)
+    R_w_hat_sq = Rl @ lie.hat_square(state.wl, state.wl)
+    r_tilt = 6 + n
+    A = A.at[r_tilt, 3:6].set(-(Rl[2] @ lie.hat(e3)))
+    tilt_rhs = (
+        -R_w_hat_sq[2, 2]
+        - (cfg.alpha1_p_cbf + cfg.alpha2_p_cbf) * R_w_hat[2, 2]
+        - cfg.alpha1_p_cbf * cfg.alpha2_p_cbf * (Rl[2, 2] - cfg.cos_max_p_ang)
+    )
+    lb = lb.at[r_tilt].set(tilt_rhs)
+    ub = ub.at[r_tilt].set(socp.INF)
+
+    A = A.at[7 + n, 3:6].set(-2.0 * state.wl)
+    lb = lb.at[7 + n].set(
+        -cfg.alpha_wl_cbf * (cfg.max_wl_sq - jnp.dot(state.wl, state.wl))
+    )
+    ub = ub.at[7 + n].set(socp.INF)
+
+    A = A.at[8 + n, 0:3].set(-2.0 * state.vl)
+    lb = lb.at[8 + n].set(
+        -cfg.alpha_vl_cbf * (cfg.max_vl_sq - jnp.dot(state.vl, state.vl))
+    )
+    ub = ub.at[8 + n].set(socp.INF)
+
+    soc = jnp.zeros((8 * n, nv), dtype)
+    shift_soc = jnp.zeros((8 * n,), dtype)
+    for i in range(n):
+        base = 8 * i
+        fi = 6 + 3 * i
+        soc = soc.at[base, fi + 2].set(cfg.sec_max_f_ang)
+        soc = soc.at[base + 1 : base + 4, fi : fi + 3].set(jnp.eye(3, dtype=dtype))
+        shift_soc = shift_soc.at[base + 4].set(cfg.max_f)
+        soc = soc.at[base + 5 : base + 8, fi : fi + 3].set(jnp.eye(3, dtype=dtype))
+
+    A_full = jnp.concatenate([A, soc], axis=0)
+    shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    return P, q, A_full, lb, ub, shift
+
+
+def control(
+    params: PMRLParams,
+    cfg: PMRLCentralizedConfig,
+    ctrl_state: CtrlState,
+    state: PMRLState,
+    acc_des,
+    rob_acc_des=None,
+):
+    """One control step: ``-> (f (n, 3), CtrlState, SolverStats)`` with the
+    previous-solution fallback the reference controllers use
+    (rp_centralized.py:291-302). ``f`` feeds ``pmrl.integrate`` directly.
+
+    ``rob_acc_des (n, 3)``: desired robot accelerations (default:
+    ``dvl_des - swing_damp * L_i dq_i`` — every robot accelerates like the
+    payload target while damping its link's swing; see the k_rob /
+    swing_damp config docstrings)."""
+    n = params.n
+    if rob_acc_des is None:
+        rob_acc_des = (
+            acc_des[0][None, :]
+            - cfg.swing_damp * params.L[:, None] * state.dq
+        )
+    f_eq = equilibrium_forces(params, state)
+    P, q, A, lb, ub, shift = _build_qp(
+        params, cfg, f_eq, state, acc_des, rob_acc_des
+    )
+    n_box, _, soc_dims = qp_dims(n)
+    sol = socp.solve_socp(
+        P, q, A, lb, ub,
+        n_box=n_box, soc_dims=soc_dims, iters=cfg.solver_iters,
+        warm=ctrl_state.warm, shift=shift,
+        check_every=cfg.solver_check_every, tol=cfg.solver_tol,
+    )
+    f = sol.x[6:].reshape(n, 3)
+    ok = (sol.prim_res < cfg.solver_tol) & jnp.all(jnp.isfinite(sol.x))
+    f_out = jnp.where(ok, f, ctrl_state.prev_f)
+    keep = lambda new, old: jnp.where(ok, new, old)
+    warm = socp.SOCPSolution(
+        x=keep(sol.x, ctrl_state.warm.x),
+        y=keep(sol.y, ctrl_state.warm.y),
+        z=keep(sol.z, ctrl_state.warm.z),
+        prim_res=sol.prim_res,
+        dual_res=sol.dual_res,
+    )
+    stats = SolverStats(
+        iters=jnp.asarray(-1, jnp.int32),
+        solve_res=sol.prim_res,
+        collision=jnp.zeros((), bool),
+        min_env_dist=jnp.asarray(jnp.inf, state.xl.dtype),
+        ok_frac=ok.astype(sol.x.dtype),
+    )
+    return f_out, CtrlState(prev_f=f_out, warm=warm), stats
