@@ -1,0 +1,30 @@
+"""Device-count guard for the mesh/sharding tests.
+
+The root conftest.py requests 8 virtual CPU devices via XLA_FLAGS before JAX
+initializes; if the ambient environment already pinned
+``--xla_force_host_platform_device_count`` to fewer (the root conftest
+respects an existing setting), the mesh tests would die inside
+``make_mesh``'s bare assert instead of reporting why. Skip them with an
+actionable message instead.
+"""
+
+import jax
+import pytest
+
+_REQUIRED_DEVICES = 8
+
+
+def pytest_collection_modifyitems(config, items):
+    n = jax.device_count()
+    if n >= _REQUIRED_DEVICES:
+        return
+    skip = pytest.mark.skip(
+        reason=(
+            f"needs {_REQUIRED_DEVICES} virtual devices, have {n}: set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 (the root "
+            "conftest.py does this unless XLA_FLAGS already pins a count)"
+        )
+    )
+    for item in items:
+        if "test_parallel" in item.nodeid or "device" in item.name:
+            item.add_marker(skip)

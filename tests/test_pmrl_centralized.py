@@ -89,7 +89,10 @@ def test_closed_loop_setpoint():
     assert bool(jnp.all(jnp.isfinite(xs)))
     final_err = float(jnp.linalg.norm(s_fin.xl - target))
     initial_err = float(jnp.linalg.norm(target))
-    assert final_err < 0.4 * initial_err, \
+    # swing_damp = 3.5 (calibrated, see make_config) settles to ~0.036 m
+    # here; 0.15x initial keeps >2x margin while still catching a return of
+    # the under-damped limit cycle (which plateaued at ~0.4x).
+    assert final_err < 0.15 * initial_err, \
         f"did not approach target: {final_err} vs {initial_err}"
     # Tilt CBF: cos(payload tilt) stays above the 30-deg bound.
     assert float(tilt.min()) > cfg.cos_max_p_ang - 1e-3

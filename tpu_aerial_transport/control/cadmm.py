@@ -522,6 +522,14 @@ def make_schur_plan(
         )  # (6, 3)
         # Row equilibration (row norms are Rl-invariant, so computed on the
         # payload-frame blocks once): rows mix mT ~ O(1) and JT_inv ~ O(1e2).
+        # CROSS-AGENT INVARIANT: [Eu | Ev] jointly contains hat(r_j) for
+        # EVERY agent j — only the column order differs between agents — so
+        # each equality row's norm (hence `scale`) is identical for all
+        # agents. _schur_state_pieces relies on this by using agent 0's
+        # scale (plan.scale[0, 0]) for the agent-shared Ecc/e0s rows; the
+        # invariance is asserted after the plan is built below. Any change
+        # that makes the equilibration depend on the agent's own geometry
+        # (e.g. per-agent CBF rows folded into the equalities) breaks it.
         Ecc_proxy = jnp.zeros((6, 9), dtype)
         Ecc_proxy = Ecc_proxy.at[0:3, 0:3].set(
             params.mT * jnp.eye(3, dtype=dtype)
@@ -563,9 +571,27 @@ def make_schur_plan(
         )
 
     rhos = jnp.asarray(_rho_schedule(cfg), dtype)
-    return jax.vmap(
+    plan = jax.vmap(
         lambda rho: jax.vmap(lambda aid: one_agent(aid, rho))(agent_ids)
     )(rhos)
+    if not isinstance(plan.scale, jax.core.Tracer):
+        # Guard the cross-agent row-norm invariance documented at the scale
+        # construction above (skipped under tracing, where values are
+        # abstract — inline plan builds inside jit still get the check from
+        # any eager/test build of the same configuration).
+        import numpy as _np
+
+        # rtol: each row norm sums ~3(n+1) squared f32 terms in a per-agent
+        # order, so worst-case reordering error grows like rows * eps
+        # (~2e-5 at n = 64); 1e-4 keeps 4x headroom without masking a real
+        # equilibration change (which would shift norms by O(1).
+        assert _np.allclose(
+            _np.asarray(plan.scale), _np.asarray(plan.scale[:, :1]),
+            rtol=1e-4, atol=0.0,
+        ), "equality-row equilibration is no longer agent-invariant; " \
+           "_schur_state_pieces(plan.scale[0, 0]) would corrupt the " \
+           "eliminated equality rows"
+    return plan
 
 
 def _schur_state_pieces(params: RQPParams, cfg: RQPCADMMConfig,

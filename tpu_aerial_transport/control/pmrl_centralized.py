@@ -64,8 +64,12 @@ class PMRLCentralizedConfig:
     # a_des,i = dvl_des - swing_damp * L_i dq_i. Undamped link swing drives
     # payload-speed excursions whose |vl| CBF row can become infeasible
     # against the thrust-cone limits (every such step falls back to the
-    # previous forces, which feeds the oscillation).
-    swing_damp: float = 2.0
+    # previous forces, which feeds the oscillation). Calibrated by a closed
+    # -loop gain sweep (round 4): at 2.0 the setpoint approach limit-cycles
+    # at ~0.2 m error with solver fallbacks (ok_frac dips to 0); at 3.5 it
+    # settles to ~0.03 m with ok_frac == 1 throughout, across
+    # k_rob in [0.5, 2] and k_feq in [0.02, 0.1].
+    swing_damp: float = 3.5
     solver_iters: int = struct.field(pytree_node=False, default=150)
     solver_tol: float = struct.field(pytree_node=False, default=5e-3)
     solver_check_every: int = struct.field(pytree_node=False, default=25)
@@ -93,7 +97,7 @@ def make_config(params: PMRLParams,
         k_dvl=1.0,
         k_dwl=1.0,
         k_rob=1.0,
-        swing_damp=2.0,
+        swing_damp=3.5,
         solver_iters=solver_iters,
     )
 

@@ -1,0 +1,195 @@
+"""Fused ADMM inner-loop Pallas TPU kernel (SURVEY.md §2.9's "Pallas
+kernels" native tier).
+
+Why this kernel exists: one ADMM inner iteration is a per-lane matvec with
+the fused operator ``K2 ((nv+m)^2)`` plus a cone projection (ops/socp.py
+``step``). Under ``lax.scan`` XLA re-streams every lane's K2 from HBM on
+every iteration — for the headline C-ADMM batch (2048 lanes x 31^2 f32
+operators, ~8 MB) that is ~8 MB x inner_iters x consensus_iters of pure
+re-read traffic per control step, on a workload whose roofline shows it is
+bandwidth/latency-bound (AI ~ 0.04 F/B, BASELINE.md round 3). This kernel
+runs the whole fixed-iteration chunk with K2 resident in VMEM: each lane's
+operator is read from HBM exactly once per chunk.
+
+Layout: batch lanes on the LAST (lane) axis. All arrays arrive transposed
+to ``(rows, B)`` / ``(d, d, B)``; the grid tiles B in ``LANE_TILE`` chunks,
+so one grid cell holds ``(d, d, LANE_TILE)`` of K2 in VMEM (~0.5 MB at
+d = 31) and loops over iterations on the VPU. The per-iteration math is a
+transcription of ``ops/socp.py``'s ``step`` (same order of operations, same
+``y / rho`` division) so the kernel and the scan path agree to f32
+rounding.
+
+Batch capture: ``jax.vmap`` of a ``pallas_call`` lifts the mapped axis to a
+sequential grid dimension — one TensorCore grid cell per lane, which is
+orders of magnitude too slow. Instead :mod:`ops.socp` wraps this kernel in
+a recursive ``jax.custom_batching.custom_vmap`` pair that FOLDS every
+enclosing vmap axis (agents, Monte-Carlo scenarios) into the kernel's
+explicit lane axis, so the nested ``vmap(vmap(solve))`` the controllers
+build becomes a single wide kernel invocation.
+
+Reference provenance: the loop body this kernel fuses implements the same
+per-agent conic solves the reference does sequentially through
+cvxpy/Clarabel inside its consensus iterations (reference
+control/rqp_cadmm.py:644-648); the fusion itself has no reference
+counterpart — it is the TPU-native replacement for Clarabel's role in the
+hot loop.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE_TILE = 128
+# Above this operator edge the per-lane K2 tile no longer earns its VMEM
+# residency (d = 450 for centralized n = 64 would need ~100 MB/tile):
+# callers fall back to the scan path.
+MAX_FUSED_DIM = 128
+
+
+def _admm_chunk_kernel(
+    K2_ref, w2_ref, rho_ref, lb_ref, ub_ref, shift_ref,
+    x0_ref, y0_ref, z0_ref,
+    xo_ref, yo_ref, zo_ref,
+    *, nv: int, n_box: int, soc_dims: tuple, iters: int, alpha: float,
+):
+    """One grid cell: ``iters`` ADMM iterations over a LANE_TILE-wide slab.
+
+    Shapes (B = LANE_TILE): K2 (d, d, B), w2 (d, B), rho/lb-ub-like rows
+    (m or n_box, B), x (nv, B), y/z (m, B), with d = nv + m.
+    """
+    d = K2_ref.shape[0]
+    m = rho_ref.shape[0]
+    assert d == nv + m
+    K2 = K2_ref[...]
+    w2 = w2_ref[...]
+    rho = rho_ref[...]
+    lb = lb_ref[...]
+    ub = ub_ref[...]
+    shift = shift_ref[...]
+
+    def project(zin):
+        """Translated-cone projection, transcribing socp._project_cone /
+        project_soc with rows-first layout."""
+        zs = zin + shift
+        parts = [jnp.clip(zs[:n_box], lb, ub)]
+        off = n_box
+        for dsoc in soc_dims:
+            t = zs[off:off + 1]              # (1, B)
+            v = zs[off + 1:off + dsoc]       # (dsoc-1, B)
+            nrm = jnp.sqrt(jnp.sum(v * v, axis=0, keepdims=True))
+            inside = nrm <= t
+            polar = nrm <= -t
+            s = 0.5 * (t + nrm)
+            scale = jnp.where(nrm > 0, s / jnp.where(nrm > 0, nrm, 1.0), 0.0)
+            parts.append(jnp.where(inside, t, jnp.where(polar, 0.0, s)))
+            parts.append(jnp.where(inside, v, jnp.where(polar, 0.0, scale * v)))
+            off += dsoc
+        return jnp.concatenate(parts, axis=0) - shift
+
+    def body(_, carry):
+        x, y, z = carry
+        u = jnp.concatenate([x, rho * z - y], axis=0)          # (d, B)
+        # Per-lane matvec as a broadcast-multiply + sublane reduction: lanes
+        # stay on the 128-wide axis, so the VPU sees full-width vregs.
+        v = jnp.sum(K2 * u[None, :, :], axis=1) - w2           # (d, B)
+        x_new = v[:nv]
+        Ax = v[nv:]
+        Ax_rel = alpha * Ax + (1.0 - alpha) * z
+        z_new = project(Ax_rel + y / rho)
+        y_new = y + rho * (Ax_rel - z_new)
+        return (x_new, y_new, z_new)
+
+    x, y, z = lax.fori_loop(
+        0, iters, body, (x0_ref[...], y0_ref[...], z0_ref[...]),
+        unroll=False,
+    )
+    xo_ref[...] = x
+    yo_ref[...] = y
+    zo_ref[...] = z
+
+
+def _pad_lanes(a, B_pad, fill=0.0):
+    B = a.shape[-1]
+    if B == B_pad:
+        return a
+    pad = [(0, 0)] * (a.ndim - 1) + [(0, B_pad - B)]
+    return jnp.pad(a, pad, constant_values=fill)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("nv", "n_box", "soc_dims", "iters", "alpha", "interpret"),
+)
+def admm_chunk_lanes(
+    x, y, z, K2, w2, rho, lb, ub, shift,
+    *, nv: int, n_box: int, soc_dims: tuple, iters: int, alpha: float,
+    interpret: bool = False,
+):
+    """Run the fused chunk over a LEADING batch axis B (lane layout handled
+    here): args are batch-first ``(B, rows...)`` as produced by vmap folding;
+    returns ``(x, y, z)`` batch-first.
+
+    Padded lanes (B rounded up to LANE_TILE) run the iteration on zero
+    operators with rho = 1 — every intermediate stays finite — and are
+    sliced off before returning.
+    """
+    B = x.shape[0]
+    m = rho.shape[-1]
+    d = nv + m
+    B_pad = max(LANE_TILE, ((B + LANE_TILE - 1) // LANE_TILE) * LANE_TILE)
+
+    # Transpose to lanes-last and pad. (For the consensus controllers K2/w2
+    # are loop-invariant across outer iterations; XLA hoists these
+    # transposes out of the surrounding while_loop when it can — measured in
+    # bench.py, see BASELINE.md round 4.)
+    K2T = _pad_lanes(jnp.moveaxis(K2, 0, -1), B_pad)           # (d, d, Bp)
+    w2T = _pad_lanes(jnp.moveaxis(w2, 0, -1), B_pad)           # (d, Bp)
+    rhoT = _pad_lanes(jnp.moveaxis(rho, 0, -1), B_pad, 1.0)    # (m, Bp)
+    lbT = _pad_lanes(jnp.moveaxis(lb, 0, -1), B_pad)
+    ubT = _pad_lanes(jnp.moveaxis(ub, 0, -1), B_pad)
+    shiftT = _pad_lanes(jnp.moveaxis(shift, 0, -1), B_pad)
+    xT = _pad_lanes(jnp.moveaxis(x, 0, -1), B_pad)
+    yT = _pad_lanes(jnp.moveaxis(y, 0, -1), B_pad)
+    zT = _pad_lanes(jnp.moveaxis(z, 0, -1), B_pad)
+
+    grid = (B_pad // LANE_TILE,)
+
+    def spec(rows):
+        # rows may be a tuple (leading dims) — block covers full rows, one
+        # LANE_TILE slab of lanes.
+        shape = rows + (LANE_TILE,)
+        nlead = len(rows)
+        return pl.BlockSpec(
+            shape, lambda i: (0,) * nlead + (i,), memory_space=pltpu.VMEM
+        )
+
+    kernel = functools.partial(
+        _admm_chunk_kernel,
+        nv=nv, n_box=n_box, soc_dims=tuple(soc_dims), iters=iters,
+        alpha=alpha,
+    )
+    dtype = x.dtype
+    xo, yo, zo = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            spec((d, d)), spec((d,)), spec((m,)), spec((n_box,)),
+            spec((n_box,)), spec((m,)), spec((nv,)), spec((m,)), spec((m,)),
+        ],
+        out_specs=[spec((nv,)), spec((m,)), spec((m,))],
+        out_shape=[
+            jax.ShapeDtypeStruct((nv, B_pad), dtype),
+            jax.ShapeDtypeStruct((m, B_pad), dtype),
+            jax.ShapeDtypeStruct((m, B_pad), dtype),
+        ],
+        interpret=interpret,
+    )(K2T, w2T, rhoT, lbT, ubT, shiftT, xT, yT, zT)
+
+    unT = lambda a: jnp.moveaxis(a, -1, 0)[:B]
+    return unT(xo), unT(yo), unT(zo)
