@@ -193,7 +193,8 @@ def _substeps(params, ll, state, f_des, n_sub=10, dt=1e-3):
 
 
 def make_mpc_step(controller: str, n: int, max_iter: int = 20,
-                  inner_iters: int | None = None):
+                  inner_iters: int | None = None, socp_fused: str = "auto",
+                  force_fixed_iters: bool = False):
     # Default inner ADMM budgets are the measured knees. C-ADMM: 20 — below
     # it the warm-started agent solves miss the 5e-3 primal tolerance and
     # fall back to equilibrium forces (visible as an exactly-zero consensus
@@ -213,6 +214,11 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             params, col.collision_radius, col.max_deceleration,
             max_iter=max_iter,
             inner_iters=inner_iters if inner_iters is not None else 20,
+            socp_fused=socp_fused,
+            # res_tol = 0 can never be met (inf-norm >= 0), so the consensus
+            # loop runs to exactly max_iter + 1 iterations — the fixed-count
+            # mode _measured_iter_ms differences.
+            **({"res_tol": 0.0} if force_fixed_iters else {}),
         )
         cs0 = cadmm.init_cadmm_state(params, cfg)
         # Precompute the state-independent Schur plan once, outside the
@@ -230,6 +236,8 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             params, col.collision_radius, col.max_deceleration,
             max_iter=max_iter,
             inner_iters=inner_iters if inner_iters is not None else 40,
+            socp_fused=socp_fused,
+            **({"prim_inf_tol": 0.0} if force_fixed_iters else {}),
         )
         cs0 = dd.init_dd_state(params, cfg)
         plan = dd.make_dd_plan(params, cfg)  # state-independent QN cores.
@@ -275,15 +283,33 @@ def _scenario_batch(state0, n_scenarios):
     )(xs)
 
 
-def build(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS):
-    mpc_step, cs0, state0 = make_mpc_step(controller, n)
+def build(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
+          socp_fused="auto", buckets=0):
+    mpc_step, cs0, state0 = make_mpc_step(controller, n, socp_fused=socp_fused)
     states = _scenario_batch(state0, n_scenarios)
     css = jax.vmap(lambda _: cs0)(jnp.arange(n_scenarios))
+
+    if buckets >= 2:
+        # Congestion-bucketed batch: decouple the vmapped while_loop's
+        # worst-lane iteration count across env-CBF-activity groups
+        # (harness/bucketing.py; per-scenario results identical).
+        from tpu_aerial_transport.envs import forest as forest_mod
+        from tpu_aerial_transport.harness import bucketing
+        from tpu_aerial_transport.harness import setup as setup_mod
+
+        _, col, _ = setup_mod.rqp_setup(n)
+        forest = forest_mod.make_forest(seed=0)
+        metric = bucketing.env_congestion_metric(
+            forest, col.collision_radius + 5.0
+        )
+        batched_step = bucketing.bucketed_step(mpc_step, metric, buckets)
+    else:
+        batched_step = jax.vmap(mpc_step)
 
     def rollout(css, states, n_steps):
         def body(carry, _):
             cs, s = carry
-            cs, s, _ = jax.vmap(mpc_step)(cs, s)
+            cs, s, _ = batched_step(cs, s)
             return (cs, s), None
 
         (css, states), _ = jax.lax.scan(
@@ -399,8 +425,9 @@ def ref_arch_cpu_rate(n=N_AGENTS, max_iter=20, inner_iters=20, n_steps=5):
     return n_steps / t_total
 
 
-def headline(profile_dir: str | None = None, platform: str = "unknown"):
-    step, css, states = build()
+def headline(profile_dir: str | None = None, platform: str = "unknown",
+             socp_fused: str = "auto", buckets: int = 0):
+    step, css, states = build(socp_fused=socp_fused, buckets=buckets)
     if profile_dir:
         # Warm up outside the trace so the profile shows steady-state execution.
         measure(step, css, states, jax.devices()[0], TIMED_STEPS, N_SCENARIOS)
@@ -491,6 +518,51 @@ def _batched(controller, n, n_scenarios, n_steps=10):
     return measure(step, css, states, jax.devices()[0], n_steps, n_scenarios)
 
 
+def _measured_iter_ms(controller, n, k_lo=4, k_hi=24, n_steps=30):
+    """MEASURED per-consensus-iteration latency (not p50-divided): run the
+    single-stream rollout with the consensus loop forced to a fixed
+    iteration count (stop tolerance 0 never triggers, so every step runs
+    exactly ``max_iter + 1`` iterations) at two counts and difference the
+    scan-amortized wall times — fixed per-step work (env query, QP build,
+    low-level, physics) cancels exactly.
+
+    Max-over-agents semantics (reference rqp_cadmm.py:649 times each
+    consensus iteration as the max over per-agent solve times): the vmapped
+    agent batch executes all n solves in lockstep inside one program, so a
+    batched iteration's wall time IS the slowest agent's — the same
+    statistic by construction."""
+    per_step = {}
+    for k in (k_lo, k_hi):
+        mpc_step, cs0, state0 = make_mpc_step(
+            controller, n, max_iter=k, force_fixed_iters=True
+        )
+        state0 = state0.replace(vl=jnp.array([0.5, 0.0, 0.0], jnp.float32))
+
+        def roll(cs, state):
+            def body(carry, _):
+                cs, s = carry
+                cs, s, _ = mpc_step(cs, s)
+                return (cs, s), None
+
+            return jax.lax.scan(body, (cs, state), None, length=n_steps)[0]
+
+        jitted = jax.jit(roll)
+        cs, s = jitted(cs0, state0)
+        jax.block_until_ready(s.xl)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cs, s = jitted(cs0, state0)
+            jax.block_until_ready(s.xl)
+            times.append(time.perf_counter() - t0)
+        per_step[k] = float(np.median(times)) / n_steps
+    return {
+        "ms_per_consensus_iter_measured":
+            (per_step[k_hi] - per_step[k_lo]) / (k_hi - k_lo) * 1e3,
+        "fixed_iter_step_ms": {str(k): v * 1e3 for k, v in per_step.items()},
+    }
+
+
 SWEEP_PARTIAL_PATH = "BENCH_SWEEP_PARTIAL.json"
 
 
@@ -573,6 +645,14 @@ def sweep(resume: bool = False):
             if key in results:
                 continue
             record(key, _single_stream(ctrl, n))
+    # Measured per-consensus-iteration latency (differenced fixed-iteration
+    # runs; see _measured_iter_ms — VERDICT r3 item 7).
+    for ctrl in ("cadmm", "dd"):
+        for n in (4, 16, 64):
+            key = f"{ctrl}_n{n}_iter_latency"
+            if key in results:
+                continue
+            record(key, _measured_iter_ms(ctrl, n))
     # Batched throughput (the TPU's actual operating point) at the same Ns.
     for ctrl in ("cadmm", "dd"):
         for n, ns in ((4, 256), (16, 128), (64, 64)):
@@ -615,12 +695,14 @@ def sweep(resume: bool = False):
         os.remove(SWEEP_PARTIAL_PATH)
 
     # Markdown table for BASELINE.md.
-    print("\n| Config | MPC steps/s | mean step ms | ms/consensus-iter |")
+    print("\n| Config | MPC steps/s | mean step ms | ms/consensus-iter "
+          "(measured) |")
     print("|---|---|---|---|")
     for ctrl in ("centralized", "cadmm", "dd"):
         for n in (4, 16, 64):
             r = results[f"{ctrl}_n{n}_single"]
-            per_iter = r.get("ms_per_consensus_iter")
+            lat = results.get(f"{ctrl}_n{n}_iter_latency", {})
+            per_iter = lat.get("ms_per_consensus_iter_measured")
             per_iter_s = f"{per_iter:.2f}" if per_iter is not None else "—"
             print(f"| {ctrl} n={n} single-stream | "
                   f"{r['mpc_steps_per_sec']:.1f} | {r['step_ms_mean']:.2f} | "
@@ -629,6 +711,111 @@ def sweep(resume: bool = False):
         r = results[key]
         print(f"| {key} | {r['scenario_mpc_steps_per_sec']:.1f} scenario-steps/s "
               f"({r['agent_mpc_steps_per_sec']:.0f} agent-steps/s) | — | — |")
+
+
+def multichip(n_steps: int = 10, n_swarm: int = 128, reps: int = 3,
+              max_iter: int = 20, inner_cadmm: int = 20, inner_dd: int = 40):
+    """BASELINE.json multi-device configs, runnable unchanged the day a
+    multi-chip slice appears (VERDICT r3 item 6): gated on
+    ``len(jax.devices()) > 1``; exercised for shape/compile correctness on
+    the virtual 8-device CPU mesh by tests/test_multichip_bench.py.
+
+    Configs (BASELINE.json "benchmark configs" 3-5):
+    - ``dd_n16_sharded``: 16-agent DD with agents sharded over the mesh
+      (2 agents/device on 8 devices) — psum price sums + all_gathered QN
+      dual step over ICI, full MPC step (env CBF + low-level + physics).
+    - ``cadmm_n8_sharded``: 8-agent C-ADMM, one agent per device.
+    - ``swarm_scenario_sharded``: 128 payloads x 8 quads (1024 agents),
+      scenario axis sharded over the mesh (pure data parallelism).
+    Emits one JSON line per config.
+    """
+    from tpu_aerial_transport.control import cadmm as cadmm_mod
+    from tpu_aerial_transport.control import dd as dd_mod
+    from tpu_aerial_transport.parallel import mesh as mesh_mod
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        raise SystemExit(
+            f"--multichip needs >1 device, have {ndev}; on a single chip "
+            "run the standard modes (for the CPU shape check: "
+            "JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_"
+            "device_count=8 python bench.py --multichip)"
+        )
+
+    def timed_rollout(roll, *args):
+        jitted = jax.jit(roll, static_argnames="n_steps")
+        out = jitted(*args, n_steps=n_steps)
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = jitted(*args, n_steps=n_steps)
+            jax.block_until_ready(jax.tree.leaves(out)[0])
+            times.append(time.perf_counter() - t0)
+        return n_steps / float(np.median(times))
+
+    results = {}
+
+    # Agent-sharded distributed controllers: full MPC step in a scan.
+    for key, ctrl, n in (("dd_n16_sharded", "dd", 16),
+                         ("cadmm_n8_sharded", "cadmm", 8)):
+        params, col, state0, forest, f_eq, ll, acc_des = _setup(n)
+        m = mesh_mod.make_mesh({"agent": min(ndev, n)})
+        if ctrl == "dd":
+            cfg = dd_mod.make_config(
+                params, col.collision_radius, col.max_deceleration,
+                max_iter=max_iter, inner_iters=inner_dd,
+            )
+            cs0 = dd_mod.init_dd_state(params, cfg)
+            step = mesh_mod.dd_control_sharded(params, cfg, f_eq, m, forest)
+        else:
+            cfg = cadmm_mod.make_config(
+                params, col.collision_radius, col.max_deceleration,
+                max_iter=max_iter, inner_iters=inner_cadmm,
+            )
+            cs0 = cadmm_mod.init_cadmm_state(params, cfg)
+            step = mesh_mod.cadmm_control_sharded(params, cfg, f_eq, m, forest)
+        state0 = state0.replace(vl=jnp.array([0.5, 0.0, 0.0], jnp.float32))
+
+        def roll(cs, state, n_steps):
+            def body(carry, _):
+                cs, s = carry
+                f, cs, _ = step(cs, s, acc_des)
+                return (cs, _substeps(params, ll, s, f)), None
+
+            return jax.lax.scan(body, (cs, state), None, length=n_steps)[0]
+
+        rate = timed_rollout(roll, cs0, state0)
+        results[key] = rate
+        print(json.dumps({
+            "metric": f"multichip_{key}", "value": _finite_or_none(rate, 1),
+            "unit": "MPC-steps/s", "devices": ndev,
+            "mesh": {"agent": int(m.shape["agent"])},
+        }), flush=True)
+
+    # Scenario-sharded swarm: 128 payloads x 8 quads = 1024 agents.
+    step, css, states = build("cadmm", 8, n_swarm)
+    m = mesh_mod.make_mesh({"scenario": ndev})
+    css = mesh_mod.shard_scenarios(m, css)
+    states = mesh_mod.shard_scenarios(m, states)
+    out = step(css, states, n_steps)
+    jax.block_until_ready(out[1].xl)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = step(css, states, n_steps)
+        jax.block_until_ready(out[1].xl)
+        times.append(time.perf_counter() - t0)
+    rate = n_swarm * n_steps / float(np.median(times))
+    results["swarm_scenario_sharded"] = rate
+    print(json.dumps({
+        "metric": "multichip_swarm_scenario_sharded",
+        "value": _finite_or_none(rate, 1),
+        "unit": "scenario-MPC-steps/s", "devices": ndev,
+        "agents_total": 8 * n_swarm,
+        "agent_mpc_steps_per_sec": _finite_or_none(rate * 8, 1),
+    }), flush=True)
+    return results
 
 
 def components():
@@ -867,23 +1054,38 @@ def main():
                     help="with --sweep: skip configs already checkpointed "
                          "in BENCH_SWEEP_PARTIAL.json")
     ap.add_argument("--components", action="store_true")
+    ap.add_argument("--multichip", action="store_true",
+                    help="BASELINE.json multi-device configs (needs >1 "
+                         "device; CPU shape-check via JAX_PLATFORMS=cpu + "
+                         "xla_force_host_platform_device_count)")
     ap.add_argument("--roofline", action="store_true")
     ap.add_argument("--profile", default=None, metavar="DIR")
+    ap.add_argument("--fused", default="auto",
+                    choices=["auto", "scan", "pallas", "interpret"],
+                    help="inner ADMM chunk mode for the headline "
+                         "(ops/admm_kernel.py A/B switch)")
+    ap.add_argument("--buckets", type=int, default=0,
+                    help="headline congestion-bucket count (0/1 = off; "
+                         "harness/bucketing.py A/B switch)")
     args = ap.parse_args()
     _honor_jax_platforms_env()
     mode_metric = ("bench_sweep" if args.sweep
                    else "bench_components" if args.components
                    else "bench_roofline" if args.roofline
+                   else "bench_multichip" if args.multichip
                    else HEADLINE_METRIC)
     platform = ensure_backend_or_die(metric=mode_metric)
     if args.sweep:
         sweep(resume=args.resume)
+    elif args.multichip:
+        multichip()
     elif args.components:
         components()
     elif args.roofline:
         roofline()
     else:
-        headline(args.profile, platform=platform)
+        headline(args.profile, platform=platform, socp_fused=args.fused,
+                 buckets=args.buckets)
 
 
 if __name__ == "__main__":

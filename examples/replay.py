@@ -44,6 +44,10 @@ def main() -> None:
                    choices=["centralized", "cadmm", "dd"])
     p.add_argument("--outdir", default="replay_out")
     p.add_argument("--stride", type=int, default=25, help="frame stride")
+    p.add_argument("--force-arrows", action="store_true",
+                   help="overlay per-agent commanded-force arrows "
+                        "(reference _DRAW_FORCE_ARROWS; needs f_des_seq "
+                        "in the log)")
     p.add_argument("--meshcat", action="store_true",
                    help="live meshcat replay instead of PNG frames")
     args = p.parse_args()
@@ -71,7 +75,7 @@ def main() -> None:
         frames = scene.render_frames(
             logs, params, col.payload_vertices,
             os.path.join(args.outdir, "frames"), forest=forest,
-            stride=args.stride,
+            stride=args.stride, force_arrows=args.force_arrows,
         )
         print(f"{len(frames)} frames -> {args.outdir}/frames")
 

@@ -1,0 +1,29 @@
+"""Shape/compile correctness of bench.py --multichip on the virtual 8-device
+CPU mesh — the driver can run the same command unchanged on a real slice
+(VERDICT r3 item 6). Tiny budgets: the property under test is that every
+multi-device config builds, shards, compiles, and executes, not throughput."""
+
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo")
+import bench  # noqa: E402
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_multichip_configs_compile_and_run(capsys):
+    results = bench.multichip(
+        n_steps=2, n_swarm=16, reps=1, max_iter=3, inner_cadmm=5, inner_dd=5
+    )
+    assert set(results) == {
+        "dd_n16_sharded", "cadmm_n8_sharded", "swarm_scenario_sharded"
+    }
+    for key, rate in results.items():
+        assert np.isfinite(rate) and rate > 0, (key, rate)
+    # One JSON line per config on stdout (driver-facing contract).
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 3

@@ -135,8 +135,11 @@ def test_quadrotor_mesh_and_forest_scene(tmp_path):
         Rl = np.eye(3)
         R = np.tile(np.eye(3), (3, 1, 1))
 
+    # Force arrows: the reference's optional _DRAW_FORCE_ARROWS overlay —
+    # include a near-zero force to exercise the min-length floor.
+    forces = np.array([[0.0, 0.0, 5.0], [0.5, 0.0, 4.0], [0.0, 1e-12, 0.0]])
     scene.draw_snapshot(ax, params, col.payload_vertices, _S(), forest=forest,
-                        quad_mesh=True)
+                        quad_mesh=True, forces=forces)
     out = tmp_path / "scene3d.png"
     fig.savefig(str(out))
     plt.close(fig)

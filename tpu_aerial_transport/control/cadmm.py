@@ -119,6 +119,11 @@ class RQPCADMMConfig:
     inner_iters_warm: int = struct.field(pytree_node=False, default=0)
     solver_tol: float = struct.field(pytree_node=False, default=5e-3)
     max_f_ang: float = struct.field(pytree_node=False, default=jnp.pi / 6)
+    # Inner-chunk execution mode forwarded to ops/socp.py solve_socp
+    # ("auto" | "scan" | "pallas" | "interpret"): "pallas" runs each fixed-
+    # iteration ADMM chunk as one fused TPU kernel with the per-agent
+    # operators VMEM-resident (ops/admm_kernel.py).
+    socp_fused: str = struct.field(pytree_node=False, default="auto")
 
 
 def make_config(
@@ -136,6 +141,7 @@ def make_config(
     rho0: float = 1.0,
     tau_incr: float = 1.0,
     rho_max: float = 2.0,
+    socp_fused: str = "auto",
 ) -> RQPCADMMConfig:
     """Defaults are reference-conservative (max_iter mirrors the reference's
     100-iteration cap). For warm-started receding-horizon use, the measured
@@ -176,6 +182,7 @@ def make_config(
         inner_iters=inner_iters,
         inner_iters_warm=inner_iters_warm,
         reduced_qp=reduced_qp,
+        socp_fused=socp_fused,
     )
 
 
@@ -963,7 +970,7 @@ def control(
             lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_: socp.solve_socp(
                 P_, q_, A_, lb_, ub_,
                 n_box=n_box, soc_dims=(4, 4), iters=iters,
-                warm=warm_, shift=shift_, op=op_,
+                warm=warm_, shift=shift_, op=op_, fused=cfg.socp_fused,
             )
         )
 

@@ -64,6 +64,7 @@ def make_config(
     prim_inf_tol: float = 1e-2,
     k_smooth: float = 0.0,
     dt: float = 1e-3,
+    socp_fused: str = "auto",
 ) -> RQPDDConfig:
     """Defaults are reference-conservative. For warm-started receding-horizon
     use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
@@ -74,7 +75,7 @@ def make_config(
     base = cadmm_mod.make_config(
         params, collision_radius, max_deceleration,
         n_env_cbfs=n_env_cbfs, max_iter=max_iter, inner_iters=inner_iters,
-        k_smooth=k_smooth, dt=dt,
+        k_smooth=k_smooth, dt=dt, socp_fused=socp_fused,
     )
     return RQPDDConfig(base=base, prim_inf_tol=prim_inf_tol)
 
@@ -513,7 +514,7 @@ def control(
         lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_: socp.solve_socp(
             P_, q_, A_, lb_, ub_,
             n_box=n_box, soc_dims=(4, 4), iters=base.inner_iters,
-            warm=warm_, shift=shift_, op=op_,
+            warm=warm_, shift=shift_, op=op_, fused=base.socp_fused,
         )
     )
 

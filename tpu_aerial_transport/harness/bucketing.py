@@ -1,0 +1,93 @@
+"""Congestion-bucketed Monte-Carlo batching: worst-lane decoupling for
+vmapped consensus loops.
+
+A vmapped ``lax.while_loop`` runs every lane to the batch's worst-case trip
+count (converged lanes' carries freeze, but their per-iteration cost is still
+paid), so one congested scenario drags the whole batch (BASELINE.md round 2
+quantified this at ~25 ms of the headline step). Consensus iteration counts
+correlate with how many obstacle CBF rows are active, which is observable
+BEFORE solving — so: sort the batch by a cheap congestion metric, split into
+``n_buckets`` contiguous groups, and run the step's consensus loop once per
+group. Quiet buckets drain at their own (small) worst case; only the
+congested bucket pays the deep trip count. Per-scenario results are exactly
+the unbucketed ones (same solves, same data, just grouped) — asserted by
+tests/test_bucketing.py.
+
+Cost model: bucket b's time ~ (B / n_buckets) x worst_iters(b) + fixed
+overhead per bucket (kernel dispatch, gathers). Wins when iteration counts
+are heavy-tailed across the batch; loses slightly when uniform. Measured
+A/B lives in bench.py (``--buckets``).
+
+No reference counterpart: the reference solves scenarios one at a time in a
+Python loop (test_rqpcontrollers.py:112-124) and never faces batch coupling.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _take(tree, idx):
+    return jax.tree.map(lambda x: jnp.take(x, idx, axis=0), tree)
+
+
+def _slice(tree, lo, hi):
+    return jax.tree.map(lambda x: x[lo:hi], tree)
+
+
+def _concat(trees):
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *trees)
+
+
+def env_congestion_metric(forest, vision_radius: float) -> Callable:
+    """Congestion metric for the forest env: number of trees whose axis lies
+    within ``vision_radius`` of the payload position — an O(num_trees)
+    distance sweep, ~free next to one consensus iteration, and a direct
+    proxy for how many env-CBF rows will be active."""
+
+    def metric(state):
+        d = jnp.linalg.norm(
+            forest.tree_pos[:, :2] - state.xl[None, :2], axis=-1
+        )
+        alive = jnp.arange(forest.tree_pos.shape[0]) < forest.num_trees
+        return jnp.sum((d < vision_radius) & alive)
+
+    return metric
+
+
+def bucketed_step(step_fn: Callable, metric_fn: Callable,
+                  n_buckets: int = 2) -> Callable:
+    """Wrap a per-scenario MPC step ``step_fn(cs, state) -> (cs, state,
+    stats)`` into a batched step that runs ``n_buckets`` separate vmapped
+    consensus loops grouped by ascending ``metric_fn(state)``.
+
+    The batch size must be divisible by ``n_buckets`` (static shapes). The
+    returned function maps ``(css, states) -> (css, states, stats)`` with
+    leading batch axes, bit-identical per scenario to ``vmap(step_fn)``
+    modulo lane order (results are scattered back to input order).
+    """
+    if n_buckets < 2:
+        return jax.vmap(step_fn)
+
+    def batched(css, states):
+        B = jax.tree.leaves(states)[0].shape[0]
+        assert B % n_buckets == 0, (B, n_buckets)
+        per = B // n_buckets
+        m = jax.vmap(metric_fn)(states)
+        order = jnp.argsort(m)
+        inv = jnp.argsort(order)
+        css_s = _take(css, order)
+        states_s = _take(states, order)
+        outs = []
+        for b in range(n_buckets):
+            outs.append(jax.vmap(step_fn)(
+                _slice(css_s, b * per, (b + 1) * per),
+                _slice(states_s, b * per, (b + 1) * per),
+            ))
+        out = _concat(outs)
+        return _take(out, inv)
+
+    return batched

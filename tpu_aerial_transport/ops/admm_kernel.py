@@ -46,10 +46,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANE_TILE = 128
-# Above this operator edge the per-lane K2 tile no longer earns its VMEM
-# residency (d = 450 for centralized n = 64 would need ~100 MB/tile):
-# callers fall back to the scan path.
-MAX_FUSED_DIM = 128
+# Above this operator edge the per-lane K2 tile no longer fits VMEM
+# residency (block bytes = 4 d^2 LANE_TILE, double-buffered by the pipeline:
+# d = 96 -> ~4.7 MB/block, x2 in flight ~9.4 MB of the ~16 MB VMEM; d = 450
+# for centralized n = 64 would need ~100 MB): callers fall back to scan.
+# Covers the consensus controllers' solves (reduced C-ADMM d = 37, DD d = 49
+# at the default 10 env-CBF rows).
+MAX_FUSED_DIM = 96
 
 
 def _admm_chunk_kernel(

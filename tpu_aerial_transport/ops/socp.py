@@ -48,6 +48,7 @@ Design notes vs the reference:
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import NamedTuple, Sequence
 
@@ -57,6 +58,11 @@ from jax import lax
 
 EQ_RHO_SCALE = 1e3  # OSQP's rho boost for equality rows.
 INF = 1e20  # "infinity" bound; keeps arithmetic finite in f32... used via clipping.
+
+# What ``fused="auto"`` resolves to on a non-CPU backend. Stays "scan" until
+# the Pallas chunk kernel is validated on the real chip; flip to "pallas"
+# after on-TPU A/B (see ops/admm_kernel.py and bench.py --fused).
+_AUTO_FUSED_NONCPU = "scan"
 
 
 class KKTOp(NamedTuple):
@@ -132,9 +138,88 @@ def _project_cone(z, lb, ub, n_box: int, soc_dims: Sequence[int], shift=None):
     return out
 
 
+def _admm_step(carry, K2, w2, rho_vec, lb, ub, shift, *,
+               nv, n_box, soc_dims, alpha):
+    """One ADMM iteration (the scan path's body AND the numerics contract the
+    Pallas chunk kernel transcribes — keep in sync with
+    admm_kernel._admm_chunk_kernel)."""
+    x, y, z = carry
+    v = K2 @ jnp.concatenate([x, rho_vec * z - y]) - w2
+    x_new, Ax = v[:nv], v[nv:]
+    Ax_rel = alpha * Ax + (1 - alpha) * z
+    z_new = _project_cone(Ax_rel + y / rho_vec, lb, ub, n_box, soc_dims, shift)
+    y_new = y + rho_vec * (Ax_rel - z_new)
+    return (x_new, y_new, z_new)
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_chunk_runner(nv: int, n_box: int, soc_dims: tuple, iters: int,
+                        alpha: float, interpret: bool):
+    """Build the vmap-folding runner for one static chunk configuration.
+
+    Returns a function ``(x, y, z, K2, w2, rho, lb, ub, shift) -> (x, y, z)``
+    running ``iters`` ADMM iterations. Unbatched calls use the plain scan
+    (a lone solve gains nothing from a kernel); every enclosing ``vmap``
+    axis — agents, then Monte-Carlo scenarios — is FOLDED into the Pallas
+    kernel's explicit lane axis via a recursive ``custom_vmap`` pair, rather
+    than letting vmap lift the kernel to one sequential grid cell per lane
+    (see admm_kernel module docstring)."""
+    from tpu_aerial_transport.ops import admm_kernel
+
+    kw = dict(nv=nv, n_box=n_box, soc_dims=soc_dims, alpha=alpha)
+
+    @jax.custom_batching.custom_vmap
+    def batched(x, y, z, K2, w2, rho, lb, ub, shift):
+        # Leading batch axis on every arg.
+        return admm_kernel.admm_chunk_lanes(
+            x, y, z, K2, w2, rho, lb, ub, shift,
+            iters=iters, interpret=interpret, **kw,
+        )
+
+    @batched.def_vmap
+    def _batched_rule(axis_size, in_batched, *args):
+        # Fold the new (leading) vmap axis into the existing lane axis.
+        folded = []
+        for a, b in zip(args, in_batched):
+            if not b:
+                a = jnp.broadcast_to(a[None], (axis_size,) + a.shape)
+            folded.append(a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]))
+        outs = batched(*folded)
+        unfold = lambda o: o.reshape((axis_size, -1) + o.shape[1:])
+        return tuple(unfold(o) for o in outs), (True, True, True)
+
+    @jax.custom_batching.custom_vmap
+    def single(x, y, z, K2, w2, rho, lb, ub, shift):
+        def stepf(c, _):
+            return _admm_step(c, K2, w2, rho, lb, ub, shift, **kw), None
+        return lax.scan(stepf, (x, y, z), None, length=iters)[0]
+
+    @single.def_vmap
+    def _single_rule(axis_size, in_batched, *args):
+        lifted = [
+            a if b else jnp.broadcast_to(a[None], (axis_size,) + a.shape)
+            for a, b in zip(args, in_batched)
+        ]
+        return batched(*lifted), (True, True, True)
+
+    return single
+
+
+def _resolve_fused(fused: str) -> str:
+    if fused == "auto":
+        return (
+            "scan" if jax.default_backend() == "cpu" else _AUTO_FUSED_NONCPU
+        )
+    return fused
+
+
 @partial(
     jax.jit,
-    static_argnames=("n_box", "soc_dims", "iters", "check_every", "tol"),
+    # alpha is static: it parameterizes the fused-chunk kernel build (a
+    # Python-level cache key), and it is an algorithm constant at every call
+    # site — a traced alpha would also break the scan/pallas parity contract.
+    static_argnames=("n_box", "soc_dims", "iters", "check_every", "tol",
+                     "fused", "alpha"),
 )
 def solve_socp(
     P: jnp.ndarray,
@@ -154,6 +239,7 @@ def solve_socp(
     tol: float = 0.0,
     shift: jnp.ndarray | None = None,
     op: KKTOp | None = None,
+    fused: str = "auto",
 ) -> SOCPSolution:
     """Solve one conic QP. All array args may carry leading batch axes only via
     ``vmap`` (this function itself is single-instance).
@@ -174,6 +260,13 @@ def solve_socp(
         that re-solve with the same (P, A) but different q — e.g. the C-ADMM
         consensus loop, where only the dual/consensus linear term moves between
         iterations — build the operator once per control step and amortize.
+      fused: how to run the fixed-iteration chunks — "scan" (lax.scan of
+        single iterations), "pallas" (the fused TPU chunk kernel,
+        ops/admm_kernel.py: K2 resident in VMEM across iterations, enclosing
+        vmap axes folded into kernel lanes), "interpret" (same kernel via the
+        Pallas interpreter — CPU-testable), or "auto". Solves too big for
+        VMEM residency (nv + m > admm_kernel.MAX_FUSED_DIM, e.g. centralized
+        n = 64) fall back to "scan" regardless.
     """
     m, nv = A.shape
     assert m == n_box + sum(soc_dims)
@@ -203,17 +296,33 @@ def solve_socp(
     else:
         x0, y0, z0 = warm.x, warm.y, warm.z
 
-    def step(carry, _):
-        x, y, z = carry
-        v = K2 @ jnp.concatenate([x, rho_vec * z - y]) - w2
-        x_new, Ax = v[:nv], v[nv:]
-        Ax_rel = alpha * Ax + (1 - alpha) * z
-        z_new = _project_cone(Ax_rel + y / rho_vec, lb, ub, n_box, soc_dims, shift)
-        y_new = y + rho_vec * (Ax_rel - z_new)
-        return (x_new, y_new, z_new), None
+    fused_mode = _resolve_fused(fused)
+    if fused_mode != "scan":
+        from tpu_aerial_transport.ops import admm_kernel
 
-    def run_chunk(carry, k):
-        return lax.scan(step, carry, None, length=k)[0]
+        if nv + m > admm_kernel.MAX_FUSED_DIM:
+            fused_mode = "scan"
+
+    step_kw = dict(nv=nv, n_box=n_box, soc_dims=tuple(soc_dims), alpha=alpha)
+
+    def step(carry, _):
+        return _admm_step(carry, K2, w2, rho_vec, lb, ub, shift, **step_kw), None
+
+    if fused_mode == "scan":
+
+        def run_chunk(carry, k):
+            return lax.scan(step, carry, None, length=k)[0]
+    else:
+        shift_arr = (
+            shift if shift is not None else jnp.zeros((m,), dtype)
+        )
+
+        def run_chunk(carry, k):
+            runner = _fused_chunk_runner(
+                nv, n_box, tuple(soc_dims), k, alpha,
+                fused_mode == "interpret",
+            )
+            return runner(*carry, K2, w2, rho_vec, lb, ub, shift_arr)
 
     def residuals(carry):
         x, y, z = carry

@@ -17,6 +17,9 @@ import os
 import numpy as np
 
 QUAD_ARM = 0.15  # [m] drawn arm length for the quadrotor cross.
+# Force-arrow overlay constants (reference system/rigid_payload.py:26-30).
+FORCE_SCALING = 1.0  # [m/N] arrow length per Newton.
+FORCE_MIN_LENGTH = 0.05  # [m] floor so near-zero forces stay visible.
 CONE_HEIGHT = 2.0  # [m] foliage cone on each bark (reference env_forest.py:24).
 CONE_RADIUS = 1.0
 
@@ -119,14 +122,19 @@ def _mpl():
 
 
 def draw_snapshot(ax, params, payload_vertices, state, forest=None, alpha=1.0,
-                  quad_mesh=False):
+                  quad_mesh=False, forces=None,
+                  force_scaling=FORCE_SCALING):
     """Draw one scene state into a 3-D matplotlib axis.
 
     ``state`` needs ``xl, Rl`` and optionally per-agent ``R``; agent positions
     are the attachment points ``xl + Rl r_i`` (rigid attachment, RQP model).
     ``alpha < 1`` renders a ghost (multi-snapshot scenes, rqp_plots.py:112-147).
     ``quad_mesh=True`` draws the full procedural quadrotor mesh instead of the
-    cross-of-arms sketch.
+    cross-of-arms sketch. ``forces (n, 3)``: optional per-agent applied-force
+    arrows from each agent (the reference's ``_DRAW_FORCE_ARROWS`` option,
+    system/rigid_payload.py:25-30 / rigid_quadrotor_payload.py:25, default
+    off there too); ``force_scaling`` is meters of arrow per Newton
+    (reference ``_FORCE_SCALING``).
     """
     from mpl_toolkits.mplot3d.art3d import Poly3DCollection
 
@@ -173,8 +181,33 @@ def draw_snapshot(ax, params, payload_vertices, state, forest=None, alpha=1.0,
                     ])
                     ax.plot(*seg.T, color="k", lw=0.8, alpha=alpha)
 
+    if forces is not None:
+        draw_force_arrows(ax, quad_pos, np.asarray(forces),
+                          scaling=force_scaling, alpha=alpha)
+
     if forest is not None:
         draw_forest_3d(ax, forest)
+
+
+def draw_force_arrows(ax, positions, forces, scaling=FORCE_SCALING,
+                      alpha=1.0, color="tab:red"):
+    """Per-agent applied-force arrows (reference ``_DRAW_FORCE_ARROWS``
+    cylinder+cone pairs, system/rigid_payload.py:204-233, rendered here with
+    matplotlib ``quiver``): one arrow per agent from its position along its
+    applied force, length ``scaling`` m/N with the reference's
+    ``_FORCE_MIN_LENGTH`` floor so near-zero forces stay visible."""
+    positions = np.asarray(positions)
+    forces = np.asarray(forces)
+    norms = np.linalg.norm(forces, axis=-1)
+    safe = np.where(norms > 1e-9, norms, 1.0)
+    lengths = np.maximum(norms * scaling, FORCE_MIN_LENGTH)
+    dirs = forces / safe[:, None]
+    vecs = dirs * lengths[:, None] * (norms > 1e-9)[:, None]
+    ax.quiver(
+        positions[:, 0], positions[:, 1], positions[:, 2],
+        vecs[:, 0], vecs[:, 1], vecs[:, 2],
+        color=color, alpha=alpha, lw=1.2, arrow_length_ratio=0.25,
+    )
 
 
 def draw_pmrl_snapshot(ax, params, payload_vertices, state, alpha=1.0):
@@ -205,15 +238,22 @@ def render_frames(
     forest=None,
     stride: int = 25,
     follow: bool = True,
+    force_arrows: bool = False,
 ):
     """Replay a rollout log as PNG frames (the reference's meshcat replay with
     follow camera, rqp_plots.py:44-109; camera smoothing via a simple windowed
-    mean instead of savgol). Returns the frame paths."""
+    mean instead of savgol). ``force_arrows`` overlays the logged commanded
+    forces per agent (the reference's ``_DRAW_FORCE_ARROWS`` option; needs
+    ``f_des_seq`` in the log — state-only log rates fall back to no arrows).
+    Returns the frame paths."""
     plt = _mpl()
     os.makedirs(out_dir, exist_ok=True)
     xl_seq = np.asarray(logs["state_seq"]["xl"])
     Rl_seq = np.asarray(logs["state_seq"]["Rl"])
     R_seq = np.asarray(logs["state_seq"]["R"])
+    f_seq = None
+    if force_arrows and "f_des_seq" in logs:
+        f_seq = np.asarray(logs["f_des_seq"])
 
     # Smoothed follow-camera track.
     k = 25
@@ -231,7 +271,8 @@ def render_frames(
         ax = fig.add_subplot(projection="3d")
         s = _S()
         s.xl, s.Rl, s.R = xl_seq[t], Rl_seq[t], R_seq[t]
-        draw_snapshot(ax, params, payload_vertices, s, forest)
+        draw_snapshot(ax, params, payload_vertices, s, forest,
+                      forces=None if f_seq is None else f_seq[t])
         c = smooth[t] if follow else xl_seq[0]
         ax.set_xlim(c[0] - 4, c[0] + 4)
         ax.set_ylim(c[1] - 4, c[1] + 4)
