@@ -513,8 +513,10 @@ def _single_stream(controller, n, n_steps=50):
     return out
 
 
-def _batched(controller, n, n_scenarios, n_steps=10):
-    step, css, states = build(controller, n, n_scenarios)
+def _batched(controller, n, n_scenarios, n_steps=10, socp_fused="auto",
+             buckets=0):
+    step, css, states = build(controller, n, n_scenarios,
+                              socp_fused=socp_fused, buckets=buckets)
     return measure(step, css, states, jax.devices()[0], n_steps, n_scenarios)
 
 
@@ -690,6 +692,37 @@ def sweep(resume: bool = False):
                 "ratio": tpu / ref,
             })
 
+    # A/B cells for the round-4 switches (VERDICT r4 item 6): headline
+    # config x {scan, pallas} x {0, 2 buckets}, plus the n=64 fused A/B.
+    # TPU-only — the Pallas kernel has no CPU lowering worth timing and the
+    # bucketing question (worst-lane while_loop drag) is a device question.
+    if jax.devices()[0].platform != "cpu":
+        ab_cells = [
+            (f"headline_fused_{fused}_buckets{nb}",
+             dict(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
+                  socp_fused=fused, buckets=nb))
+            for fused in ("scan", "pallas") for nb in (0, 2)
+        ] + [
+            (f"cadmm_n64_batch64_fused_{fused}",
+             dict(controller="cadmm", n=64, n_scenarios=64, socp_fused=fused))
+            for fused in ("scan", "pallas")
+        ]
+        for key, kw in ab_cells:
+            # An "error" cell is retried on --resume (unlike a measured one):
+            # a transient tunnel death must not be checkpointed as a result.
+            if key in results and "error" not in results[key]:
+                continue
+            try:
+                rate = _batched(kw["controller"], kw["n"], kw["n_scenarios"],
+                                socp_fused=kw["socp_fused"],
+                                buckets=kw.get("buckets", 0))
+                record(key, {"scenario_mpc_steps_per_sec": rate,
+                             "agent_mpc_steps_per_sec": rate * kw["n"]})
+            except Exception as e:
+                # Keep going: a Pallas lowering failure IS a result for its
+                # cell and must not kill the scan/bucket cells after it.
+                record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+
     _write_json_atomic("BENCH_SWEEP.json", results)
     if os.path.exists(SWEEP_PARTIAL_PATH):
         os.remove(SWEEP_PARTIAL_PATH)
@@ -707,10 +740,16 @@ def sweep(resume: bool = False):
             print(f"| {ctrl} n={n} single-stream | "
                   f"{r['mpc_steps_per_sec']:.1f} | {r['step_ms_mean']:.2f} | "
                   f"{per_iter_s} |")
-    for key in [k for k in results if "batch" in k or "swarm" in k]:
+    for key in [k for k in results
+                if "batch" in k or "swarm" in k or "fused" in k]:
         r = results[key]
-        print(f"| {key} | {r['scenario_mpc_steps_per_sec']:.1f} scenario-steps/s "
-              f"({r['agent_mpc_steps_per_sec']:.0f} agent-steps/s) | — | — |")
+        if "scenario_mpc_steps_per_sec" not in r:  # errored A/B cell.
+            print(f"| {key} | ERROR: {r.get('error', '?')} | — | — |")
+            continue
+        agent_s = (f" ({r['agent_mpc_steps_per_sec']:.0f} agent-steps/s)"
+                   if "agent_mpc_steps_per_sec" in r else "")
+        print(f"| {key} | {r['scenario_mpc_steps_per_sec']:.1f} "
+              f"scenario-steps/s{agent_s} | — | — |")
 
 
 def multichip(n_steps: int = 10, n_swarm: int = 128, reps: int = 3,

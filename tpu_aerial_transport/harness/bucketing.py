@@ -74,7 +74,13 @@ def bucketed_step(step_fn: Callable, metric_fn: Callable,
 
     def batched(css, states):
         B = jax.tree.leaves(states)[0].shape[0]
-        assert B % n_buckets == 0, (B, n_buckets)
+        if B % n_buckets != 0:
+            divisors = [d for d in range(2, B + 1) if B % d == 0]
+            raise ValueError(
+                f"bucketed_step: batch size {B} is not divisible by "
+                f"n_buckets={n_buckets} (static shapes require equal "
+                f"buckets); valid bucket counts for this batch: {divisors}"
+            )
         per = B // n_buckets
         m = jax.vmap(metric_fn)(states)
         order = jnp.argsort(m)

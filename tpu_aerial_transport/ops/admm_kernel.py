@@ -149,8 +149,7 @@ def admm_chunk_lanes(
 
     # Transpose to lanes-last and pad. (For the consensus controllers K2/w2
     # are loop-invariant across outer iterations; XLA hoists these
-    # transposes out of the surrounding while_loop when it can — measured in
-    # bench.py, see BASELINE.md round 4.)
+    # transposes out of the surrounding while_loop when it can.)
     K2T = _pad_lanes(jnp.moveaxis(K2, 0, -1), B_pad)           # (d, d, Bp)
     w2T = _pad_lanes(jnp.moveaxis(w2, 0, -1), B_pad)           # (d, Bp)
     rhoT = _pad_lanes(jnp.moveaxis(rho, 0, -1), B_pad, 1.0)    # (m, Bp)

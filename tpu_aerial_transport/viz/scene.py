@@ -202,7 +202,12 @@ def draw_force_arrows(ax, positions, forces, scaling=FORCE_SCALING,
     safe = np.where(norms > 1e-9, norms, 1.0)
     lengths = np.maximum(norms * scaling, FORCE_MIN_LENGTH)
     dirs = forces / safe[:, None]
-    vecs = dirs * lengths[:, None] * (norms > 1e-9)[:, None]
+    # Exactly-zero force: fall back to +z (the reference's default cylinder
+    # orientation) so the min-length arrow is still drawn.
+    z = np.zeros_like(dirs)
+    z[:, 2] = 1.0
+    dirs = np.where((norms > 1e-9)[:, None], dirs, z)
+    vecs = dirs * lengths[:, None]
     ax.quiver(
         positions[:, 0], positions[:, 1], positions[:, 2],
         vecs[:, 0], vecs[:, 1], vecs[:, 2],
