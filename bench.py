@@ -194,7 +194,7 @@ def _substeps(params, ll, state, f_des, n_sub=10, dt=1e-3):
 
 def make_mpc_step(controller: str, n: int, max_iter: int = 20,
                   inner_iters: int | None = None, socp_fused: str = "auto",
-                  force_fixed_iters: bool = False):
+                  force_fixed_iters: bool = False, inner_tol: float = 0.0):
     # Default inner ADMM budgets are the measured knees. C-ADMM: 20 — below
     # it the warm-started agent solves miss the 5e-3 primal tolerance and
     # fall back to equilibrium forces (visible as an exactly-zero consensus
@@ -214,7 +214,7 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             params, col.collision_radius, col.max_deceleration,
             max_iter=max_iter,
             inner_iters=inner_iters if inner_iters is not None else 20,
-            socp_fused=socp_fused,
+            socp_fused=socp_fused, inner_tol=inner_tol,
             # res_tol = 0 can never be met (inf-norm >= 0), so the consensus
             # loop runs to exactly max_iter + 1 iterations — the fixed-count
             # mode _measured_iter_ms differences.
@@ -236,7 +236,7 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             params, col.collision_radius, col.max_deceleration,
             max_iter=max_iter,
             inner_iters=inner_iters if inner_iters is not None else 40,
-            socp_fused=socp_fused,
+            socp_fused=socp_fused, inner_tol=inner_tol,
             **({"prim_inf_tol": 0.0} if force_fixed_iters else {}),
         )
         cs0 = dd.init_dd_state(params, cfg)
@@ -284,8 +284,9 @@ def _scenario_batch(state0, n_scenarios):
 
 
 def build(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
-          socp_fused="auto", buckets=0):
-    mpc_step, cs0, state0 = make_mpc_step(controller, n, socp_fused=socp_fused)
+          socp_fused="auto", buckets=0, inner_tol=0.0):
+    mpc_step, cs0, state0 = make_mpc_step(controller, n, socp_fused=socp_fused,
+                                          inner_tol=inner_tol)
     states = _scenario_batch(state0, n_scenarios)
     css = jax.vmap(lambda _: cs0)(jnp.arange(n_scenarios))
 
@@ -426,8 +427,10 @@ def ref_arch_cpu_rate(n=N_AGENTS, max_iter=20, inner_iters=20, n_steps=5):
 
 
 def headline(profile_dir: str | None = None, platform: str = "unknown",
-             socp_fused: str = "auto", buckets: int = 0):
-    step, css, states = build(socp_fused=socp_fused, buckets=buckets)
+             socp_fused: str = "auto", buckets: int = 0,
+             inner_tol: float = 0.0):
+    step, css, states = build(socp_fused=socp_fused, buckets=buckets,
+                              inner_tol=inner_tol)
     if profile_dir:
         # Warm up outside the trace so the profile shows steady-state execution.
         measure(step, css, states, jax.devices()[0], TIMED_STEPS, N_SCENARIOS)
@@ -514,9 +517,10 @@ def _single_stream(controller, n, n_steps=50):
 
 
 def _batched(controller, n, n_scenarios, n_steps=10, socp_fused="auto",
-             buckets=0):
+             buckets=0, inner_tol=0.0):
     step, css, states = build(controller, n, n_scenarios,
-                              socp_fused=socp_fused, buckets=buckets)
+                              socp_fused=socp_fused, buckets=buckets,
+                              inner_tol=inner_tol)
     return measure(step, css, states, jax.devices()[0], n_steps, n_scenarios)
 
 
@@ -706,6 +710,25 @@ def sweep(resume: bool = False):
             (f"cadmm_n64_batch64_fused_{fused}",
              dict(controller="cadmm", n=64, n_scenarios=64, socp_fused=fused))
             for fused in ("scan", "pallas")
+        ] + [
+            # Tolerance-chunked inner solves (inner_tol): CPU A/B measured
+            # 1.67x on DD n=64 but a SLOWDOWN for C-ADMM (0.43-0.89x, knee-
+            # sized inner budget — BASELINE.md round 5), so on-chip cells
+            # are DD plus one headline confirmation only.
+            ("dd_n64_batch64_innertol",
+             dict(controller="dd", n=64, n_scenarios=64, inner_tol=2e-3)),
+            ("headline_innertol",
+             dict(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
+                  inner_tol=2e-3)),
+            ("dd_n64_batch64_fused_pallas",
+             dict(controller="dd", n=64, n_scenarios=64, socp_fused="pallas")),
+            ("dd_n64_batch64_innertol_pallas",
+             dict(controller="dd", n=64, n_scenarios=64, socp_fused="pallas",
+                  inner_tol=2e-3)),
+            # DD worst-lane outer iterations ride the cap harder than
+            # C-ADMM's — congestion bucketing may pay off most here.
+            ("dd_n64_batch64_buckets2",
+             dict(controller="dd", n=64, n_scenarios=64, buckets=2)),
         ]
         for key, kw in ab_cells:
             # An "error" cell is retried on --resume (unlike a measured one):
@@ -714,8 +737,9 @@ def sweep(resume: bool = False):
                 continue
             try:
                 rate = _batched(kw["controller"], kw["n"], kw["n_scenarios"],
-                                socp_fused=kw["socp_fused"],
-                                buckets=kw.get("buckets", 0))
+                                socp_fused=kw.get("socp_fused", "auto"),
+                                buckets=kw.get("buckets", 0),
+                                inner_tol=kw.get("inner_tol", 0.0))
                 record(key, {"scenario_mpc_steps_per_sec": rate,
                              "agent_mpc_steps_per_sec": rate * kw["n"]})
             except Exception as e:
@@ -741,7 +765,8 @@ def sweep(resume: bool = False):
                   f"{r['mpc_steps_per_sec']:.1f} | {r['step_ms_mean']:.2f} | "
                   f"{per_iter_s} |")
     for key in [k for k in results
-                if "batch" in k or "swarm" in k or "fused" in k]:
+                if "batch" in k or "swarm" in k or "fused" in k
+                or "innertol" in k]:
         r = results[key]
         if "scenario_mpc_steps_per_sec" not in r:  # errored A/B cell.
             print(f"| {key} | ERROR: {r.get('error', '?')} | — | — |")
@@ -1106,6 +1131,9 @@ def main():
     ap.add_argument("--buckets", type=int, default=0,
                     help="headline congestion-bucket count (0/1 = off; "
                          "harness/bucketing.py A/B switch)")
+    ap.add_argument("--inner-tol", type=float, default=0.0,
+                    help="tolerance-chunked inner solves (0 = fixed-budget; "
+                         "A/B switch, see BASELINE.md round 5)")
     args = ap.parse_args()
     _honor_jax_platforms_env()
     mode_metric = ("bench_sweep" if args.sweep
@@ -1124,7 +1152,7 @@ def main():
         roofline()
     else:
         headline(args.profile, platform=platform, socp_fused=args.fused,
-                 buckets=args.buckets)
+                 buckets=args.buckets, inner_tol=args.inner_tol)
 
 
 if __name__ == "__main__":

@@ -70,7 +70,7 @@ def main() -> None:
     if args.meshcat:
         backend = scene.MeshcatBackend().open()
         backend.replay(logs, params, payload_vertices=col.payload_vertices,
-                       forest=forest)
+                       forest=forest, force_arrows=args.force_arrows)
     else:
         frames = scene.render_frames(
             logs, params, col.payload_vertices,

@@ -198,3 +198,79 @@ def test_oversized_solve_falls_back_to_scan():
     )
     assert float(sol.prim_res) < 1e-3
     np.testing.assert_allclose(np.asarray(sol.x[:4]), 0.5, atol=1e-2)
+
+
+def test_dd_step_fused_matches_scan():
+    """Full DD control step (18-var agent QPs, d = nv + m = 49 kernel dim)
+    with fused chunks == scan chunks — the coverage that lets the on-chip
+    fused A/B flip DD's default too, not just C-ADMM's."""
+    from tpu_aerial_transport.control import dd
+
+    n = 4
+    params, col, state = setup.rqp_setup(n)
+    f_eq = centralized.equilibrium_forces(params)
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+
+    def run(mode):
+        cfg = dd.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=6, inner_iters=10, socp_fused=mode,
+        )
+        dstate = dd.init_dd_state(params, cfg)
+        vls = jnp.stack([
+            jnp.array([0.2, 0.1, 0.0]), jnp.array([-0.1, 0.3, 0.1]),
+        ])
+        states = jax.vmap(lambda v: state.replace(vl=v))(vls)
+        dstates = jax.vmap(lambda _: dstate)(vls)
+
+        def one(dst, st):
+            return dd.control(params, cfg, f_eq, dst, st, acc_des)
+
+        f, _, stats = jax.jit(jax.vmap(one))(dstates, states)
+        return f, stats
+
+    f_ref, st_ref = run("scan")
+    f_out, st_out = run("interpret")
+    np.testing.assert_allclose(
+        np.asarray(f_out), np.asarray(f_ref), rtol=0, atol=5e-4
+    )
+    assert np.array_equal(np.asarray(st_out.iters), np.asarray(st_ref.iters))
+
+
+def test_dd_step_fused_inner_tol_matches_scan():
+    """Tolerance-chunked inner solves UNDER the fused kernel (the while_loop
+    of fused chunk runners, batched by vmap) — the exact composition the
+    on-chip sweep cell dd_n64_batch64_innertol_pallas runs — must trace,
+    execute, and match the scan path on CPU (interpret mode) first."""
+    from tpu_aerial_transport.control import dd
+
+    n = 4
+    params, col, state = setup.rqp_setup(n)
+    f_eq = centralized.equilibrium_forces(params)
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+
+    def run(mode):
+        cfg = dd.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=6, inner_iters=20, socp_fused=mode,
+            inner_tol=2e-3, inner_check_every=5,
+        )
+        dstate = dd.init_dd_state(params, cfg)
+        vls = jnp.stack([
+            jnp.array([0.2, 0.1, 0.0]), jnp.array([-0.1, 0.3, 0.1]),
+        ])
+        states = jax.vmap(lambda v: state.replace(vl=v))(vls)
+        dstates = jax.vmap(lambda _: dstate)(vls)
+
+        def one(dst, st):
+            return dd.control(params, cfg, f_eq, dst, st, acc_des)
+
+        f, _, stats = jax.jit(jax.vmap(one))(dstates, states)
+        return f, stats
+
+    f_ref, st_ref = run("scan")
+    f_out, st_out = run("interpret")
+    np.testing.assert_allclose(
+        np.asarray(f_out), np.asarray(f_ref), rtol=0, atol=5e-4
+    )
+    assert np.array_equal(np.asarray(st_out.iters), np.asarray(st_ref.iters))

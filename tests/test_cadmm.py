@@ -207,3 +207,31 @@ def test_two_phase_inner_budget_agrees():
     assert int(st_two.iters) <= two.max_iter
     assert float(st_two.solve_res) < two.res_tol
     assert float(jnp.abs(f_two - f_one).max()) < 5e-3
+
+
+def test_inner_tol_early_exit_agrees():
+    """Tolerance-chunked inner solves (inner_tol > 0: each agent QP stops its
+    ADMM chunks once primal+dual residuals clear the tolerance instead of
+    always burning the full fixed budget) must reproduce the fixed-budget
+    forces and iteration counts for BOTH distributed controllers."""
+    from tpu_aerial_transport.control import dd
+
+    n = 4
+    params, col, _, _, _, f_eq = _setup(n)
+    state = _random_state(jax.random.PRNGKey(11), n)
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+
+    for mod, make, init in (
+        (cadmm, cadmm.make_config, cadmm.init_cadmm_state),
+        (dd, dd.make_config, dd.init_dd_state),
+    ):
+        def run(**kw):
+            cfg = make(params, col.collision_radius, col.max_deceleration,
+                       max_iter=10, inner_iters=40, **kw)
+            st = init(params, cfg)
+            return mod.control(params, cfg, f_eq, st, state, acc_des)
+
+        f0, _, st0 = run()
+        f1, _, st1 = run(inner_tol=2e-3, inner_check_every=10)
+        assert int(st1.iters) == int(st0.iters)
+        assert float(jnp.abs(f1 - f0).max()) < 1e-3

@@ -144,3 +144,111 @@ def test_quadrotor_mesh_and_forest_scene(tmp_path):
     fig.savefig(str(out))
     plt.close(fig)
     assert out.stat().st_size > 0
+
+
+def test_rotation_y_to():
+    """Minimal rotation taking +y onto an arbitrary unit direction: proper
+    orthogonal, maps y exactly, antipodal -y handled."""
+    from tpu_aerial_transport.viz.scene import _rotation_y_to
+
+    rng = np.random.default_rng(3)
+    dirs = rng.normal(size=(20, 3))
+    dirs /= np.linalg.norm(dirs, axis=-1, keepdims=True)
+    dirs = np.concatenate([dirs, [[0, 1, 0.0]], [[0, -1, 0.0]],
+                           [[0, 0, 1.0]]])
+    for d in dirs:
+        R = _rotation_y_to(d)
+        np.testing.assert_allclose(R @ R.T, np.eye(3), atol=1e-12)
+        assert np.linalg.det(R) > 0.99
+        np.testing.assert_allclose(R @ np.array([0, 1, 0.0]), d, atol=1e-9)
+
+
+def test_meshcat_force_arrow_geometry(monkeypatch):
+    """Solid cylinder+cone force arrows (reference rigid_payload.py:249-274
+    update path) against a stub meshcat: shaft height = max(|f|*scaling,
+    min-length), shaft centered at root + L/2 d, head at root + (L + h/2) d,
+    zero force points +z at min length."""
+    import sys
+    import types
+
+    calls = {}
+
+    class _Rec:
+        def __init__(self, path):
+            self.path = path
+
+        def set_object(self, geom, *a):
+            calls.setdefault(self.path, {})["geom"] = geom
+
+        def set_transform(self, T):
+            calls.setdefault(self.path, {})["T"] = np.array(T)
+
+    class _Vis:
+        def __getitem__(self, path):
+            return _Rec(path)
+
+    class _Cyl:
+        def __init__(self, height, radius=None, radiusBottom=None,
+                     radiusTop=None):
+            self.height = height
+            self.radius = radius
+
+    gm = types.ModuleType("meshcat.geometry")
+    gm.Cylinder = _Cyl
+    tfm = types.ModuleType("meshcat.transformations")
+
+    def _tl(v):
+        T = np.eye(4)
+        T[:3, 3] = np.asarray(v, float)
+        return T
+
+    tfm.translation_matrix = _tl
+    mc = types.ModuleType("meshcat")
+    mc.geometry = gm
+    mc.transformations = tfm
+    monkeypatch.setitem(sys.modules, "meshcat", mc)
+    monkeypatch.setitem(sys.modules, "meshcat.geometry", gm)
+    monkeypatch.setitem(sys.modules, "meshcat.transformations", tfm)
+
+    from tpu_aerial_transport.harness import setup
+    from tpu_aerial_transport.viz import scene
+
+    params, _, _ = setup.rqp_setup(3)
+    backend = scene.MeshcatBackend.__new__(scene.MeshcatBackend)
+    backend.vis = _Vis()
+    backend._objs = set()
+
+    xl = np.array([1.0, 2.0, 3.0])
+    Rl = np.eye(3)
+    forces = np.array([[0.0, 0.0, 4.0], [3.0, 0.0, 0.0], [0.0, 0.0, 0.0]])
+    backend._update_force_arrows(params, xl, Rl, forces)
+
+    r = np.asarray(params.r)
+    for i, (f, d_exp) in enumerate(zip(
+        forces, [[0, 0, 1.0], [1.0, 0, 0], [0, 0, 1.0]]
+    )):
+        L = max(np.linalg.norm(f) * scene.FORCE_SCALING,
+                scene.FORCE_MIN_LENGTH)
+        root = xl + Rl @ r[i]
+        tail = calls[f"force_tail_{i}"]
+        head = calls[f"force_head_{i}"]
+        # Unit-height shaft, re-posed per frame: the length rides in the
+        # transform as a y-axis scale (no per-frame geometry re-uploads).
+        assert abs(tail["geom"].height - 1.0) < 1e-12
+        np.testing.assert_allclose(
+            tail["T"][:3, 3], root + 0.5 * L * np.array(d_exp), atol=1e-9
+        )
+        np.testing.assert_allclose(
+            head["T"][:3, 3],
+            root + (L + 0.5 * scene.FORCE_HEAD_LENGTH) * np.array(d_exp),
+            atol=1e-9,
+        )
+        # Cylinder axis (+y) maps onto the force direction, scaled to the
+        # arrow length; the cross axes stay unit (radius unscaled).
+        np.testing.assert_allclose(
+            tail["T"][:3, :3] @ np.array([0, 1, 0.0]),
+            L * np.array(d_exp), atol=1e-9,
+        )
+        for axis in ([1.0, 0, 0], [0, 0, 1.0]):
+            assert abs(np.linalg.norm(tail["T"][:3, :3] @ np.array(axis))
+                       - 1.0) < 1e-9

@@ -1,0 +1,63 @@
+"""Probe TPU-backend liveness under a watchdog and append a timestamped
+attempt record to ``artifacts/tpu_probe_log_r5.txt``.
+
+VERDICT r4 item 1: when the chip is wedged, the round must carry an explicit
+timestamped attempt log instead of a silent absence of numbers. Exit 0 iff
+the accelerator responded (platform != cpu).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "artifacts", "tpu_probe_log_r5.txt")
+
+PROBE_CODE = (
+    "import os, jax\n"
+    "envp = os.environ.get('JAX_PLATFORMS')\n"
+    "if envp: jax.config.update('jax_platforms', envp)\n"
+    "d = jax.devices()\n"
+    "import jax.numpy as jnp\n"
+    "x = jnp.ones((128, 128)); s = float((x @ x).sum())\n"
+    "print('BACKEND_OK', d[0].platform, len(d), s)"
+)
+
+
+def probe(timeout_s: int = 60) -> tuple[bool, str]:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"timeout after {timeout_s}s (chip unreachable/wedged)"
+    out = proc.stdout.strip().splitlines()
+    ok_line = next((l for l in out if l.startswith("BACKEND_OK")), None)
+    if proc.returncode != 0 or ok_line is None:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-1:]
+        return False, f"probe rc={proc.returncode}: {' '.join(tail)[:200]}"
+    platform = ok_line.split()[1]
+    if platform == "cpu":
+        return False, f"silent CPU fallback ({ok_line})"
+    return True, ok_line
+
+
+def main() -> int:
+    ok, detail = probe()
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M:%S UTC"
+    )
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
+    with open(LOG, "a") as fh:
+        fh.write(f"{stamp}  {'ALIVE' if ok else 'DOWN'}  {detail}\n")
+    print(f"{stamp}  {'ALIVE' if ok else 'DOWN'}  {detail}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

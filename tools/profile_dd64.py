@@ -1,0 +1,117 @@
+"""Component-split profile of the n=64 batched DD MPC step (VERDICT r4
+item 4): where does the step time go — QP build, KKT-operator build, the
+per-iteration conic solves, or the 6n-dim quasi-Newton dual machinery?
+
+Methodology (scan-amortized, same conventions as bench.py): each variant is
+the FULL batched MPC step with one knob moved, timed as a fixed-iteration
+rollout; differencing isolates the component. Runs on whatever backend JAX
+resolves (CPU relative structure transfers to TPU for the vector path;
+absolute numbers do not — rerun on chip for the record).
+
+Usage: JAX_PLATFORMS=cpu python tools/profile_dd64.py [--n 64] [--batch 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args, reps=3, n_steps=6):
+    jitted = jax.jit(fn, static_argnames="n_steps")
+    out = jitted(*args, n_steps=n_steps)
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jitted(*args, n_steps=n_steps)
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)) / n_steps * 1e3  # ms / MPC step
+
+
+def build_step(n, batch, max_iter, inner_iters, fixed=True, inner_tol=0.0):
+    import bench
+
+    mpc_step, cs0, state0 = bench.make_mpc_step(
+        "dd", n, max_iter=max_iter, inner_iters=inner_iters,
+        force_fixed_iters=fixed, inner_tol=inner_tol,
+    )
+    states = bench._scenario_batch(state0, batch)
+    css = jax.vmap(lambda _: cs0)(jnp.arange(batch))
+    vstep = jax.vmap(mpc_step)
+
+    def roll(css, states, n_steps):
+        def body(carry, _):
+            cs, s = carry
+            cs, s, _ = vstep(cs, s)
+            return (cs, s), None
+
+        return jax.lax.scan(body, (css, states), None, length=n_steps)[0]
+
+    return roll, css, states
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+    n, batch = args.n, args.batch
+
+    res = {"platform": jax.devices()[0].platform, "n": n, "batch": batch}
+
+    # (1) Fixed 8 outer x 40 inner (the bench operating point's iteration
+    #     shape) vs 8 x 20: differencing gives the pure inner-ADMM cost.
+    t_8x40 = timed(*build_step(n, batch, max_iter=7, inner_iters=40))
+    t_8x20 = timed(*build_step(n, batch, max_iter=7, inner_iters=20))
+    res["step_ms_8outer_40inner"] = t_8x40
+    res["step_ms_8outer_20inner"] = t_8x20
+    res["ms_per_inner_iter_x8outer"] = (t_8x40 - t_8x20) / 20
+    # per single inner ADMM iteration across the whole batch (8 outer iters
+    # each run `inner` of them):
+    res["ms_per_single_inner_iter"] = (t_8x40 - t_8x20) / 20 / 8
+
+    # (2) Outer-iteration overhead beyond the solves: 16 outer vs 8 outer at
+    #     fixed inner=20 gives (solve + QN + consensus) per outer; subtract
+    #     the solve part from (1).
+    t_16x20 = timed(*build_step(n, batch, max_iter=15, inner_iters=20))
+    res["step_ms_16outer_20inner"] = t_16x20
+    per_outer = (t_16x20 - t_8x20) / 8
+    res["ms_per_outer_iter_at_inner20"] = per_outer
+    solve_per_outer = res["ms_per_single_inner_iter"] * 20
+    res["ms_per_outer_qn_and_consensus"] = per_outer - solve_per_outer
+
+    # (3) Fixed per-step work (QP build, kkt_operator, env query, low-level
+    #     + physics substeps): extrapolate to zero outer iterations.
+    res["ms_fixed_per_step"] = t_8x20 - 8 * per_outer
+
+    # (4) Adaptive run (real tolerances) for the actual operating point.
+    roll, css, states = build_step(n, batch, max_iter=20, inner_iters=40,
+                                   fixed=False)
+    res["step_ms_adaptive"] = timed(roll, css, states)
+
+    # (5) Adaptive + tolerance-chunked inner solves (inner_tol): warm-started
+    #     agent QPs stop their ADMM chunks at 2e-3 residual instead of always
+    #     burning the full 40-iteration budget.
+    roll, css, states = build_step(n, batch, max_iter=20, inner_iters=40,
+                                   fixed=False, inner_tol=2e-3)
+    res["step_ms_adaptive_inner_tol"] = timed(roll, css, states)
+    res["inner_tol_speedup"] = (
+        res["step_ms_adaptive"] / res["step_ms_adaptive_inner_tol"]
+    )
+
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -124,6 +124,16 @@ class RQPCADMMConfig:
     # iteration ADMM chunk as one fused TPU kernel with the per-agent
     # operators VMEM-resident (ops/admm_kernel.py).
     socp_fused: str = struct.field(pytree_node=False, default="auto")
+    # Tolerance-chunked inner solves: when inner_tol > 0, each agent QP runs
+    # its ADMM iterations in chunks of ``inner_check_every`` and stops as
+    # soon as primal AND dual residuals drop below ``inner_tol`` (ops/socp.py
+    # check_every/tol path), still capped at ``inner_iters``. Warm-started
+    # steady-state solves typically converge well before the fixed budget;
+    # inside a vmapped batch the saving realizes once every lane of the
+    # batched program is converged (while_loop batching semantics). 0 = off
+    # (fixed-iteration solves, bit-identical to the historical path).
+    inner_tol: float = struct.field(pytree_node=False, default=0.0)
+    inner_check_every: int = struct.field(pytree_node=False, default=10)
 
 
 def make_config(
@@ -142,6 +152,8 @@ def make_config(
     tau_incr: float = 1.0,
     rho_max: float = 2.0,
     socp_fused: str = "auto",
+    inner_tol: float = 0.0,
+    inner_check_every: int = 10,
 ) -> RQPCADMMConfig:
     """Defaults are reference-conservative (max_iter mirrors the reference's
     100-iteration cap). For warm-started receding-horizon use, the measured
@@ -182,7 +194,11 @@ def make_config(
         inner_iters=inner_iters,
         inner_iters_warm=inner_iters_warm,
         reduced_qp=reduced_qp,
-        socp_fused=socp_fused,
+        # Resolved here (config build time, outside jit) so the mode is an
+        # explicit static field rather than a trace-time backend probe.
+        socp_fused=socp.resolve_fused(socp_fused),
+        inner_tol=inner_tol,
+        inner_check_every=inner_check_every,
     )
 
 
@@ -971,6 +987,9 @@ def control(
                 P_, q_, A_, lb_, ub_,
                 n_box=n_box, soc_dims=(4, 4), iters=iters,
                 warm=warm_, shift=shift_, op=op_, fused=cfg.socp_fused,
+                tol=cfg.inner_tol,
+                check_every=(cfg.inner_check_every if cfg.inner_tol > 0
+                             else 0),
             )
         )
 

@@ -65,6 +65,8 @@ def make_config(
     k_smooth: float = 0.0,
     dt: float = 1e-3,
     socp_fused: str = "auto",
+    inner_tol: float = 0.0,
+    inner_check_every: int = 10,
 ) -> RQPDDConfig:
     """Defaults are reference-conservative. For warm-started receding-horizon
     use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
@@ -76,6 +78,7 @@ def make_config(
         params, collision_radius, max_deceleration,
         n_env_cbfs=n_env_cbfs, max_iter=max_iter, inner_iters=inner_iters,
         k_smooth=k_smooth, dt=dt, socp_fused=socp_fused,
+        inner_tol=inner_tol, inner_check_every=inner_check_every,
     )
     return RQPDDConfig(base=base, prim_inf_tol=prim_inf_tol)
 
@@ -515,6 +518,9 @@ def control(
             P_, q_, A_, lb_, ub_,
             n_box=n_box, soc_dims=(4, 4), iters=base.inner_iters,
             warm=warm_, shift=shift_, op=op_, fused=base.socp_fused,
+            tol=base.inner_tol,
+            check_every=(base.inner_check_every if base.inner_tol > 0
+                         else 0),
         )
     )
 

@@ -205,12 +205,21 @@ def _fused_chunk_runner(nv: int, n_box: int, soc_dims: tuple, iters: int,
     return single
 
 
-def _resolve_fused(fused: str) -> str:
+def resolve_fused(fused: str) -> str:
+    """Resolve ``"auto"`` to the backend default ("scan" on CPU,
+    ``_AUTO_FUSED_NONCPU`` elsewhere). Controllers call this at CONFIG BUILD
+    time (outside jit) so the chosen mode is an explicit static config field
+    — resolving inside a jitted function would bake the first backend seen
+    into a trace cache keyed only on the "auto" string (stale if the
+    process later switches platforms)."""
     if fused == "auto":
         return (
             "scan" if jax.default_backend() == "cpu" else _AUTO_FUSED_NONCPU
         )
     return fused
+
+
+_resolve_fused = resolve_fused  # solve_socp-internal alias (direct callers).
 
 
 @partial(

@@ -20,6 +20,9 @@ QUAD_ARM = 0.15  # [m] drawn arm length for the quadrotor cross.
 # Force-arrow overlay constants (reference system/rigid_payload.py:26-30).
 FORCE_SCALING = 1.0  # [m/N] arrow length per Newton.
 FORCE_MIN_LENGTH = 0.05  # [m] floor so near-zero forces stay visible.
+FORCE_TAIL_RADIUS = 0.01  # [m] arrow shaft cylinder radius.
+FORCE_HEAD_BASE_RADIUS = 0.03  # [m] arrow head cone base radius.
+FORCE_HEAD_LENGTH = 0.1  # [m] arrow head cone height.
 CONE_HEIGHT = 2.0  # [m] foliage cone on each bark (reference env_forest.py:24).
 CONE_RADIUS = 1.0
 
@@ -328,6 +331,19 @@ def render_ghost_snapshot(
 _Z_UP = np.array([[1, 0, 0], [0, 0, -1], [0, 1, 0]], float).T  # y-up -> z-up.
 
 
+def _rotation_y_to(d: np.ndarray) -> np.ndarray:
+    """Rotation taking the +y axis (meshcat's cylinder axis) onto unit ``d``
+    by the minimal rotation (Rodrigues about y x d); antipodal -y falls back
+    to a pi flip about x."""
+    y = np.array([0.0, 1.0, 0.0])
+    c = float(y @ d)
+    if c < -1.0 + 1e-12:
+        return np.diag([1.0, -1.0, -1.0])
+    v = np.cross(y, d)
+    vx = np.array([[0, -v[2], v[1]], [v[2], 0, -v[0]], [-v[1], v[0], 0]])
+    return np.eye(3) + vx + vx @ vx / (1.0 + c)
+
+
 class MeshcatBackend:
     """Live three.js viewer path, used only when meshcat is installed (the
     reference's default backend). Mirrors ``RQPVisualizer``'s scene graph
@@ -418,7 +434,8 @@ class MeshcatBackend:
                 self.vis[qn].set_object(gm.TriangularMeshGeometry(mv, mf))
                 self._objs.add(qn)
 
-    def update(self, params, state, prefix: str = "", payload_vertices=None):
+    def update(self, params, state, prefix: str = "", payload_vertices=None,
+               forces=None):
         import meshcat.transformations as tf
 
         self._ensure_objects(params, payload_vertices, prefix)
@@ -433,12 +450,63 @@ class MeshcatBackend:
             Ti = tf.translation_matrix(xl + Rl @ r[i])
             Ti[:3, :3] = R[i]
             self.vis[prefix + f"quad_{i}"].set_transform(Ti)
+        if forces is not None:
+            self._update_force_arrows(
+                params, xl, Rl, np.asarray(forces), prefix
+            )
+
+    def _update_force_arrows(self, params, xl, Rl, forces, prefix: str = ""):
+        """Solid cylinder+cone arrow per agent along its applied force
+        (reference ``_DRAW_FORCE_ARROWS`` geometry, rigid_payload.py:204-233
+        / :249-274): shaft length ``FORCE_SCALING`` m/N with the
+        ``FORCE_MIN_LENGTH`` floor, fixed-size cone head at the tip, rooted
+        at each attachment point. The shaft is re-created each frame (its
+        height changes); the head is created once and re-posed."""
+        import meshcat.geometry as gm
+        import meshcat.transformations as tf
+
+        r = np.asarray(params.r)
+        for i in range(r.shape[0]):
+            norm = float(np.linalg.norm(forces[i]))
+            d = (forces[i] / norm if norm > 0
+                 else np.array([0.0, 0.0, 1.0]))  # zero force: +z, as ref.
+            length = max(norm * FORCE_SCALING, FORCE_MIN_LENGTH)
+            root = xl + Rl @ r[i]
+            rot = _rotation_y_to(d)
+            tail = prefix + f"force_tail_{i}"
+            head = prefix + f"force_head_{i}"
+            # Both pieces are create-once/re-pose: the varying shaft length
+            # rides in the transform as a y-axis (cylinder-axis) scale of a
+            # unit-height cylinder — no per-frame geometry re-uploads on the
+            # replay hot path.
+            if tail not in self._objs:
+                self.vis[tail].set_object(
+                    gm.Cylinder(height=1.0, radius=FORCE_TAIL_RADIUS)
+                )
+                self._objs.add(tail)
+            T = tf.translation_matrix(root + 0.5 * length * d)
+            T[:3, :3] = rot @ np.diag([1.0, length, 1.0])
+            self.vis[tail].set_transform(T)
+            if head not in self._objs:
+                self.vis[head].set_object(gm.Cylinder(
+                    height=FORCE_HEAD_LENGTH,
+                    radiusBottom=FORCE_HEAD_BASE_RADIUS, radiusTop=0.0,
+                ))
+                self._objs.add(head)
+            Th = tf.translation_matrix(
+                root + (length + 0.5 * FORCE_HEAD_LENGTH) * d
+            )
+            Th[:3, :3] = rot
+            self.vis[head].set_transform(Th)
 
     def replay(self, logs: dict, params, payload_vertices=None, forest=None,
-               speedup: float = 5.0, min_fps: float = 24.0):
+               speedup: float = 5.0, min_fps: float = 24.0,
+               force_arrows: bool = False):
         """Replay a rollout log with the smoothed follow camera (reference
         ``_visualization``, rqp_plots.py:44-109: savgol-smoothed camera track,
-        fast-forward, minimum frame pacing)."""
+        fast-forward, minimum frame pacing). ``force_arrows`` draws the solid
+        cylinder+cone commanded-force arrows (needs ``f_des_seq`` in the
+        log)."""
         import time as _time
 
         if forest is not None:
@@ -446,6 +514,8 @@ class MeshcatBackend:
         xl_seq = np.asarray(logs["state_seq"]["xl"])
         Rl_seq = np.asarray(logs["state_seq"]["Rl"])
         R_seq = np.asarray(logs["state_seq"]["R"])
+        f_seq = (np.asarray(logs["f_des_seq"])
+                 if force_arrows and "f_des_seq" in logs else None)
         dt_frame = logs["dt"] * logs["hl_rel_freq"] / speedup
         stride = max(1, int(round(1.0 / (min_fps * dt_frame))))
         k = 25  # camera smoothing window (savgol stand-in).
@@ -460,7 +530,8 @@ class MeshcatBackend:
         for t in range(0, len(xl_seq), stride):
             s = _S()
             s.xl, s.Rl, s.R = xl_seq[t], Rl_seq[t], R_seq[t]
-            self.update(params, s, payload_vertices=payload_vertices)
+            self.update(params, s, payload_vertices=payload_vertices,
+                        forces=None if f_seq is None else f_seq[t])
             cam = smooth[t] + np.array([-3.0, -3.0, 1.5])
             try:
                 self.vis.set_cam_pos(cam)
