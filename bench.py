@@ -962,6 +962,81 @@ PEAK_F32_FLOPS = 49e12
 PEAK_HBM_BPS = 819e9
 
 
+def smoke():
+    """~30-second chip validation (VERDICT r4 item 2's precondition for the
+    fused A/B): solve one small batch of structured SOCPs twice — scan path
+    and Pallas path — on the default device, and report whether Mosaic
+    compiles the kernel and the two solutions agree. One JSON line; exit
+    nonzero only on infrastructure failure (a kernel compile failure is a
+    RESULT, reported in the line)."""
+    from tpu_aerial_transport.ops import admm_kernel, socp
+
+    rng = np.random.default_rng(0)
+    nv, n_box, soc = 12, 23, (4, 4)
+    m = n_box + sum(soc)
+    # Below this bound the "pallas" request really builds the kernel; above
+    # it solve_socp silently falls back to scan and the smoke would compare
+    # scan against scan — a false PASS in the kernel-validation tool.
+    assert nv + m <= admm_kernel.MAX_FUSED_DIM, (nv + m)
+
+    def make():
+        L = rng.normal(size=(nv, nv))
+        return (L @ L.T + 0.1 * np.eye(nv), rng.normal(size=nv),
+                rng.normal(size=(m, nv)) * 0.5,
+                rng.uniform(-2, -0.5, n_box), rng.uniform(0.5, 2, n_box))
+
+    Ps, qs, As, lbs, ubs = (
+        jnp.asarray(np.stack(a), jnp.float32)
+        for a in zip(*[make() for _ in range(256)])
+    )
+
+    def run(mode):
+        def one(P, q, A, lb, ub):
+            return socp.solve_socp(
+                P, q, A, lb, ub, n_box=n_box, soc_dims=soc, iters=60,
+                fused=mode,
+            )
+        t0 = time.perf_counter()
+        lowered = jax.jit(jax.vmap(one)).lower(Ps, qs, As, lbs, ubs)
+        compiled = lowered.compile()  # Mosaic runs here.
+        t_compile = time.perf_counter() - t0
+        sol = compiled(Ps, qs, As, lbs, ubs)
+        jax.block_until_ready(sol.x)
+        return sol, t_compile
+
+    out = {"metric": "pallas_smoke", "platform": jax.devices()[0].platform}
+    sol_scan, t_scan = run("scan")
+    out["scan_ok"] = bool(np.isfinite(np.asarray(sol_scan.x)).all())
+    out["scan_compile_s"] = round(t_scan, 1)
+    # Compile and execution are separated so a post-compile runtime fault
+    # (e.g. a Mosaic VMEM error at block_until_ready) is not misreported as
+    # a compile failure.
+    out["pallas_compiles"] = False
+    out["pallas_runs"] = False
+    out["value"] = 0
+    try:
+        def one_pl(P, q, A, lb, ub):
+            return socp.solve_socp(
+                P, q, A, lb, ub, n_box=n_box, soc_dims=soc, iters=60,
+                fused="pallas",
+            )
+        t0 = time.perf_counter()
+        compiled = jax.jit(jax.vmap(one_pl)).lower(
+            Ps, qs, As, lbs, ubs
+        ).compile()
+        out["pallas_compiles"] = True
+        out["pallas_compile_s"] = round(time.perf_counter() - t0, 1)
+        sol_pl = compiled(Ps, qs, As, lbs, ubs)
+        jax.block_until_ready(sol_pl.x)
+        out["pallas_runs"] = True
+        diff = float(jnp.abs(sol_pl.x - sol_scan.x).max())
+        out["x_maxdiff_vs_scan"] = diff
+        out["value"] = 1 if diff < 5e-4 else 0
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:400]
+    print(json.dumps(out), flush=True)
+
+
 def roofline(out_path: str = "artifacts/roofline.json"):
     """FLOPs / HBM-bytes attribution and %-of-peak for the headline step and
     its components, from XLA's own compiled-program cost model
@@ -1123,6 +1198,9 @@ def main():
                          "device; CPU shape-check via JAX_PLATFORMS=cpu + "
                          "xla_force_host_platform_device_count)")
     ap.add_argument("--roofline", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="~30 s Pallas-kernel compile+numerics check on the "
+                         "current device (run FIRST when the chip returns)")
     ap.add_argument("--profile", default=None, metavar="DIR")
     ap.add_argument("--fused", default="auto",
                     choices=["auto", "scan", "pallas", "interpret"],
@@ -1136,13 +1214,18 @@ def main():
                          "A/B switch, see BASELINE.md round 5)")
     args = ap.parse_args()
     _honor_jax_platforms_env()
-    mode_metric = ("bench_sweep" if args.sweep
+    # Same precedence order as the dispatch chain below, so a backend-probe
+    # failure is always labeled with the mode that would have run.
+    mode_metric = ("bench_smoke" if args.smoke
+                   else "bench_sweep" if args.sweep
                    else "bench_components" if args.components
                    else "bench_roofline" if args.roofline
                    else "bench_multichip" if args.multichip
                    else HEADLINE_METRIC)
     platform = ensure_backend_or_die(metric=mode_metric)
-    if args.sweep:
+    if args.smoke:
+        smoke()
+    elif args.sweep:
         sweep(resume=args.resume)
     elif args.multichip:
         multichip()
