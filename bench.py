@@ -990,44 +990,37 @@ def smoke():
         for a in zip(*[make() for _ in range(256)])
     )
 
-    def run(mode):
+    def compile_mode(mode):
+        """Lower+compile (Mosaic runs here) — separated from execution so a
+        post-compile runtime fault (e.g. a Mosaic VMEM error surfacing at
+        block_until_ready) is not misreported as a compile failure."""
         def one(P, q, A, lb, ub):
             return socp.solve_socp(
                 P, q, A, lb, ub, n_box=n_box, soc_dims=soc, iters=60,
                 fused=mode,
             )
         t0 = time.perf_counter()
-        lowered = jax.jit(jax.vmap(one)).lower(Ps, qs, As, lbs, ubs)
-        compiled = lowered.compile()  # Mosaic runs here.
-        t_compile = time.perf_counter() - t0
+        compiled = jax.jit(jax.vmap(one)).lower(Ps, qs, As, lbs, ubs).compile()
+        return compiled, time.perf_counter() - t0
+
+    def execute(compiled):
         sol = compiled(Ps, qs, As, lbs, ubs)
         jax.block_until_ready(sol.x)
-        return sol, t_compile
+        return sol
 
     out = {"metric": "pallas_smoke", "platform": jax.devices()[0].platform}
-    sol_scan, t_scan = run("scan")
+    compiled_scan, t_scan = compile_mode("scan")
+    sol_scan = execute(compiled_scan)
     out["scan_ok"] = bool(np.isfinite(np.asarray(sol_scan.x)).all())
     out["scan_compile_s"] = round(t_scan, 1)
-    # Compile and execution are separated so a post-compile runtime fault
-    # (e.g. a Mosaic VMEM error at block_until_ready) is not misreported as
-    # a compile failure.
     out["pallas_compiles"] = False
     out["pallas_runs"] = False
     out["value"] = 0
     try:
-        def one_pl(P, q, A, lb, ub):
-            return socp.solve_socp(
-                P, q, A, lb, ub, n_box=n_box, soc_dims=soc, iters=60,
-                fused="pallas",
-            )
-        t0 = time.perf_counter()
-        compiled = jax.jit(jax.vmap(one_pl)).lower(
-            Ps, qs, As, lbs, ubs
-        ).compile()
+        compiled_pl, t_pl = compile_mode("pallas")
         out["pallas_compiles"] = True
-        out["pallas_compile_s"] = round(time.perf_counter() - t0, 1)
-        sol_pl = compiled(Ps, qs, As, lbs, ubs)
-        jax.block_until_ready(sol_pl.x)
+        out["pallas_compile_s"] = round(t_pl, 1)
+        sol_pl = execute(compiled_pl)
         out["pallas_runs"] = True
         diff = float(jnp.abs(sol_pl.x - sol_scan.x).max())
         out["x_maxdiff_vs_scan"] = diff
@@ -1218,9 +1211,9 @@ def main():
     # failure is always labeled with the mode that would have run.
     mode_metric = ("bench_smoke" if args.smoke
                    else "bench_sweep" if args.sweep
+                   else "bench_multichip" if args.multichip
                    else "bench_components" if args.components
                    else "bench_roofline" if args.roofline
-                   else "bench_multichip" if args.multichip
                    else HEADLINE_METRIC)
     platform = ensure_backend_or_die(metric=mode_metric)
     if args.smoke:

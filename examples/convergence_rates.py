@@ -20,6 +20,9 @@ import numpy as np
 
 
 def main() -> None:
+    from tpu_aerial_transport.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()  # JAX_PLATFORMS=cpu must win over site hooks.
     p = argparse.ArgumentParser()
     p.add_argument("--samples", type=int, default=100)
     p.add_argument("--iters", type=int, default=25)

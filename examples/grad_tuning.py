@@ -1,0 +1,70 @@
+"""Gradient-based SO(3) gain tuning through the differentiable simulator.
+
+Demonstrates harness/diff.py: the two-rate cascade (1 kHz low-level SO(3)
+attitude control + manifold-integrator physics) is differentiated end-to-end
+with ``jax.grad`` (``jax.checkpoint`` rematerialization on the per-step
+function), and the attitude PD gains are recovered by projected gradient
+descent from a deliberately detuned start. The reference hand-scales these
+gains from the Lee-2010 paper values (control/rqp_centralized.py:487-497);
+here the simulator tunes them against the rollout objective directly.
+
+Usage: python examples/grad_tuning.py [--steps 40] [--iters 25] [--lr 0.05]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> None:
+    from tpu_aerial_transport.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()  # JAX_PLATFORMS=cpu must win over site hooks.
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=3)
+    p.add_argument("--steps", type=int, default=40, help="MPC-rate steps")
+    p.add_argument("--iters", type=int, default=25, help="SGD iterations")
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_aerial_transport.control import centralized
+    from tpu_aerial_transport.harness import diff, setup
+    from tpu_aerial_transport.ops import lie
+
+    params, _, state0 = setup.rqp_setup(args.n)
+    f_eq = centralized.equilibrium_forces(params)
+    # Tilted initial attitudes + a position step: the attitude loop must
+    # actually work, so its gains shape the objective.
+    key = jax.random.PRNGKey(0)
+    axes = 0.3 * jax.random.normal(key, (args.n, 3))
+    state0 = state0.replace(R=jax.vmap(lie.expm_so3)(axes) @ state0.R)
+    xl_ref = state0.xl + jnp.array([0.5, 0.0, 0.3])
+
+    loss = diff.make_rollout_loss(
+        params, f_eq, xl_ref, n_steps=args.steps, k_att=1.0
+    )
+
+    detuned = {"k_R": jnp.asarray(0.02), "k_Omega": jnp.asarray(0.2)}
+    reference = {"k_R": jnp.asarray(0.25), "k_Omega": jnp.asarray(0.075)}
+    print(f"loss @ detuned   (k_R=0.02, k_Omega=0.2):   "
+          f"{float(jax.jit(loss)(detuned, state0)):.5f}")
+    print(f"loss @ reference (k_R=0.25, k_Omega=0.075): "
+          f"{float(jax.jit(loss)(reference, state0)):.5f}")
+
+    gains, hist = diff.tune_gains(
+        loss, detuned, state0, lr=args.lr, iters=args.iters
+    )
+    print(f"tuned gains (best iterate): k_R={float(gains['k_R']):.4f} "
+          f"k_Omega={float(gains['k_Omega']):.4f}")
+    print("loss history:",
+          " ".join(f"{float(v):.5f}" for v in hist[:: max(1, args.iters // 8)]))
+    best = float(jax.jit(loss)(gains, state0))
+    print(f"loss @ tuned gains: {best:.5f} "
+          f"(improvement {float(hist[0]) / best:.2f}x over detuned)")
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,9 @@ def load_log(path: str) -> dict:
 
 
 def main() -> None:
+    from tpu_aerial_transport.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()  # JAX_PLATFORMS=cpu must win over site hooks.
     p = argparse.ArgumentParser()
     p.add_argument("log", help="npz log from rqp_forest.py --out")
     p.add_argument("--controller", default="cadmm",

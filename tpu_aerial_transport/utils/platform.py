@@ -1,0 +1,24 @@
+"""Backend-selection helper for entry-point scripts.
+
+The axon site hook (PYTHONPATH=/root/.axon_site) rewrites ``jax_platforms``
+to ``"axon,cpu"`` at interpreter startup, OVERRIDING the ``JAX_PLATFORMS``
+env var — so when the TPU tunnel is down, a script that honors only the env
+var hangs forever in backend init even under ``JAX_PLATFORMS=cpu``.
+``bench.py`` and the test conftest counter this with a config-level
+override; every example entry point calls :func:`honor_jax_platforms_env`
+for the same guarantee. Must run before first device use (importing jax is
+safe — backend init is lazy)."""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    """Re-apply the ``JAX_PLATFORMS`` env var at the jax.config level in this
+    process, so an explicit platform request always wins over site hooks."""
+    envp = os.environ.get("JAX_PLATFORMS")
+    if envp:
+        import jax
+
+        jax.config.update("jax_platforms", envp)
