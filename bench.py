@@ -153,12 +153,12 @@ def _cpu_explicitly_requested() -> bool:
 
 
 def _honor_jax_platforms_env() -> None:
-    """Re-apply the JAX_PLATFORMS env var at the config level in THIS process,
-    countering the axon site hook's startup rewrite, so an explicit
-    ``JAX_PLATFORMS=cpu python bench.py`` actually measures on CPU."""
-    envp = os.environ.get("JAX_PLATFORMS")
-    if envp:
-        jax.config.update("jax_platforms", envp)
+    """Counter the axon site hook's startup rewrite so an explicit
+    ``JAX_PLATFORMS=cpu python bench.py`` actually measures on CPU
+    (shared implementation: tpu_aerial_transport/utils/platform.py)."""
+    from tpu_aerial_transport.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
 
 
 def _finite_or_none(x: float, digits: int = 2):
@@ -180,21 +180,29 @@ def _setup(n):
     return params, col, state0, forest, f_eq, ll, acc_des
 
 
-def _substeps(params, ll, state, f_des, n_sub=10, dt=1e-3):
-    """1 kHz low-level control + physics, the reference's inner loop."""
+def _substeps(params, ll, state, f_des, n_sub=10, dt=1e-3, unroll=1):
+    """1 kHz low-level control + physics, the reference's inner loop.
+
+    ``unroll``: scan-unroll factor. Results are bit-identical at any value
+    (measured, CPU); unrolling lets XLA fuse elementwise chains ACROSS
+    substeps, attacking the kernel-count bottleneck the roofline identifies
+    (artifacts/roofline.json: headline at ~2% HBM peak because the two-rate
+    cascade serializes many small kernels). CPU A/B is noise (1.03x); the
+    on-chip A/B is the sweep cell headline_substep_unroll10."""
     from tpu_aerial_transport.models import rqp
 
     def body(s, _):
         f, M = ll.control(s, f_des)
         return rqp.integrate(params, s, (f, M), dt), None
 
-    state, _ = jax.lax.scan(body, state, None, length=n_sub)
+    state, _ = jax.lax.scan(body, state, None, length=n_sub, unroll=unroll)
     return state
 
 
 def make_mpc_step(controller: str, n: int, max_iter: int = 20,
                   inner_iters: int | None = None, socp_fused: str = "auto",
-                  force_fixed_iters: bool = False, inner_tol: float = 0.0):
+                  force_fixed_iters: bool = False, inner_tol: float = 0.0,
+                  substep_unroll: int = 1):
     # Default inner ADMM budgets are the measured knees. C-ADMM: 20 — below
     # it the warm-started agent solves miss the 5e-3 primal tolerance and
     # fall back to equilibrium forces (visible as an exactly-zero consensus
@@ -229,7 +237,8 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             f_app, cs, stats = cadmm.control(
                 params, cfg, f_eq, cs, state, acc_des, forest, plan=plan
             )
-            return cs, _substeps(params, ll, state, f_app), stats
+            return cs, _substeps(params, ll, state, f_app,
+                                 unroll=substep_unroll), stats
 
     elif controller == "dd":
         cfg = dd.make_config(
@@ -246,7 +255,8 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             f_des, cs, stats = dd.control(
                 params, cfg, f_eq, cs, state, acc_des, forest, plan=plan
             )
-            return cs, _substeps(params, ll, state, f_des), stats
+            return cs, _substeps(params, ll, state, f_des,
+                                 unroll=substep_unroll), stats
 
     elif controller == "centralized":
         cfg = centralized.make_config(
@@ -264,7 +274,8 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             f_des, cs, stats = centralized.control(
                 params, cfg, f_eq, cs, state, acc_des, env_cbf
             )
-            return cs, _substeps(params, ll, state, f_des), stats
+            return cs, _substeps(params, ll, state, f_des,
+                                 unroll=substep_unroll), stats
 
     else:
         raise ValueError(controller)
@@ -284,9 +295,10 @@ def _scenario_batch(state0, n_scenarios):
 
 
 def build(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
-          socp_fused="auto", buckets=0, inner_tol=0.0):
+          socp_fused="auto", buckets=0, inner_tol=0.0, substep_unroll=1):
     mpc_step, cs0, state0 = make_mpc_step(controller, n, socp_fused=socp_fused,
-                                          inner_tol=inner_tol)
+                                          inner_tol=inner_tol,
+                                          substep_unroll=substep_unroll)
     states = _scenario_batch(state0, n_scenarios)
     css = jax.vmap(lambda _: cs0)(jnp.arange(n_scenarios))
 
@@ -517,10 +529,11 @@ def _single_stream(controller, n, n_steps=50):
 
 
 def _batched(controller, n, n_scenarios, n_steps=10, socp_fused="auto",
-             buckets=0, inner_tol=0.0):
+             buckets=0, inner_tol=0.0, substep_unroll=1):
     step, css, states = build(controller, n, n_scenarios,
                               socp_fused=socp_fused, buckets=buckets,
-                              inner_tol=inner_tol)
+                              inner_tol=inner_tol,
+                              substep_unroll=substep_unroll)
     return measure(step, css, states, jax.devices()[0], n_steps, n_scenarios)
 
 
@@ -729,6 +742,10 @@ def sweep(resume: bool = False):
             # C-ADMM's — congestion bucketing may pay off most here.
             ("dd_n64_batch64_buckets2",
              dict(controller="dd", n=64, n_scenarios=64, buckets=2)),
+            # Substep-scan unrolling (kernel-count lever; see SUBSTEP_UNROLL).
+            ("headline_substep_unroll10",
+             dict(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
+                  substep_unroll=10)),
         ]
         for key, kw in ab_cells:
             # An "error" cell is retried on --resume (unlike a measured one):
@@ -739,7 +756,8 @@ def sweep(resume: bool = False):
                 rate = _batched(kw["controller"], kw["n"], kw["n_scenarios"],
                                 socp_fused=kw.get("socp_fused", "auto"),
                                 buckets=kw.get("buckets", 0),
-                                inner_tol=kw.get("inner_tol", 0.0))
+                                inner_tol=kw.get("inner_tol", 0.0),
+                                substep_unroll=kw.get("substep_unroll", 1))
                 record(key, {"scenario_mpc_steps_per_sec": rate,
                              "agent_mpc_steps_per_sec": rate * kw["n"]})
             except Exception as e:

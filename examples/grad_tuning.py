@@ -47,12 +47,13 @@ def main() -> None:
         params, f_eq, xl_ref, n_steps=args.steps, k_att=1.0
     )
 
+    lj = jax.jit(loss)  # one wrapper, one trace cache for all evaluations.
     detuned = {"k_R": jnp.asarray(0.02), "k_Omega": jnp.asarray(0.2)}
     reference = {"k_R": jnp.asarray(0.25), "k_Omega": jnp.asarray(0.075)}
     print(f"loss @ detuned   (k_R=0.02, k_Omega=0.2):   "
-          f"{float(jax.jit(loss)(detuned, state0)):.5f}")
+          f"{float(lj(detuned, state0)):.5f}")
     print(f"loss @ reference (k_R=0.25, k_Omega=0.075): "
-          f"{float(jax.jit(loss)(reference, state0)):.5f}")
+          f"{float(lj(reference, state0)):.5f}")
 
     gains, hist = diff.tune_gains(
         loss, detuned, state0, lr=args.lr, iters=args.iters
@@ -61,7 +62,7 @@ def main() -> None:
           f"k_Omega={float(gains['k_Omega']):.4f}")
     print("loss history:",
           " ".join(f"{float(v):.5f}" for v in hist[:: max(1, args.iters // 8)]))
-    best = float(jax.jit(loss)(gains, state0))
+    best = float(lj(gains, state0))
     print(f"loss @ tuned gains: {best:.5f} "
           f"(improvement {float(hist[0]) / best:.2f}x over detuned)")
 
