@@ -742,7 +742,8 @@ def sweep(resume: bool = False):
             # C-ADMM's — congestion bucketing may pay off most here.
             ("dd_n64_batch64_buckets2",
              dict(controller="dd", n=64, n_scenarios=64, buckets=2)),
-            # Substep-scan unrolling (kernel-count lever; see SUBSTEP_UNROLL).
+            # Substep-scan unrolling (kernel-count lever; see the _substeps
+            # docstring for the rationale and CPU parity measurement).
             ("headline_substep_unroll10",
              dict(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
                   substep_unroll=10)),

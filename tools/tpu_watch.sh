@@ -1,0 +1,40 @@
+#!/bin/bash
+# TPU-return watcher (round 5): probe the chip every 10 min; on the first
+# ALIVE, run the full measurement sequence ONCE (smoke -> headline -> sweep
+# --resume), logging everything to artifacts/, then exit. The sweep is
+# checkpointed (BENCH_SWEEP_PARTIAL.json), so a tunnel death mid-sweep loses
+# nothing. Single-instance via pidfile.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+LOG="$REPO/artifacts/tpu_watch.log"
+PIDFILE="/tmp/tpu_watch_r5.pid"
+
+if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+    echo "watcher already running (pid $(cat "$PIDFILE"))" >> "$LOG"
+    exit 0
+fi
+echo $$ > "$PIDFILE"
+trap 'rm -f "$PIDFILE"' EXIT
+
+log() { echo "$(date -u '+%F %T UTC')  $*" >> "$LOG"; }
+
+log "watcher started (pid $$)"
+while true; do
+    if python "$REPO/tools/probe_chip.py" >> "$LOG" 2>&1; then
+        log "CHIP ALIVE - starting measurement sequence"
+        log "=== smoke ==="
+        timeout 900 python "$REPO/bench.py" --smoke >> "$LOG" 2>&1
+        log "smoke rc=$?"
+        log "=== headline ==="
+        timeout 1800 python "$REPO/bench.py" > "$REPO/artifacts/headline_r5.json" 2>> "$LOG"
+        log "headline rc=$? (artifacts/headline_r5.json)"
+        log "=== sweep ==="
+        timeout 14400 python "$REPO/bench.py" --sweep --resume >> "$REPO/artifacts/sweep_r5.log" 2>&1
+        log "sweep rc=$? (artifacts/sweep_r5.log; BENCH_SWEEP.json on success)"
+        log "sequence done - exiting"
+        rm -f "$PIDFILE"
+        exit 0
+    fi
+    sleep 600
+done

@@ -37,6 +37,30 @@ from tpu_aerial_transport.models.rqp import RQPParams, RQPState
 from tpu_aerial_transport.ops import lie
 
 
+def substep_rollout(
+    params: RQPParams,
+    gains: dict,
+    state: RQPState,
+    f_des: jnp.ndarray,
+    n_sub: int = 10,
+    dt: float = 1e-3,
+) -> RQPState:
+    """The 1 kHz inner loop under a fixed high-level command: ``n_sub``
+    substeps of SO(3)-PD low-level control (gains from the ``gains`` pytree)
+    + manifold integration. The single differentiable implementation every
+    rollout in this module (and its tests) shares — the recorded and the
+    replayed trajectory must come from the same code path or system
+    identification silently desynchronizes."""
+    ll = so3_tracking.So3PDParams(k_R=gains["k_R"], k_Omega=gains["k_Omega"])
+
+    def sub(s, _):
+        f, M = lowlevel_mod.lowlevel_control(params.J, ll, s, f_des)
+        return rqp.integrate(params, s, (f, M), dt), None
+
+    state, _ = jax.lax.scan(sub, state, None, length=n_sub)
+    return state
+
+
 def payload_pd_forces(
     params: RQPParams,
     f_eq: jnp.ndarray,
@@ -85,16 +109,8 @@ def make_rollout_loss(
     """
 
     def mpc_step(state: RQPState, gains):
-        ll = so3_tracking.So3PDParams(
-            k_R=gains["k_R"], k_Omega=gains["k_Omega"]
-        )
         f_des = payload_pd_forces(params, f_eq, state, xl_ref, k_p, k_d)
-
-        def sub(s, _):
-            f, M = lowlevel_mod.lowlevel_control(params.J, ll, s, f_des)
-            return rqp.integrate(params, s, (f, M), dt), None
-
-        state, _ = jax.lax.scan(sub, state, None, length=n_sub)
+        state = substep_rollout(params, gains, state, f_des, n_sub, dt)
         err = state.xl - xl_ref
         cost = jnp.sum(err * err) + 0.1 * jnp.sum(state.vl * state.vl)
         if k_att:
@@ -117,22 +133,86 @@ def make_rollout_loss(
     return loss
 
 
+def simulate_commands(
+    params: RQPParams,
+    gains: dict,
+    f_des_seq: jnp.ndarray,
+    state0: RQPState,
+    n_sub: int = 10,
+    dt: float = 1e-3,
+    remat: bool = True,
+):
+    """Roll the model under a RECORDED high-level command sequence
+    ``f_des_seq (T, n, 3)`` (the low-level SO(3) loop still closes on the
+    simulated state, as on the real system): returns ``(xl_seq (T, 3),
+    vl_seq (T, 3))`` at the MPC rate. The replay half of system
+    identification — commands logged, states observed."""
+
+    def mpc_step(state: RQPState, f_des):
+        state = substep_rollout(params, gains, state, f_des, n_sub, dt)
+        return state, (state.xl, state.vl)
+
+    step = jax.checkpoint(mpc_step) if remat else mpc_step
+    _, (xl_seq, vl_seq) = jax.lax.scan(step, state0, f_des_seq)
+    return xl_seq, vl_seq
+
+
+def make_sysid_loss(
+    m: jnp.ndarray,
+    J: jnp.ndarray,
+    Jl: jnp.ndarray,
+    r: jnp.ndarray,
+    gains: dict,
+    f_des_seq: jnp.ndarray,
+    xl_obs: jnp.ndarray,
+    vl_obs: jnp.ndarray,
+    n_sub: int = 10,
+    dt: float = 1e-3,
+) -> Callable:
+    """System identification by gradient: ``loss(theta, state0)`` replays the
+    recorded commands through a candidate model with payload mass
+    ``ml = exp(theta["log_ml"])`` (log parameterization keeps the mass
+    positive) and scores the trajectory mismatch against the observations.
+    ``rqp_params`` recomputes every derived quantity (total mass, CoM shift,
+    composite inertia and its inverse) inside the differentiated graph, so
+    the gradient sees the full physical coupling — the reference's numpy
+    parameter struct (RQPParameters, system/rigid_quadrotor_payload.py:48-84)
+    has no analogue of this."""
+
+    def loss(theta, state0: RQPState) -> jnp.ndarray:
+        params = rqp.rqp_params(m, J, jnp.exp(theta["log_ml"]), Jl, r)
+        xl_seq, vl_seq = simulate_commands(
+            params, gains, f_des_seq, state0, n_sub=n_sub, dt=dt
+        )
+        exl = xl_seq - xl_obs
+        evl = vl_seq - vl_obs
+        return jnp.mean(jnp.sum(exl * exl, -1) + 0.1 * jnp.sum(evl * evl, -1))
+
+    return loss
+
+
 def tune_gains(
     loss: Callable,
     gains0: dict,
     state0: RQPState,
     lr: float = 0.05,
     iters: int = 30,
-    min_gain: float = 1e-4,
+    min_gain: float | None = 1e-4,
 ):
-    """Projected gradient descent on the rollout loss (gains must stay
-    positive for the SO(3) law to be stabilizing). Plain SGD on a
-    2-parameter problem — no optimizer state to manage; the entire loop is
-    one jitted program. Returns ``(best_gains, loss_history (iters + 1,))``
-    — the best iterate seen, not the last (a fixed step can overshoot the
-    valley and oscillate; the best-so-far selection makes the result
-    monotone in ``iters``)."""
+    """Projected gradient descent on the rollout loss. ``min_gain`` floors
+    every parameter after each step (gains must stay positive for the SO(3)
+    law to be stabilizing); pass ``None`` for unconstrained parameters —
+    e.g. LOG-parameterized quantities like ``make_sysid_loss``'s
+    ``log_ml``, which are legitimately negative and must not be floored.
+    Plain SGD on a tiny problem — no optimizer state to manage; the entire
+    loop is one jitted program. Returns ``(best_gains, loss_history
+    (iters + 1,))`` — the best iterate seen, not the last (a fixed step can
+    overshoot the valley and oscillate; the best-so-far selection makes the
+    result monotone in ``iters``)."""
     vg = jax.value_and_grad(loss)
+
+    def project(g):
+        return g if min_gain is None else jnp.maximum(g, min_gain)
 
     def body(carry, _):
         gains, best_gains, best_val = carry
@@ -143,7 +223,7 @@ def tune_gains(
         )
         best_val = jnp.minimum(best_val, val)
         gains = jax.tree.map(
-            lambda g, d: jnp.maximum(g - lr * d, min_gain), gains, grad
+            lambda g, d: project(g - lr * d), gains, grad
         )
         return (gains, best_gains, best_val), val
 
