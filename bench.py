@@ -657,58 +657,10 @@ def sweep(resume: bool = False):
         _write_json_atomic(SWEEP_PARTIAL_PATH, results)
         print(f"# {key}: {value}", flush=True)
 
-    # MPC steps/sec/chip at N in {4, 16, 64} for all three controllers.
-    for ctrl in ("centralized", "cadmm", "dd"):
-        for n in (4, 16, 64):
-            key = f"{ctrl}_n{n}_single"
-            if key in results:
-                continue
-            record(key, _single_stream(ctrl, n))
-    # Measured per-consensus-iteration latency (differenced fixed-iteration
-    # runs; see _measured_iter_ms — VERDICT r3 item 7).
-    for ctrl in ("cadmm", "dd"):
-        for n in (4, 16, 64):
-            key = f"{ctrl}_n{n}_iter_latency"
-            if key in results:
-                continue
-            record(key, _measured_iter_ms(ctrl, n))
-    # Batched throughput (the TPU's actual operating point) at the same Ns.
-    for ctrl in ("cadmm", "dd"):
-        for n, ns in ((4, 256), (16, 128), (64, 64)):
-            key = f"{ctrl}_n{n}_batch{ns}"
-            if key in results:
-                continue
-            rate = _batched(ctrl, n, ns)
-            record(key, {"scenario_mpc_steps_per_sec": rate,
-                         "agent_mpc_steps_per_sec": rate * n})
-    # Swarm (BASELINE.json config 5): 128 payloads x 8 quads = 1024 agents.
-    if "swarm_128x8" not in results:
-        rate = _batched("cadmm", 8, 128)
-        record("swarm_128x8", {"scenario_mpc_steps_per_sec": rate,
-                               "agent_mpc_steps_per_sec": rate * 8})
-    # North-star ratio (BASELINE.json): TPU throughput vs the reference-
-    # architecture CPU baseline at 64 agents.
-    for n, ns in ((8, 256), (64, 64)):
-        ns_key = f"north_star_n{n}"
-        if ns_key in results:
-            continue
-        try:
-            ref = ref_arch_cpu_rate(n=n, n_steps=3)
-        except Exception as e:  # native solver unavailable/failed: keep the
-            print(f"# ref_arch_cpu_rate(n={n}) failed: {e}", flush=True)
-            ref = None  # TPU measurements already collected above.
-        if ref:
-            key = f"cadmm_n{n}_batch{ns}"
-            if key in results:
-                tpu = results[key]["scenario_mpc_steps_per_sec"]
-            else:
-                tpu = _batched("cadmm", n, ns)
-            record(ns_key, {
-                "tpu_scenario_mpc_steps_per_sec": tpu,
-                "ref_arch_cpu_mpc_steps_per_sec": ref,
-                "ratio": tpu / ref,
-            })
-
+    # The round-5 A/B cells run FIRST: if the tunnel dies mid-sweep,
+    # the checkpoint must already hold the cells that decide this
+    # round's default flips (fused/buckets/inner_tol/unroll), not
+    # just the long-standing matrix.
     # A/B cells for the round-4 switches (VERDICT r4 item 6): headline
     # config x {scan, pallas} x {0, 2 buckets}, plus the n=64 fused A/B.
     # TPU-only — the Pallas kernel has no CPU lowering worth timing and the
@@ -765,6 +717,58 @@ def sweep(resume: bool = False):
                 # Keep going: a Pallas lowering failure IS a result for its
                 # cell and must not kill the scan/bucket cells after it.
                 record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    # MPC steps/sec/chip at N in {4, 16, 64} for all three controllers.
+    for ctrl in ("centralized", "cadmm", "dd"):
+        for n in (4, 16, 64):
+            key = f"{ctrl}_n{n}_single"
+            if key in results:
+                continue
+            record(key, _single_stream(ctrl, n))
+    # Measured per-consensus-iteration latency (differenced fixed-iteration
+    # runs; see _measured_iter_ms — VERDICT r3 item 7).
+    for ctrl in ("cadmm", "dd"):
+        for n in (4, 16, 64):
+            key = f"{ctrl}_n{n}_iter_latency"
+            if key in results:
+                continue
+            record(key, _measured_iter_ms(ctrl, n))
+    # Batched throughput (the TPU's actual operating point) at the same Ns.
+    for ctrl in ("cadmm", "dd"):
+        for n, ns in ((4, 256), (16, 128), (64, 64)):
+            key = f"{ctrl}_n{n}_batch{ns}"
+            if key in results:
+                continue
+            rate = _batched(ctrl, n, ns)
+            record(key, {"scenario_mpc_steps_per_sec": rate,
+                         "agent_mpc_steps_per_sec": rate * n})
+    # Swarm (BASELINE.json config 5): 128 payloads x 8 quads = 1024 agents.
+    if "swarm_128x8" not in results:
+        rate = _batched("cadmm", 8, 128)
+        record("swarm_128x8", {"scenario_mpc_steps_per_sec": rate,
+                               "agent_mpc_steps_per_sec": rate * 8})
+    # North-star ratio (BASELINE.json): TPU throughput vs the reference-
+    # architecture CPU baseline at 64 agents.
+    for n, ns in ((8, 256), (64, 64)):
+        ns_key = f"north_star_n{n}"
+        if ns_key in results:
+            continue
+        try:
+            ref = ref_arch_cpu_rate(n=n, n_steps=3)
+        except Exception as e:  # native solver unavailable/failed: keep the
+            print(f"# ref_arch_cpu_rate(n={n}) failed: {e}", flush=True)
+            ref = None  # TPU measurements already collected above.
+        if ref:
+            key = f"cadmm_n{n}_batch{ns}"
+            if key in results:
+                tpu = results[key]["scenario_mpc_steps_per_sec"]
+            else:
+                tpu = _batched("cadmm", n, ns)
+            record(ns_key, {
+                "tpu_scenario_mpc_steps_per_sec": tpu,
+                "ref_arch_cpu_mpc_steps_per_sec": ref,
+                "ratio": tpu / ref,
+            })
 
     _write_json_atomic("BENCH_SWEEP.json", results)
     if os.path.exists(SWEEP_PARTIAL_PATH):

@@ -28,13 +28,20 @@ while true; do
         log "smoke rc=$?"
         log "=== headline ==="
         timeout 1800 python "$REPO/bench.py" > "$REPO/artifacts/headline_r5.json" 2>> "$LOG"
-        log "headline rc=$? (artifacts/headline_r5.json)"
+        hl_rc=$?
+        log "headline rc=$hl_rc (artifacts/headline_r5.json)"
         log "=== sweep ==="
         timeout 14400 python "$REPO/bench.py" --sweep --resume >> "$REPO/artifacts/sweep_r5.log" 2>&1
-        log "sweep rc=$? (artifacts/sweep_r5.log; BENCH_SWEEP.json on success)"
-        log "sequence done - exiting"
-        rm -f "$PIDFILE"
-        exit 0
+        sw_rc=$?
+        log "sweep rc=$sw_rc (artifacts/sweep_r5.log; BENCH_SWEEP.json on success)"
+        # Only stand down once BOTH deliverables are in hand; a chip that
+        # re-wedged mid-sequence must re-arm the watcher, not end it — the
+        # sweep checkpoint makes the retry cheap.
+        if [ "$hl_rc" -eq 0 ] && [ "$sw_rc" -eq 0 ]; then
+            log "sequence complete - exiting"
+            exit 0
+        fi
+        log "sequence incomplete (headline=$hl_rc sweep=$sw_rc) - re-arming"
     fi
     sleep 600
 done
