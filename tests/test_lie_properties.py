@@ -1,0 +1,205 @@
+"""Property-based tests for the SO(3) math core (ops/lie.py) via hypothesis.
+
+The reference checks these identities at a handful of random samples with
+printed average errors a human reads (test/utils/test_mathutils.py,
+SURVEY.md §4); here each algebraic identity is asserted over a searched
+input space, including the adversarial corners hypothesis shrinks toward
+(near-zero axes, near-pi rotations, antipodal pairs, ill-conditioned
+near-rotations)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from tpu_aerial_transport.ops import lie
+
+# Moderate example counts: every example pays a jitted-call dispatch; the
+# functions under test are deterministic algebra, so width beats depth.
+COMMON = dict(max_examples=60, deadline=None)
+
+finite3 = st.lists(
+    st.floats(-10.0, 10.0, allow_nan=False), min_size=3, max_size=3
+).map(lambda v: np.asarray(v, np.float32))
+
+unit3 = finite3.filter(lambda v: np.linalg.norm(v) > 1e-3).map(
+    lambda v: (v / np.linalg.norm(v)).astype(np.float32)
+)
+
+
+def _is_rotation(R, atol=1e-5):
+    R = np.asarray(R, np.float64)
+    return (
+        np.allclose(R @ R.T, np.eye(3), atol=atol)
+        and abs(np.linalg.det(R) - 1.0) < atol
+    )
+
+
+@given(w=finite3)
+@settings(**COMMON)
+def test_expm_in_so3(w):
+    R = np.asarray(lie.expm_so3(jnp.asarray(w)))
+    assert _is_rotation(R)
+
+
+@given(w=finite3)
+@settings(**COMMON)
+def test_expm_inverse_is_transpose(w):
+    Rp = np.asarray(lie.expm_so3(jnp.asarray(w)))
+    Rm = np.asarray(lie.expm_so3(jnp.asarray(-w)))
+    np.testing.assert_allclose(Rm, Rp.T, atol=1e-5)
+
+
+@given(w=finite3.filter(lambda v: 1e-4 < np.linalg.norm(v) < np.pi - 1e-2))
+@settings(**COMMON)
+def test_log_expm_roundtrip(w):
+    """log(exp(w)) = w on the injectivity ball |w| < pi."""
+    back = np.asarray(lie.log_so3(lie.expm_so3(jnp.asarray(w))))
+    np.testing.assert_allclose(back, w, rtol=2e-3, atol=2e-5)
+
+
+@given(a=finite3, b=finite3)
+@settings(**COMMON)
+def test_hat_is_cross_product(a, b):
+    np.testing.assert_allclose(
+        np.asarray(lie.hat(jnp.asarray(a)) @ b), np.cross(a, b),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+@given(v=finite3)
+@settings(**COMMON)
+def test_vee_hat_roundtrip(v):
+    np.testing.assert_allclose(
+        np.asarray(lie.vee(lie.hat(jnp.asarray(v)))), v, atol=0
+    )
+
+
+@given(w=finite3, noise=st.floats(0.0, 0.3))
+@settings(**COMMON)
+def test_polar_project_recovers_rotation(w, noise):
+    """Newton-Schulz polar projection: maps a noise-perturbed rotation back
+    to SO(3), and is (near-)identity on exact rotations."""
+    R = np.asarray(lie.expm_so3(jnp.asarray(w)), np.float32)
+    rng = np.random.default_rng(0)
+    M = R + noise * 0.1 * rng.standard_normal((3, 3)).astype(np.float32)
+    P = np.asarray(lie.polar_project(jnp.asarray(M)))
+    assert _is_rotation(P, atol=5e-4)
+    if noise == 0.0:
+        np.testing.assert_allclose(P, R, atol=1e-5)
+
+
+@given(a=unit3, b=unit3)
+@settings(**COMMON)
+def test_rotation_a_to_b_maps_a_to_b(a, b):
+    R = np.asarray(lie.rotation_a_to_b(jnp.asarray(a), jnp.asarray(b)))
+    assert _is_rotation(R, atol=2e-4)
+    np.testing.assert_allclose(R @ a, b, atol=5e-3)
+
+
+@given(a=unit3)
+@settings(**COMMON)
+def test_rotation_a_to_b_antipodal(a):
+    """The b = -a corner has no unique minimal rotation; the construction
+    must still return a proper rotation with R a = -a (reference
+    test_mathutils.py:30-39 checks exactly this edge)."""
+    R = np.asarray(lie.rotation_a_to_b(jnp.asarray(a), jnp.asarray(-a)))
+    assert _is_rotation(R, atol=2e-4)
+    np.testing.assert_allclose(R @ a, -a, atol=5e-3)
+
+
+@given(q=unit3.filter(lambda v: np.hypot(v[0], v[2]) > 1e-2))
+@settings(**COMMON)
+def test_rotation_from_z_alignment(q):
+    """rotation_from_z(q): proper rotation whose third column is q (body z
+    aligned with the commanded direction, reference rotation_matrix_from_z_
+    vector). Domain excludes q = +-e2, the zero-yaw (ZYX) construction's
+    gimbal singularity (hypothesis found it immediately) — unreachable in
+    use: the low-level controller feeds thrust directions with q_z > 0
+    (min_fz box constraint)."""
+    R = np.asarray(lie.rotation_from_z(jnp.asarray(q)))
+    assert _is_rotation(R, atol=2e-4)
+    np.testing.assert_allclose(R[:, 2], q, atol=5e-3)
+
+
+@given(theta=st.floats(0.05, np.pi / 2 - 0.05), seed=st.integers(0, 2**31))
+@settings(**COMMON)
+def test_random_cone_vector_membership(theta, seed):
+    """Samples lie inside the half-angle-theta cone about +z and are unit
+    (reference test_mathutils.py cone membership, N=10000 -> searched)."""
+    v = np.asarray(
+        lie.random_cone_vector(jax.random.PRNGKey(seed), theta, shape=(32,))
+    )
+    norms = np.linalg.norm(v, axis=-1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+    assert np.all(v[..., 2] >= np.cos(theta) - 1e-5)
+
+
+# ---- Dynamics-level properties (searched amplitudes, all three models) ----
+
+amp = st.floats(0.01, 5.0)
+
+
+@given(seed=st.integers(0, 2**31), w_amp=amp, f_amp=st.floats(0.1, 50.0))
+@settings(max_examples=25, deadline=None)
+def test_rqp_residual_zero_under_searched_amplitudes(seed, w_amp, f_amp):
+    """forward_dynamics must zero the Newton-Euler residual at ANY state and
+    input amplitude, not just the unit-scale seeds of test_rqp_model.py —
+    hypothesis drives angular rates and thrusts orders of magnitude apart
+    to expose conditioning-sensitive terms. Tolerance scales with the
+    forcing (f32 residual is ~eps * ||terms||)."""
+    from tpu_aerial_transport.models import rqp
+
+    n = 4
+    key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 8)
+    params = rqp.rqp_params(
+        m=0.5 + jax.random.uniform(ks[0], (n,)),
+        J=jnp.tile(jnp.eye(3) * 0.01, (n, 1, 1)),
+        ml=1.0 + jax.random.uniform(ks[1], ()),
+        Jl=jnp.eye(3) * (0.1 + 0.1 * jax.random.uniform(ks[2], ())),
+        r=jax.random.normal(ks[3], (n, 3)),
+    )
+    state = rqp.rqp_state(
+        R=jax.vmap(lie.expm_so3)(jax.random.normal(ks[4], (n, 3))),
+        w=w_amp * jax.random.normal(ks[5], (n, 3)),
+        xl=jnp.zeros(3),
+        vl=jnp.zeros(3),
+        Rl=lie.expm_so3(jax.random.normal(ks[6], (3,))),
+        wl=w_amp * jax.random.normal(ks[7], (3,)),
+    )
+    f = f_amp * (1.0 + jax.random.uniform(ks[0], (n,)))
+    M = 0.1 * f_amp * jax.random.normal(ks[1], (n, 3))
+    acc = rqp.forward_dynamics(params, state, (f, M))
+    err = float(rqp.inverse_dynamics_error(state, params, (f, M), acc))
+    scale = max(1.0, f_amp * (1.0 + w_amp))
+    assert err < 1e-4 * scale, (err, w_amp, f_amp)
+
+
+@given(seed=st.integers(0, 2**31), w_amp=st.floats(0.1, 30.0),
+       dt=st.floats(1e-4, 5e-3))
+@settings(max_examples=25, deadline=None)
+def test_rqp_integrator_stays_on_manifold(seed, w_amp, dt):
+    """20 integrator steps at searched (extreme) angular rates and step
+    sizes: every rotation stays orthonormal to f32 roundoff — the manifold
+    integrator's whole point (SURVEY §2.2 orthonormality test)."""
+    from tpu_aerial_transport.harness import setup
+    from tpu_aerial_transport.models import rqp
+
+    params, _, state = setup.rqp_setup(3)
+    key = jax.random.PRNGKey(seed)
+    state = state.replace(
+        w=w_amp * jax.random.normal(key, (3, 3)),
+        wl=w_amp * jax.random.normal(jax.random.fold_in(key, 1), (3,)),
+    )
+    f = params.mT * 9.81 / 3 * jnp.ones((3,))
+    M = jnp.zeros((3, 3))
+
+    def body(s, _):
+        return rqp.integrate(params, s, (f, M), dt), None
+
+    state, _ = jax.lax.scan(body, state, None, length=20)
+    for R in list(np.asarray(state.R)) + [np.asarray(state.Rl)]:
+        err = np.abs(R.astype(np.float64) @ R.T - np.eye(3)).max()
+        assert err < 5e-5, (err, w_amp, dt)

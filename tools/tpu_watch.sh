@@ -20,16 +20,30 @@ trap 'rm -f "$PIDFILE"' EXIT
 log() { echo "$(date -u '+%F %T UTC')  $*" >> "$LOG"; }
 
 log "watcher started (pid $$)"
+HL_DONE=0
+TRIES=0
+MAX_TRIES=5
 while true; do
     if python "$REPO/tools/probe_chip.py" >> "$LOG" 2>&1; then
-        log "CHIP ALIVE - starting measurement sequence"
+        TRIES=$((TRIES + 1))
+        log "CHIP ALIVE - starting measurement sequence (attempt $TRIES/$MAX_TRIES)"
         log "=== smoke ==="
         timeout 900 python "$REPO/bench.py" --smoke >> "$LOG" 2>&1
         log "smoke rc=$?"
-        log "=== headline ==="
-        timeout 1800 python "$REPO/bench.py" > "$REPO/artifacts/headline_r5.json" 2>> "$LOG"
-        hl_rc=$?
-        log "headline rc=$hl_rc (artifacts/headline_r5.json)"
+        if [ "$HL_DONE" -eq 0 ]; then
+            log "=== headline ==="
+            # Temp file + mv on success: a retry that wedges must not
+            # truncate an already-captured headline deliverable.
+            timeout 1800 python "$REPO/bench.py" > "$REPO/artifacts/headline_r5.json.tmp" 2>> "$LOG"
+            hl_rc=$?
+            if [ "$hl_rc" -eq 0 ]; then
+                mv "$REPO/artifacts/headline_r5.json.tmp" "$REPO/artifacts/headline_r5.json"
+                HL_DONE=1
+            fi
+            log "headline rc=$hl_rc (artifacts/headline_r5.json)"
+        else
+            log "headline already captured - skipping"
+        fi
         log "=== sweep ==="
         timeout 14400 python "$REPO/bench.py" --sweep --resume >> "$REPO/artifacts/sweep_r5.log" 2>&1
         sw_rc=$?
@@ -37,11 +51,22 @@ while true; do
         # Only stand down once BOTH deliverables are in hand; a chip that
         # re-wedged mid-sequence must re-arm the watcher, not end it — the
         # sweep checkpoint makes the retry cheap.
-        if [ "$hl_rc" -eq 0 ] && [ "$sw_rc" -eq 0 ]; then
+        if [ "$HL_DONE" -eq 1 ] && [ "$sw_rc" -eq 0 ]; then
             log "sequence complete - exiting"
             exit 0
         fi
-        log "sequence incomplete (headline=$hl_rc sweep=$sw_rc) - re-arming"
+        # Deterministic failures (e.g. the sweep's refusing-resume guard on
+        # a dirty git tree) would loop forever with the chip alive — detect
+        # the refusal and cap total attempts, loudly.
+        if tail -5 "$REPO/artifacts/sweep_r5.log" | grep -q "refusing --resume"; then
+            log "FATAL: sweep refuses --resume (git head mismatch/dirty tree) - operator action needed, exiting"
+            exit 2
+        fi
+        if [ "$TRIES" -ge "$MAX_TRIES" ]; then
+            log "FATAL: $MAX_TRIES alive-attempts without a complete sequence - exiting"
+            exit 3
+        fi
+        log "sequence incomplete (HL_DONE=$HL_DONE sweep=$sw_rc) - re-arming"
     fi
     sleep 600
 done
