@@ -248,12 +248,13 @@ def render_frames(
     follow: bool = True,
     force_arrows: bool = False,
 ):
-    """Replay a rollout log as PNG frames (the reference's meshcat replay with
-    follow camera, rqp_plots.py:44-109; camera smoothing via a simple windowed
-    mean instead of savgol). ``force_arrows`` overlays the logged commanded
-    forces per agent (the reference's ``_DRAW_FORCE_ARROWS`` option; needs
-    ``f_des_seq`` in the log — state-only log rates fall back to no arrows).
-    Returns the frame paths."""
+    """Replay a rollout log as PNG frames (the reference's meshcat replay
+    with follow camera, rqp_plots.py:44-109; camera smoothing via
+    :func:`smooth_camera_track` — the reference's savgol when scipy is
+    present, windowed mean otherwise). ``force_arrows`` overlays the logged
+    commanded forces per agent (the reference's ``_DRAW_FORCE_ARROWS``
+    option; needs ``f_des_seq`` in the log — state-only log rates fall back
+    to no arrows). Returns the frame paths."""
     plt = _mpl()
     os.makedirs(out_dir, exist_ok=True)
     xl_seq = np.asarray(logs["state_seq"]["xl"])
@@ -263,12 +264,8 @@ def render_frames(
     if force_arrows and "f_des_seq" in logs:
         f_seq = np.asarray(logs["f_des_seq"])
 
-    # Smoothed follow-camera track.
-    k = 25
-    pad = np.pad(xl_seq, ((k, k), (0, 0)), mode="edge")
-    smooth = np.stack([
-        pad[i : i + 2 * k + 1].mean(axis=0) for i in range(len(xl_seq))
-    ])
+    # Smoothed follow-camera track (reference savgol, rqp_plots.py:78).
+    smooth = smooth_camera_track(xl_seq)
 
     class _S:
         pass
@@ -329,6 +326,29 @@ def render_ghost_snapshot(
 
 
 _Z_UP = np.array([[1, 0, 0], [0, 0, -1], [0, 1, 0]], float).T  # y-up -> z-up.
+
+
+def smooth_camera_track(xl_seq: np.ndarray, window: int = 51,
+                        polyorder: int = 3) -> np.ndarray:
+    """Smoothed follow-camera track over a payload trajectory — the
+    reference's ``savgol_filter(xl, window, 3)`` (rqp_plots.py:78) when
+    scipy is importable, else a centered windowed mean (same intent:
+    low-pass the camera so it doesn't shake with the payload)."""
+    xl_seq = np.asarray(xl_seq)
+    window = min(window, len(xl_seq) - (len(xl_seq) + 1) % 2)  # odd, <= T.
+    if window < 5:
+        return xl_seq.copy()
+    try:
+        from scipy.signal import savgol_filter
+
+        return savgol_filter(xl_seq, window, min(polyorder, window - 1),
+                             axis=0)
+    except ImportError:
+        k = window // 2
+        pad = np.pad(xl_seq, ((k, k), (0, 0)), mode="edge")
+        return np.stack([
+            pad[i: i + 2 * k + 1].mean(axis=0) for i in range(len(xl_seq))
+        ])
 
 
 def _rotation_y_to(d: np.ndarray) -> np.ndarray:
@@ -518,11 +538,7 @@ class MeshcatBackend:
                  if force_arrows and "f_des_seq" in logs else None)
         dt_frame = logs["dt"] * logs["hl_rel_freq"] / speedup
         stride = max(1, int(round(1.0 / (min_fps * dt_frame))))
-        k = 25  # camera smoothing window (savgol stand-in).
-        pad = np.pad(xl_seq, ((k, k), (0, 0)), mode="edge")
-        smooth = np.stack([
-            pad[i: i + 2 * k + 1].mean(axis=0) for i in range(len(xl_seq))
-        ])
+        smooth = smooth_camera_track(xl_seq)
 
         class _S:
             pass
