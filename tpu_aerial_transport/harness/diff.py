@@ -191,6 +191,68 @@ def make_sysid_loss(
     return loss
 
 
+def make_trajopt_loss(
+    params: RQPParams,
+    f_eq: jnp.ndarray,
+    goal: jnp.ndarray,
+    n_steps: int = 40,
+    n_sub: int = 10,
+    dt: float = 1e-3,
+    gains: dict | None = None,
+    obstacle_xy: jnp.ndarray | None = None,
+    obstacle_radius: float = 0.5,
+    w_effort: float = 1e-3,
+    w_obstacle: float = 30.0,
+) -> Callable:
+    """Trajectory optimization through the physics: ``loss(plan, state0)``
+    rolls the full two-rate cascade under a per-step payload-acceleration
+    schedule ``plan["acc"] (n_steps, 3)`` (shared equally by the agents on
+    top of the equilibrium forces) and scores terminal goal distance +
+    control effort + a soft obstacle-clearance penalty (squared hinge on an
+    xy-cylinder of radius ``obstacle_radius``). Descending it with
+    :func:`tune_gains` (``min_gain=None``) is direct single-shooting optimal
+    control — the third capability the pure-pytree models buy that the
+    reference's numpy stack cannot express (gain tuning and system
+    identification being the other two)."""
+    gains = gains or {"k_R": jnp.asarray(0.25), "k_Omega": jnp.asarray(0.075)}
+
+    def mpc_step(state: RQPState, acc):
+        state = substep_rollout(
+            params, gains, state,
+            plan_share_forces(params, f_eq, acc), n_sub, dt,
+        )
+        cost = w_effort * jnp.sum(acc * acc)
+        if obstacle_xy is not None:
+            d = jnp.linalg.norm(state.xl[:2] - obstacle_xy)
+            cost = cost + w_obstacle * jnp.maximum(
+                obstacle_radius - d, 0.0
+            ) ** 2
+        return state, cost
+
+    step = jax.checkpoint(mpc_step)
+
+    def loss(plan, state0: RQPState) -> jnp.ndarray:
+        if plan["acc"].shape[0] != n_steps:
+            raise ValueError(
+                f"plan horizon {plan['acc'].shape[0]} != n_steps {n_steps}"
+            )
+        state, costs = jax.lax.scan(step, state0, plan["acc"])
+        err = state.xl - goal
+        vel = state.vl
+        return (jnp.sum(err * err) + 0.1 * jnp.sum(vel * vel)
+                + jnp.sum(costs))
+
+    return loss
+
+
+def plan_share_forces(params: RQPParams, f_eq: jnp.ndarray,
+                      acc: jnp.ndarray) -> jnp.ndarray:
+    """The trajopt plan's force law — equilibrium shares plus an equal-share
+    payload-acceleration demand. Exposed so replays (tests, analysis) roll
+    the exact system the plan was optimized for."""
+    return f_eq + (params.mT / params.n) * acc[None, :]
+
+
 def tune_gains(
     loss: Callable,
     gains0: dict,
@@ -198,39 +260,62 @@ def tune_gains(
     lr: float = 0.05,
     iters: int = 30,
     min_gain: float | None = 1e-4,
+    optimizer: str = "sgd",
 ):
     """Projected gradient descent on the rollout loss. ``min_gain`` floors
     every parameter after each step (gains must stay positive for the SO(3)
     law to be stabilizing); pass ``None`` for unconstrained parameters —
     e.g. LOG-parameterized quantities like ``make_sysid_loss``'s
     ``log_ml``, which are legitimately negative and must not be floored.
-    Plain SGD on a tiny problem — no optimizer state to manage; the entire
-    loop is one jitted program. Returns ``(best_gains, loss_history
-    (iters + 1,))`` — the best iterate seen, not the last (a fixed step can
-    overshoot the valley and oscillate; the best-so-far selection makes the
-    result monotone in ``iters``)."""
+
+    ``optimizer``: ``"sgd"`` (default — 1-2-parameter tuning problems) or
+    ``"adam"`` (optax; needed when the parameter spectrum is badly
+    conditioned, e.g. :func:`make_trajopt_loss`'s per-step plan where
+    terminal-error and effort modes differ by ~1e5 in curvature and any
+    single SGD step size either diverges or crawls).
+
+    The entire loop is one jitted program. Returns ``(best_gains,
+    loss_history (iters + 1,))`` — the best iterate seen, not the last (a
+    fixed step can overshoot the valley and oscillate; the best-so-far
+    selection makes the result monotone in ``iters``)."""
     vg = jax.value_and_grad(loss)
+    if optimizer == "sgd":
+        # Hand-rolled: the default path stays free of the optax dependency.
+        opt = None
+    elif optimizer == "adam":
+        import optax
+
+        opt = optax.adam(lr)
+    else:
+        raise ValueError(optimizer)
 
     def project(g):
         return g if min_gain is None else jnp.maximum(g, min_gain)
 
     def body(carry, _):
-        gains, best_gains, best_val = carry
+        gains, opt_state, best_gains, best_val = carry
         val, grad = vg(gains, state0)
         better = val < best_val
         best_gains = jax.tree.map(
             lambda b, g: jnp.where(better, g, b), best_gains, gains
         )
         best_val = jnp.minimum(best_val, val)
-        gains = jax.tree.map(
-            lambda g, d: project(g - lr * d), gains, grad
-        )
-        return (gains, best_gains, best_val), val
+        if opt is None:  # plain SGD.
+            gains = jax.tree.map(
+                lambda g, d: project(g - lr * d), gains, grad
+            )
+        else:
+            updates, opt_state = opt.update(grad, opt_state, gains)
+            gains = jax.tree.map(
+                lambda g, u: project(g + u), gains, updates
+            )
+        return (gains, opt_state, best_gains, best_val), val
 
     @jax.jit
     def run(gains0):
-        init = (gains0, gains0, jnp.asarray(jnp.inf))
-        (gains, best_gains, best_val), hist = jax.lax.scan(
+        opt_state0 = () if opt is None else opt.init(gains0)
+        init = (gains0, opt_state0, gains0, jnp.asarray(jnp.inf))
+        (gains, _, best_gains, best_val), hist = jax.lax.scan(
             body, init, None, length=iters
         )
         final_val = loss(gains, state0)
