@@ -1,0 +1,96 @@
+"""RP consensus-ADMM controller (control/rp_cadmm.py) — BEYOND-REFERENCE
+(the reference's RP controller is centralized-only): the distributed
+machinery generalizes across model families with the same guarantees the
+RQP tests assert — centralized agreement, convergence, warm-start reuse,
+batched == solo."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_aerial_transport.control import rp_cadmm, rp_centralized
+from tpu_aerial_transport.harness import setup
+
+
+def _setup():
+    params, col, state0 = setup.rp_setup(3)
+    f_eq = rp_centralized.equilibrium_forces(params)
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.array([0.0, 0.0, 0.05]))
+    state = state0.replace(
+        vl=jnp.array([0.2, 0.1, 0.0]), wl=jnp.array([0.05, 0.0, 0.0])
+    )
+    return params, f_eq, acc_des, state
+
+
+def test_agrees_with_centralized():
+    """Both decompositions solve the same convex problem: the consensus
+    solution must match the centralized one within the consensus
+    tolerance."""
+    params, f_eq, acc_des, state = _setup()
+    ccfg = rp_centralized.make_config(params)
+    cs0 = rp_centralized.init_ctrl_state(params, ccfg)
+    f_c, _, _ = jax.jit(
+        lambda c, s: rp_centralized.control(params, ccfg, f_eq, c, s, acc_des)
+    )(cs0, state)
+
+    dcfg = rp_cadmm.make_config(params, max_iter=60, inner_iters=40,
+                                res_tol=1e-3)
+    ds0 = rp_cadmm.init_state(params, dcfg, f_eq)
+    f_d, ds, st = jax.jit(
+        lambda c, s: rp_cadmm.control(params, dcfg, f_eq, c, s, acc_des)
+    )(ds0, state)
+    assert float(st.solve_res) < dcfg.res_tol
+    assert float(st.ok_frac) == 1.0  # no equilibrium fallbacks.
+    assert float(jnp.abs(f_d - f_c).max()) < 5e-3
+
+    # Warm restart at the same state: consensus must close in ~1 iteration.
+    _, _, st2 = jax.jit(
+        lambda c, s: rp_cadmm.control(params, dcfg, f_eq, c, s, acc_des)
+    )(ds, state)
+    assert int(st2.iters) <= 2, int(st2.iters)
+
+
+def test_respects_actuation_limits():
+    """Every agent's own force satisfies its min-thrust and cone/norm-cap
+    constraints (the rows kept in its local QP)."""
+    params, f_eq, acc_des, state = _setup()
+    cfg = rp_cadmm.make_config(params, max_iter=60, inner_iters=40,
+                               res_tol=1e-3)
+    ds0 = rp_cadmm.init_state(params, cfg, f_eq)
+    f, _, _ = jax.jit(
+        lambda c, s: rp_cadmm.control(params, cfg, f_eq, c, s, acc_des)
+    )(ds0, state)
+    f = np.asarray(f)
+    base = cfg.base
+    tol = 1e-3
+    assert np.all(f[:, 2] >= base.min_fz - tol)
+    norms = np.linalg.norm(f, axis=-1)
+    assert np.all(norms <= base.sec_max_f_ang * f[:, 2] + tol)
+    assert np.all(norms <= base.max_f + tol)
+
+
+def test_batched_matches_solo():
+    """vmapped scenarios reproduce per-scenario solo runs (while_loop
+    batching keeps converged lanes frozen — the same contract the RQP
+    controllers assert)."""
+    params, f_eq, acc_des, state = _setup()
+    cfg = rp_cadmm.make_config(params, max_iter=30, inner_iters=30,
+                               res_tol=1e-3)
+    ds0 = rp_cadmm.init_state(params, cfg, f_eq)
+    vls = jnp.stack([
+        jnp.array([0.2, 0.1, 0.0]), jnp.array([-0.1, 0.0, 0.2]),
+        jnp.array([0.0, -0.2, 0.1]),
+    ])
+    states = jax.vmap(lambda v: state.replace(vl=v))(vls)
+    dss = jax.vmap(lambda _: ds0)(vls)
+
+    f_b, _, st_b = jax.jit(jax.vmap(
+        lambda c, s: rp_cadmm.control(params, cfg, f_eq, c, s, acc_des)
+    ))(dss, states)
+    for k in range(3):
+        f_s, _, st_s = jax.jit(
+            lambda c, s: rp_cadmm.control(params, cfg, f_eq, c, s, acc_des)
+        )(ds0, states_k := jax.tree.map(lambda x: x[k], states))
+        np.testing.assert_allclose(
+            np.asarray(f_b[k]), np.asarray(f_s), atol=2e-4
+        )
