@@ -94,3 +94,32 @@ def test_batched_matches_solo():
         np.testing.assert_allclose(
             np.asarray(f_b[k]), np.asarray(f_s), atol=2e-4
         )
+
+
+def test_sharded_matches_single_program():
+    """Agent-sharded RP consensus (shard_map + pmean/pmax over the virtual
+    CPU mesh) must reproduce the single-program result — the same contract
+    the RQP sharded controllers assert."""
+    import pytest
+
+    if len(jax.devices()) < 3:
+        pytest.skip("needs >=3 virtual devices")
+    from tpu_aerial_transport.parallel import mesh as mesh_mod
+
+    params, f_eq, acc_des, state = _setup()
+    cfg = rp_cadmm.make_config(params, max_iter=30, inner_iters=30,
+                               res_tol=1e-3)
+    ds0 = rp_cadmm.init_state(params, cfg, f_eq)
+
+    f_ref, _, st_ref = jax.jit(
+        lambda c, s: rp_cadmm.control(params, cfg, f_eq, c, s, acc_des)
+    )(ds0, state)
+
+    m = mesh_mod.make_mesh({"agent": 3})
+    step = mesh_mod.rp_cadmm_control_sharded(params, cfg, f_eq, m)
+    f_sh, _, st_sh = jax.jit(step)(ds0, state, acc_des)
+
+    np.testing.assert_allclose(
+        np.asarray(f_sh), np.asarray(f_ref), atol=2e-4
+    )
+    assert int(st_sh.iters) == int(st_ref.iters)

@@ -146,17 +146,28 @@ def control(
     acc_des,
     axis_name: str | None = None,
 ):
-    """One distributed control step ``-> (f (n, 3), RPCADMMState,
+    """One distributed control step ``-> (f (n_local, 3), RPCADMMState,
     SolverStats)``. ``f`` is each agent's own column of its copy (the
-    force it will actually apply), as in the RQP controller."""
+    force it will actually apply), as in the RQP controller.
+
+    With ``axis_name=None`` all n agents run in one program (vmap). Inside
+    ``shard_map`` over a mesh axis named ``axis_name`` each shard holds a
+    block of agents (the leading axis of every ``RPCADMMState`` leaf); the
+    consensus mean/residual become ``pmean``/``pmax`` collectives (equal
+    shard sizes, so the mean of per-shard means is the global mean)."""
     n = params.n
     base = cfg.base
     dtype = state.xl.dtype
     n_box = 9 + n
     soc_dims = (4,) * (2 * n)
 
-    onehots = jnp.eye(n, dtype=dtype)
-    leaders = (jnp.arange(n) == cfg.leader_idx).astype(dtype)
+    n_local = cstate.f.shape[0]
+    if axis_name is None:
+        agent_ids = jnp.arange(n_local)
+    else:
+        agent_ids = lax.axis_index(axis_name) * n_local + jnp.arange(n_local)
+    onehots = (agent_ids[:, None] == jnp.arange(n)[None, :]).astype(dtype)
+    leaders = (agent_ids == cfg.leader_idx).astype(dtype)
 
     P, q0, A, lb, ub, shift = jax.vmap(
         lambda oh, ld: _agent_qp(params, cfg, f_eq, state, acc_des, oh, ld)
@@ -170,8 +181,13 @@ def control(
         jnp.concatenate([jnp.zeros((6,), dtype), jnp.full((3 * n,), rho)])
     )[None]
     m = A.shape[1]
+    # One constant feeds BOTH the precomputed operator and the solver so the
+    # two rho_vecs cannot silently diverge (the KKTOp.sigma-mismatch hazard,
+    # socp.py:73-77, applies to rho identically).
+    solver_rho = 0.4
     rho_vec = jax.vmap(
-        lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
+        lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, solver_rho,
+                                           dtype)
     )(lb, ub)
     op = socp.kkt_operator(P_aug, A, rho_vec)
 
@@ -179,36 +195,38 @@ def control(
         lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_: socp.solve_socp(
             P_, q_, A_, lb_, ub_,
             n_box=n_box, soc_dims=soc_dims, iters=cfg.inner_iters,
-            warm=warm_, shift=shift_, op=op_,
+            rho=solver_rho, warm=warm_, shift=shift_, op=op_,
         )
     )
 
     def _mean_over_agents(x):
-        s = jnp.mean(x, axis=0)
+        # psum(local sum) / n — cadmm.control's reduction form: correct for
+        # ANY shard split, not just equal shards.
+        s = jnp.sum(x, axis=0)
         if axis_name is not None:
-            s = lax.pmean(s, axis_name)
-        return s
+            s = lax.psum(s, axis_name)
+        return s / n
 
     def _max_over_agents(x):
         s = jnp.max(x)
         return s if axis_name is None else lax.pmax(s, axis_name)
 
-    fallback = jnp.tile(f_eq[None], (n, 1, 1))
+    fallback = jnp.tile(f_eq[None], (n_local, 1, 1))
 
     def admm_iter(carry):
         f, lam, f_mean, warm, it, res, okf = carry
         # Linear term: <lam_i, f> - rho <f_mean, f> on the force block.
-        q = q0.at[:, 6:].add((lam - rho * f_mean[None]).reshape(n, -1))
+        q = q0.at[:, 6:].add((lam - rho * f_mean[None]).reshape(n_local, -1))
         sols = solve_one(P_aug, q, A, lb, ub, shift, op, warm)
         ok = (sols.prim_res < base.solver_tol) & jnp.all(
             jnp.isfinite(sols.x), axis=-1
         )
         f_new = jnp.where(
-            ok[:, None, None], sols.x[:, 6:].reshape(n, n, 3), fallback
+            ok[:, None, None], sols.x[:, 6:].reshape(n_local, n, 3), fallback
         )
         warm_new = jax.tree.map(
             lambda new, old: jnp.where(
-                ok.reshape((n,) + (1,) * (new.ndim - 1)), new, old
+                ok.reshape((n_local,) + (1,) * (new.ndim - 1)), new, old
             ),
             sols, warm,
         )
@@ -222,7 +240,7 @@ def control(
         lam_new = jnp.where(
             do_dual, lam + rho * (f_new - f_mean_new[None]), lam
         )
-        okf = jnp.minimum(okf, jnp.mean(ok.astype(dtype)))
+        okf = jnp.minimum(okf, _mean_over_agents(ok.astype(dtype)))
         return (f_new, lam_new, f_mean_new, warm_new, it + 1, res_new, okf)
 
     def cond(carry):
@@ -237,7 +255,11 @@ def control(
         cond, admm_iter, init
     )
 
-    f_own = jnp.einsum("iij->ij", f)  # agent i's own column.
+    # Agent i's own column of its copy (local rows index the GLOBAL agent
+    # axis by agent_ids under sharding).
+    f_own = jnp.take_along_axis(
+        f, agent_ids[:, None, None], axis=1
+    )[:, 0, :]
     new_state = RPCADMMState(f=f, lam=lam, warm=warm)
     stats = SolverStats(
         iters=iters,

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_aerial_transport.control import cadmm, dd
+from tpu_aerial_transport.control import cadmm, dd, rp_cadmm
 from tpu_aerial_transport.envs import forest as forest_mod
 from tpu_aerial_transport.models.rqp import RQPParams, RQPState
 
@@ -47,6 +47,27 @@ def make_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
     return Mesh(dev_array, names)
 
 
+def _sharded_control(mesh: Mesh, axis: str, n: int, state_spec,
+                     control_fn: Callable) -> Callable:
+    """Shared shard_map plumbing for every agent-sharded controller: the
+    divisibility check, the (state, replicated-state, replicated-acc) specs,
+    and the check_vma workaround live in ONE place."""
+    n_shards = mesh.shape[axis]
+    assert n % n_shards == 0, (n, n_shards)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(state_spec, P(), (P(), P())),
+        out_specs=(P(axis), state_spec, P()),
+        check_vma=False,
+    )
+    def step(ctrl_state, state, acc_des):
+        return control_fn(ctrl_state, state, acc_des)
+
+    return step
+
+
 def cadmm_control_sharded(
     params: RQPParams,
     cfg: cadmm.RQPCADMMConfig,
@@ -62,9 +83,6 @@ def cadmm_control_sharded(
     are sharded over the ``axis`` mesh dimension; ``state``/``acc_des`` are
     replicated. Requires ``n % mesh.shape[axis] == 0``.
     """
-    n = params.n
-    n_shards = mesh.shape[axis]
-    assert n % n_shards == 0, (n, n_shards)
     # State-independent Schur plan for ALL agents, computed once outside the
     # shard_map (replicated capture); each shard gathers its agent rows
     # inside cadmm.control.
@@ -74,21 +92,12 @@ def cadmm_control_sharded(
         f=P(axis), lam=P(axis), f_mean=P(),
         warm=jax.tree.map(lambda _: P(axis), _warm_structure()),
     )
-
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(state_spec, P(), (P(), P())),
-        out_specs=(P(axis), state_spec, P()),
-        check_vma=False,
+    return _sharded_control(
+        mesh, axis, params.n, state_spec,
+        lambda cs, s, a: cadmm.control(
+            params, cfg, f_eq, cs, s, a, forest, axis_name=axis, plan=plan
+        ),
     )
-    def step(admm_state, state, acc_des):
-        return cadmm.control(
-            params, cfg, f_eq, admm_state, state, acc_des, forest,
-            axis_name=axis, plan=plan,
-        )
-
-    return step
 
 
 def dd_control_sharded(
@@ -107,9 +116,6 @@ def dd_control_sharded(
     consensus-violation sums run as ``psum`` and the 6n-dim quasi-Newton dual
     step replicates per shard after an ``all_gather`` (see
     ``control.dd.control``). Requires ``n % mesh.shape[axis] == 0``."""
-    n = params.n
-    n_shards = mesh.shape[axis]
-    assert n % n_shards == 0, (n, n_shards)
     # State-independent QN plan, once, outside the shard_map (replicated).
     plan = dd.make_dd_plan(params, cfg)
 
@@ -117,21 +123,38 @@ def dd_control_sharded(
         f=P(axis), F=P(axis), M=P(axis), lam_F=P(axis), lam_M=P(axis),
         warm=jax.tree.map(lambda _: P(axis), _warm_structure()),
     )
-
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(state_spec, P(), (P(), P())),
-        out_specs=(P(axis), state_spec, P()),
-        check_vma=False,
+    return _sharded_control(
+        mesh, axis, params.n, state_spec,
+        lambda cs, s, a: dd.control(
+            params, cfg, f_eq, cs, s, a, forest, axis_name=axis, plan=plan
+        ),
     )
-    def step(dd_state, state, acc_des):
-        return dd.control(
-            params, cfg, f_eq, dd_state, state, acc_des, forest,
-            axis_name=axis, plan=plan,
-        )
 
-    return step
+
+def rp_cadmm_control_sharded(
+    params,
+    cfg: rp_cadmm.RPCADMMConfig,
+    f_eq: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "agent",
+) -> Callable:
+    """Agent-sharded RP consensus-ADMM control step (the beyond-reference
+    RP distributed controller, control/rp_cadmm.py): each shard owns a
+    block of agents' copies; consensus mean/residual ride pmean/pmax.
+
+    Returns ``step(cstate, state, acc_des) -> (f_own, cstate, stats)`` with
+    the leading-``n`` leaves of ``cstate`` and the returned ``f_own``
+    sharded over ``axis``; ``state``/``acc_des`` replicated."""
+    state_spec = rp_cadmm.RPCADMMState(
+        f=P(axis), lam=P(axis),
+        warm=jax.tree.map(lambda _: P(axis), _warm_structure()),
+    )
+    return _sharded_control(
+        mesh, axis, params.n, state_spec,
+        lambda cs, s, a: rp_cadmm.control(
+            params, cfg, f_eq, cs, s, a, axis_name=axis
+        ),
+    )
 
 
 def _warm_structure():
