@@ -15,6 +15,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import jax
@@ -150,6 +151,8 @@ def main() -> None:
 
     log_dict = ro.logs_to_dict(logs, args.n, args.dt, args.hl_rel_freq, forest)
     if args.out:
+        parent = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(parent, exist_ok=True)
         np.savez(args.out, **{
             k: v for k, v in log_dict.items() if not isinstance(v, dict)
         }, **{f"state_{k}": v for k, v in log_dict["state_seq"].items()})

@@ -122,4 +122,7 @@ def test_sharded_matches_single_program():
     np.testing.assert_allclose(
         np.asarray(f_sh), np.asarray(f_ref), atol=2e-4
     )
-    assert int(st_sh.iters) == int(st_ref.iters)
+    # +-1 tolerance: the two paths reduce the consensus mean in different
+    # f32 orders (one-kernel sum vs per-shard sums + psum), so a residual
+    # landing within epsilon of res_tol can close one iteration apart.
+    assert abs(int(st_sh.iters) - int(st_ref.iters)) <= 1

@@ -153,8 +153,8 @@ def control(
     With ``axis_name=None`` all n agents run in one program (vmap). Inside
     ``shard_map`` over a mesh axis named ``axis_name`` each shard holds a
     block of agents (the leading axis of every ``RPCADMMState`` leaf); the
-    consensus mean/residual become ``pmean``/``pmax`` collectives (equal
-    shard sizes, so the mean of per-shard means is the global mean)."""
+    consensus mean runs as ``psum(local sum) / n`` (correct for any shard
+    split) and the residual as a ``pmax`` collective."""
     n = params.n
     base = cfg.base
     dtype = state.xl.dtype

@@ -140,7 +140,8 @@ def rp_cadmm_control_sharded(
 ) -> Callable:
     """Agent-sharded RP consensus-ADMM control step (the beyond-reference
     RP distributed controller, control/rp_cadmm.py): each shard owns a
-    block of agents' copies; consensus mean/residual ride pmean/pmax.
+    block of agents' copies; the consensus mean rides psum(sum)/n and the
+    residual pmax.
 
     Returns ``step(cstate, state, acc_des) -> (f_own, cstate, stats)`` with
     the leading-``n`` leaves of ``cstate`` and the returned ``f_own``
