@@ -33,8 +33,12 @@ def test_agrees_with_centralized():
         lambda c, s: rp_centralized.control(params, ccfg, f_eq, c, s, acc_des)
     )(cs0, state)
 
+    # carry_duals=True for the warm-restart clause below: the carried duals
+    # are the memory that lets a repeat solve at the SAME state close in ~1
+    # iteration (the default resets them per step — the closed-loop test
+    # covers why).
     dcfg = rp_cadmm.make_config(params, max_iter=60, inner_iters=40,
-                                res_tol=1e-3)
+                                res_tol=1e-3, carry_duals=True)
     ds0 = rp_cadmm.init_state(params, dcfg, f_eq)
     f_d, ds, st = jax.jit(
         lambda c, s: rp_cadmm.control(params, dcfg, f_eq, c, s, acc_des)
@@ -126,3 +130,59 @@ def test_sharded_matches_single_program():
     # f32 orders (one-kernel sum vs per-shard sums + psum), so a residual
     # landing within epsilon of res_tol can close one iteration apart.
     assert abs(int(st_sh.iters) - int(st_ref.iters)) <= 1
+
+
+def test_closedloop_circle():
+    """Distributed RP consensus tracking the same circular reference the
+    centralized closed-loop test flies (reference test_rpcentralized.py:
+    14-38 pattern): bounded post-transient tracking error and the tilt CBF
+    held — the distributed decomposition is a drop-in for the centralized
+    controller in closed loop, not just at a single solve."""
+    from tpu_aerial_transport.models import rp as rp_mod
+
+    params, col, state0 = setup.rp_setup(3)
+    cfg = rp_cadmm.make_config(params, max_iter=15, inner_iters=25,
+                               res_tol=5e-3)
+    f_eq = rp_centralized.equilibrium_forces(params)
+    ds0 = rp_cadmm.init_state(params, cfg, f_eq)
+
+    radius, omega, dt = 0.5, 0.4, 1e-3
+
+    def ref(t):
+        x = jnp.stack([
+            radius * jnp.cos(omega * t) - radius,
+            radius * jnp.sin(omega * t),
+            0.1 * t,
+        ])
+        v = jnp.stack([
+            -radius * omega * jnp.sin(omega * t),
+            radius * omega * jnp.cos(omega * t),
+            jnp.asarray(0.1),
+        ])
+        a = jnp.stack([
+            -radius * omega**2 * jnp.cos(omega * t),
+            -radius * omega**2 * jnp.sin(omega * t),
+            jnp.asarray(0.0),
+        ])
+        return x, v, a
+
+    def body(carry, i):
+        state, cs = carry
+        t = i * dt * 10
+        x_ref, v_ref, a_ref = ref(t)
+        dvl_des = a_ref - 1.5 * (state.vl - v_ref) - 2.0 * (state.xl - x_ref)
+        acc_des = (dvl_des, jnp.zeros(3))
+        f, cs, _ = rp_cadmm.control(params, cfg, f_eq, cs, state, acc_des)
+
+        def ll(s, _):
+            return rp_mod.integrate(params, s, f, dt), None
+
+        state, _ = jax.lax.scan(ll, state, None, length=10)
+        return (state, cs), jnp.linalg.norm(state.xl - x_ref)
+
+    (final, _), errs = jax.jit(
+        lambda c: jax.lax.scan(body, c, jnp.arange(500))
+    )((state0, ds0))
+    assert bool(jnp.all(jnp.isfinite(final.xl)))
+    assert float(jnp.max(errs[300:])) < 0.3
+    assert float(final.Rl[2, 2]) > float(jnp.cos(jnp.pi / 6)) - 0.02

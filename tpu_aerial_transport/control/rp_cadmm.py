@@ -47,6 +47,14 @@ class RPCADMMConfig:
     leader_idx: int = 0
     max_iter: int = struct.field(pytree_node=False, default=20)
     inner_iters: int = struct.field(pytree_node=False, default=20)
+    # Carry consensus duals across control steps. Default OFF: measured in
+    # closed loop (circle track, tests/test_rp_cadmm.py), carried duals
+    # drift — stale consensus prices at a moved reference bias the agent
+    # solves, solver failures feed fallback forces into the dual update,
+    # and tracking error grows without bound (0.27 -> 0.44 -> 0.84 over 800
+    # steps, |lam| 3.8 -> 9.3), while per-step reset tracks at ~0.10 with
+    # small duals. Warm PRIMAL starts are still carried either way.
+    carry_duals: bool = struct.field(pytree_node=False, default=False)
 
 
 def make_config(
@@ -56,6 +64,7 @@ def make_config(
     res_tol: float = 1e-2,
     rho: float = 1.0,
     leader_idx: int = 0,
+    carry_duals: bool = False,
 ) -> RPCADMMConfig:
     """Distributed deltas vs the centralized config (mirroring the RQP
     reference's _set_controller_constants distributed scaling,
@@ -66,7 +75,7 @@ def make_config(
     base = base.replace(k_f=base.k_f / n)
     return RPCADMMConfig(
         base=base, rho=rho, res_tol=res_tol, leader_idx=leader_idx,
-        max_iter=max_iter, inner_iters=inner_iters,
+        max_iter=max_iter, inner_iters=inner_iters, carry_duals=carry_duals,
     )
 
 
@@ -248,7 +257,8 @@ def control(
         return (res >= cfg.res_tol) & (it <= cfg.max_iter)
 
     f_mean0 = _mean_over_agents(cstate.f)
-    init = (cstate.f, cstate.lam, f_mean0, cstate.warm,
+    lam0 = cstate.lam if cfg.carry_duals else jnp.zeros_like(cstate.lam)
+    init = (cstate.f, lam0, f_mean0, cstate.warm,
             jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype),
             jnp.ones((), dtype))
     f, lam, f_mean, warm, iters, res, ok_frac = lax.while_loop(
