@@ -152,3 +152,73 @@ def test_distance_query_jits_and_vmaps():
     ))
     out = fn(xs, vs)
     assert out.lhs.shape == (2, 10, 3)
+
+
+def test_cbf_rows_stay_protective_under_penetration():
+    """Near-contact hardening (deliberate deviation from the reference,
+    which drops rows at dist < 1e-4 and whose braking-time coefficient
+    degenerates to zero at contact — measured closed-loop consequence: the
+    payload punches straight through trees once it grazes into contact):
+    with the capsule PENETRATING a tree, the nearest-obstacle row must stay
+    active, point AWAY from the tree (sign-corrected outward normal), and
+    carry a positive rhs demanding outward acceleration."""
+    tree = jnp.array([[1.0, 0.0, 2.0]])
+    forest = fo.forest_from_tree_pos(np.asarray(tree), 1)
+    xl = jnp.array([0.0, 0.0, 2.0])
+    vl = jnp.array([0.5, 0.0, 0.0])  # flying straight at the tree.
+    collision_radius = 0.9  # 0.9 + bark 0.3 = 1.2 > 1.0 separation: contact.
+    cbf = fo.collision_cbf_rows(
+        forest, xl, vl, collision_radius, max_deceleration=2.0,
+        vision_radius=6.0, dist_eps=0.1, alpha_env_cbf=1.5, n_rows=4,
+    )
+    assert float(cbf.min_dist) < 0  # penetrating, by construction.
+    lhs = np.asarray(cbf.lhs)
+    rhs = np.asarray(cbf.rhs)
+    act = np.abs(lhs).max(axis=1) > 0
+    assert act.any(), "penetrating obstacle must still produce a row"
+    r = int(np.argmax(act))
+    # Outward = -x (tree is at +x): coefficient strictly negative in x,
+    # with the NEAR_BRAKE_TIME floor magnitude.
+    assert lhs[r, 0] < -0.9 * fo.NEAR_BRAKE_TIME, lhs[r]
+    # rhs = -alpha (d - eps) - n . vl with d < 0 and n = -x: both terms
+    # positive — the row demands deceleration/outward acceleration.
+    assert rhs[r] > 0, rhs[r]
+    # The demanded acceleration is feasible (well inside thrust envelopes).
+    assert rhs[r] / -lhs[r, 0] < 10.0
+
+
+def test_cbf_rows_protective_deep_penetration_and_at_rest():
+    """The two corners the first hardening pass missed (found by review,
+    reproduced, now fixed at the source): (a) DEEP penetration — the
+    capsule axis inside the bark — needs interior points to witness the
+    nearest SURFACE point (a self-witness zeroes the outward normal);
+    (b) a system AT REST in contact keeps its near row (the speed gate
+    applies only to far rows whose braking-capsule construction needs
+    motion)."""
+    tree = jnp.array([[1.0, 0.0, 2.0]])
+    forest = fo.forest_from_tree_pos(np.asarray(tree), 1)
+
+    # (a) axis inside the bark: payload 0.1 m from the tree axis.
+    cbf = fo.collision_cbf_rows(
+        forest, jnp.array([0.9, 0.0, 2.0]), jnp.array([0.3, 0.0, 0.0]),
+        collision_radius=0.9, max_deceleration=2.0,
+        vision_radius=6.0, dist_eps=0.1, alpha_env_cbf=1.5, n_rows=4,
+    )
+    lhs, rhs = np.asarray(cbf.lhs), np.asarray(cbf.rhs)
+    act = np.abs(lhs).max(axis=1) > 0
+    assert act.any(), "deep penetration must still produce a row"
+    r = int(np.argmax(act))
+    assert lhs[r, 0] < 0, lhs[r]  # outward = -x.
+    assert rhs[r] > 0, rhs[r]
+
+    # (b) at rest in shallow contact.
+    cbf = fo.collision_cbf_rows(
+        forest, jnp.array([0.0, 0.0, 2.0]), jnp.zeros(3),
+        collision_radius=0.9, max_deceleration=2.0,
+        vision_radius=6.0, dist_eps=0.1, alpha_env_cbf=1.5, n_rows=4,
+    )
+    lhs, rhs = np.asarray(cbf.lhs), np.asarray(cbf.rhs)
+    act = np.abs(lhs).max(axis=1) > 0
+    assert act.any(), "at-rest contact must keep its near row"
+    r = int(np.argmax(act))
+    assert lhs[r, 0] < 0 and rhs[r] > 0, (lhs[r], rhs[r])

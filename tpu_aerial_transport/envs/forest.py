@@ -52,6 +52,14 @@ _FAR = 1.0e6
 # dominated the env query's TPU latency.
 _GRID_PTS = 33
 _REFINE_ITERS = 12
+
+# Braking-time floor [s] for CBF rows of obstacles inside ``dist_eps`` (see
+# cbf_rows_from_distance near-contact hardening): keeps the row coefficient
+# ``normal * min_time`` usable when the reference's formula degenerates to
+# zero at contact. 0.2 s turns a typical near-contact rhs (~0.2-0.6 m/s
+# scale) into a 1-3 m/s^2 outward-acceleration demand — firm, and well
+# inside the thrust envelope so the agent QPs stay feasible.
+NEAR_BRAKE_TIME = 0.2
 _INV_PHI = 0.6180339887498949
 
 
@@ -154,12 +162,32 @@ def point_cylinder_distance(p, center, radius, half_height):
     inside = jnp.maximum(d_rad, d_ax)  # both <= 0 here.
     dist = jnp.where((d_rad <= 0.0) & (d_ax <= 0.0), inside, outside)
 
-    # Closest point on the cylinder (for witness/normal computation).
-    safe_rho = jnp.where(rho > 1e-12, rho, 1.0)
-    u = dxy / safe_rho[..., None]
-    clamped_rho = jnp.minimum(rho, radius)
-    cp_xy = center[..., :2] + u * clamped_rho[..., None]
-    cp_z = center[..., 2] + jnp.clip(dz, -half_height, half_height)
+    # Closest point on the cylinder SURFACE (witness/normal computation).
+    # Interior points project to the nearest boundary (wall or cap,
+    # whichever is closer) rather than to themselves: a self-witness would
+    # zero the outward normal exactly where penetration-protective CBF rows
+    # need it (cbf_rows_from_distance near-contact hardening). Points on
+    # the cylinder axis (rho ~ 0) pick an arbitrary but fixed radial
+    # direction so the witness stays defined.
+    on_axis = rho <= 1e-12
+    safe_rho = jnp.where(on_axis, 1.0, rho)
+    u = jnp.where(
+        on_axis[..., None],
+        jnp.broadcast_to(jnp.array([1.0, 0.0], p.dtype), dxy.shape),
+        dxy / safe_rho[..., None],
+    )
+    is_inside = (d_rad <= 0.0) & (d_ax <= 0.0)
+    wall_closer = d_rad >= d_ax  # both <= 0 inside: larger = nearer face.
+    # Exterior: clamp into the cylinder as before.
+    ext_xy = center[..., :2] + u * jnp.minimum(rho, radius)[..., None]
+    ext_z = center[..., 2] + jnp.clip(dz, -half_height, half_height)
+    # Interior: radial wall or the nearer cap.
+    int_xy = jnp.where(wall_closer[..., None],
+                       center[..., :2] + u * radius, p[..., :2])
+    cap_z = center[..., 2] + jnp.where(dz >= 0.0, half_height, -half_height)
+    int_z = jnp.where(wall_closer, p[..., 2], cap_z)
+    cp_xy = jnp.where(is_inside[..., None], int_xy, ext_xy)
+    cp_z = jnp.where(is_inside, int_z, ext_z)
     closest = jnp.concatenate([cp_xy, cp_z[..., None]], axis=-1)
     return dist, closest
 
@@ -213,6 +241,12 @@ class DistanceData:
     dists: jnp.ndarray  # (max_trees,) capsule-to-tree distance; +inf when masked.
     pts_sys: jnp.ndarray  # (max_trees, 3) witness on the system capsule surface.
     pts_env: jnp.ndarray  # (max_trees, 3) witness on the tree.
+    # Outward unit normal (obstacle -> system), sign-corrected from the
+    # AXIS-level geometry: the surface-witness difference pts_sys - pts_env
+    # flips direction when the inflated capsule penetrates the tree (the
+    # surface points cross), which would invert a CBF row exactly at
+    # contact; this field stays outward through penetration.
+    normal_out: jnp.ndarray  # (max_trees, 3)
     mask: jnp.ndarray  # (max_trees,) bool — tree valid & within vision radius.
     collision: jnp.ndarray  # () bool, any dist < 1e-4.
     min_dist: jnp.ndarray  # () min over mask (vision_radius if none).
@@ -238,8 +272,20 @@ def capsule_forest_distance(
     # Witness point on the capsule surface: offset from the axis toward the tree.
     normal = p_cyl - p_seg
     nn = jnp.linalg.norm(normal, axis=-1, keepdims=True)
+    valid_n = nn[:, 0] > 1e-12
     normal = normal / jnp.where(nn > 1e-12, nn, 1.0)
     pts_sys = p_seg + cap_radius * normal
+    # Outward (obstacle -> system) unit normal from the signed axis-level
+    # distance: -normal while the capsule axis is outside the tree surface
+    # (dist_axis > 0, the ordinary case — identical to normalizing
+    # pts_sys - pts_env), +normal when the axis is inside the bark
+    # (dist_axis < 0, where the surface-witness difference would flip).
+    # Zero where the direction is undefined (axis touching the surface).
+    normal_out = jnp.where(
+        valid_n[:, None],
+        -jnp.sign(dist_axis)[:, None] * normal,
+        0.0,
+    )
 
     # Vision gating mirrors the reference: the query capsule's hppfcl transform
     # translation is its *midpoint* (rqp_centralized.py:302-305 places the
@@ -257,8 +303,8 @@ def capsule_forest_distance(
     collision = jnp.any(jnp.where(mask, dists < 1e-4, False))
     min_dist = jnp.min(jnp.where(mask, dists, vision_radius))
     return DistanceData(
-        dists=dists, pts_sys=pts_sys, pts_env=p_cyl, mask=mask,
-        collision=collision, min_dist=min_dist,
+        dists=dists, pts_sys=pts_sys, pts_env=p_cyl, normal_out=normal_out,
+        mask=mask, collision=collision, min_dist=min_dist,
     )
 
 
@@ -349,10 +395,9 @@ def cbf_rows_from_distance(
     # Top-k nearest (masked): top_k on negated distance.
     neg = jnp.where(data.mask, -data.dists, -jnp.inf)
     _, idx = lax.top_k(neg, n_rows)
-    sel_mask = jnp.take(data.mask, idx) & (speed > 0)
+    sel_mask = jnp.take(data.mask, idx)
     d = jnp.take(data.dists, idx)
     p1 = jnp.take(data.pts_sys, idx, axis=0)
-    p2 = jnp.take(data.pts_env, idx, axis=0)
 
     # Remaining braking time before the closest-approach point (reference
     # :324-329): proj = clamp(<p1 - xl, dir>, 0, h);
@@ -364,12 +409,32 @@ def cbf_rows_from_distance(
         speed / max_deceleration
         - jnp.sqrt(jnp.maximum(2.0 * (cap_h - proj) / max_deceleration, 0.0)),
     )
-    normal = p1 - p2
-    nn = jnp.linalg.norm(normal, axis=-1, keepdims=True)
-    normal = normal / jnp.where(nn > 1e-12, nn, 1.0)
+    # Outward normal, sign-corrected through penetration (DistanceData
+    # docstring): zero rows only where the direction itself is undefined.
+    normal = jnp.take(data.normal_out, idx, axis=0)
+    n_valid = jnp.sum(normal * normal, axis=-1) > 0.5
 
-    # Degenerate rows (masked out, or d <= 1e-4 as in reference :322) are vacuous.
-    row_ok = sel_mask & (d > 1e-4) & jnp.isfinite(d)
+    # Near-contact hardening (DELIBERATE deviation from the reference,
+    # which drops rows at dist < 1e-4, :322, and whose braking-time
+    # coefficient goes to ZERO for an obstacle reached by the capsule —
+    # measured consequence in closed loop: once the system grazes into
+    # contact every protecting row vanishes or degenerates, the tracking
+    # cost re-accelerates, and the payload punches straight through the
+    # obstacle (T=30 forest soak: 518 collision steps, -0.92 m
+    # penetration). Inside ``dist_eps`` the braking time is floored at
+    # ``NEAR_BRAKE_TIME`` so the row keeps a usable coefficient, and its
+    # rhs (positive there) demands outward acceleration along the
+    # sign-corrected outward normal.
+    near = d < dist_eps
+    min_time = jnp.where(
+        near, jnp.maximum(min_time, NEAR_BRAKE_TIME), min_time
+    )
+    # speed > 0 gates only the FAR rows (the braking-capsule construction
+    # needs motion, reference semantics); near-contact rows stay active at
+    # rest — rhs = -alpha (d - eps) > 0 needs no velocity, and a system
+    # resting in contact must still be pushed out, not released until it
+    # re-accelerates into the obstacle.
+    row_ok = sel_mask & jnp.isfinite(d) & n_valid & (near | (speed > 0))
     lhs = jnp.where(row_ok[:, None], normal * min_time[:, None], 0.0)
     rhs = jnp.where(
         row_ok,
