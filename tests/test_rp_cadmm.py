@@ -141,7 +141,11 @@ def test_closedloop_circle():
     from tpu_aerial_transport.models import rp as rp_mod
 
     params, col, state0 = setup.rp_setup(3)
-    cfg = rp_cadmm.make_config(params, max_iter=15, inner_iters=25,
+    # With row equilibration in the RP QP builder (socp.equilibrate_rows —
+    # before it, the leader-cost QP needed ~600 ADMM iterations and every
+    # step ran on the solve-failure edge) this closed loop runs at ~1.2
+    # consensus iterations/step with zero fallbacks and ~0.05 m error.
+    cfg = rp_cadmm.make_config(params, max_iter=20, inner_iters=40,
                                res_tol=5e-3)
     f_eq = rp_centralized.equilibrium_forces(params)
     ds0 = rp_cadmm.init_state(params, cfg, f_eq)
@@ -184,5 +188,5 @@ def test_closedloop_circle():
         lambda c: jax.lax.scan(body, c, jnp.arange(500))
     )((state0, ds0))
     assert bool(jnp.all(jnp.isfinite(final.xl)))
-    assert float(jnp.max(errs[300:])) < 0.3
+    assert float(jnp.max(errs[300:])) < 0.15
     assert float(final.Rl[2, 2]) > float(jnp.cos(jnp.pi / 6)) - 0.02

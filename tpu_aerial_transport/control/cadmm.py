@@ -118,6 +118,13 @@ class RQPCADMMConfig:
     # warm start the first iteration sees). 0 = use ``inner_iters``.
     inner_iters_warm: int = struct.field(pytree_node=False, default=0)
     solver_tol: float = struct.field(pytree_node=False, default=5e-3)
+    # Consensus iterations may continue past residual convergence while any
+    # agent's solve still fails tolerance (retries accumulate inner
+    # progress through the kept warm starts — without this, a hard agent
+    # QP falls back to equilibrium forces every step and e.g. an active
+    # near-contact obstacle row is never enforced). 0 = retries allowed up
+    # to max_iter; set lower to bound the worst-lane burn in huge batches.
+    solve_retry_iters: int = struct.field(pytree_node=False, default=0)
     max_f_ang: float = struct.field(pytree_node=False, default=jnp.pi / 6)
     # Inner-chunk execution mode forwarded to ops/socp.py solve_socp
     # ("auto" | "scan" | "pallas" | "interpret"): "pallas" runs each fixed-
@@ -999,7 +1006,7 @@ def control(
     solve_warm = make_solve(warm_iters) if two_phase else solve_cold
 
     def _consensus_iter_impl(solve_one, carry):
-        f, lam, f_mean, warm, it, res, err_buf, okf = carry
+        f, lam, f_mean, warm, it, res, err_buf, okf, _ok_last = carry
         f_new, sols = primal_solve(
             solve_one, qp_at(it), rho_at(it), lam, f_mean, warm
         )
@@ -1008,12 +1015,18 @@ def control(
             jnp.isfinite(f_new), axis=(1, 2), keepdims=True
         )
         f_new = jnp.where(ok, f_new, f_eq[None, :, :])
-        # Failed agents also keep their previous warm start (a NaN iterate would
-        # poison every later solve; cvxpy in the reference re-solves fresh).
+        # Warm starts keep any FINITE iterate — including tolerance-missed
+        # ones: a hard agent QP (e.g. a strongly active near-contact env
+        # CBF row) then accumulates inner iterations across consensus
+        # retries instead of restarting from the same point and failing
+        # identically forever. Only non-finite iterates (which would poison
+        # every later solve) revert.
         ok_flat = ok[:, 0, 0]
+        finite_flat = socp.solution_is_finite(sols)
         sols = jax.tree.map(
             lambda new, old: jnp.where(
-                ok_flat.reshape((n_local,) + (1,) * (new.ndim - 1)), new, old
+                finite_flat.reshape((n_local,) + (1,) * (new.ndim - 1)),
+                new, old,
             ),
             sols, warm,
         )
@@ -1033,8 +1046,10 @@ def control(
         )
         # Worst-iteration solve-success fraction (observability of the
         # equilibrium-fallback path).
-        okf = jnp.minimum(okf, _mean_over_agents(ok_flat.astype(dtype)))
-        return f_new, lam_new, f_mean_new, sols, it, res_new, err_buf, okf
+        ok_last = _mean_over_agents(ok_flat.astype(dtype))
+        okf = jnp.minimum(okf, ok_last)
+        return (f_new, lam_new, f_mean_new, sols, it, res_new, err_buf, okf,
+                ok_last)
 
     # Per-lane batch semantics: no manual freeze is needed — lax.while_loop's
     # batching rule re-evaluates the full per-lane cond inside the body and
@@ -1043,15 +1058,24 @@ def control(
     # and each lane's result equals a solo run's exactly.
     consensus_iter = _consensus_iter_impl
 
+    retry_cap = cfg.solve_retry_iters or cfg.max_iter
+
     def cond(carry):
-        *_, it, res, _buf, _okf = carry
-        return (res >= cfg.res_tol) & (it <= cfg.max_iter)
+        *_, it, res, _buf, _okf, ok_last = carry
+        # Keep iterating while any agent's solve is still failing, even at
+        # consensus agreement: fallback copies agree trivially (all
+        # equilibrium), so a residual-only exit would declare convergence
+        # at the exact moment protection is most needed. Retries continue
+        # the failed solves from their carried finite iterates, bounded by
+        # solve_retry_iters (default: the max_iter cap).
+        return (((res >= cfg.res_tol) | ((ok_last < 1.0) & (it <= retry_cap)))
+                & (it <= cfg.max_iter))
 
     err_buf0 = jnp.full((cfg.max_iter + 1,), jnp.nan, dtype)
     init = (
         admm_state.f, admm_state.lam, admm_state.f_mean, admm_state.warm,
         jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype), err_buf0,
-        jnp.ones((), dtype),
+        jnp.ones((), dtype), jnp.ones((), dtype),
     )
     if not two_phase:
         carry = init
@@ -1064,7 +1088,8 @@ def control(
         # vmap it becomes a select that executes both solver branches for
         # every lane.)
         carry = consensus_iter(solve_cold, init)
-    f, lam, f_mean, warm, iters, res, err_buf, ok_frac = lax.while_loop(
+    (f, lam, f_mean, warm, iters, res, err_buf, ok_frac,
+     _ok_last) = lax.while_loop(
         cond, lambda c: consensus_iter(solve_warm, c), carry
     )
 

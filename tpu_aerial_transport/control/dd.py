@@ -530,7 +530,7 @@ def control(
     fallback_M = -jnp.einsum("ij,njk,nk->ni", params.JT_inv, G_local, f_eq_local)
 
     def dd_iter(carry):
-        f, F, M, lam_F, lam_M, warm, it, err, err_buf, okf = carry
+        f, F, M, lam_F, lam_M, warm, it, err, err_buf, okf, _ok_last = carry
         # Price assembly (the all-gather, reference :716-722) — two psum
         # reductions over the agent axis.
         sum_lF = _sum_over_agents(lam_F)
@@ -552,9 +552,14 @@ def control(
         f_new = jnp.where(okc, x[:, 9:12], f_eq_local)
         F_new = jnp.where(okc, x[:, 12:15], fallback_F)
         M_new = jnp.where(okc, x[:, 15:18], fallback_M)
+        # Keep any FINITE iterate as the warm start (tolerance-missed solves
+        # accumulate inner progress across dual-ascent retries instead of
+        # restarting identically); only non-finite iterates revert (see the
+        # matching note in cadmm._consensus_iter_impl).
+        finite = socp.solution_is_finite(sols)
         warm_new = jax.tree.map(
             lambda new, old: jnp.where(
-                ok.reshape((n_local,) + (1,) * (new.ndim - 1)), new, old
+                finite.reshape((n_local,) + (1,) * (new.ndim - 1)), new, old
             ),
             sols, warm,
         )
@@ -586,30 +591,37 @@ def control(
         do_dual = (err_new >= cfg.prim_inf_tol) & (it <= base.max_iter)
         lam_F_new = jnp.where(do_dual, lam_F + step[:, :3] @ state.Rl.T, lam_F)
         lam_M_new = jnp.where(do_dual, lam_M + step[:, 3:], lam_M)
-        okf = jnp.minimum(
-            okf, _sum_over_agents(ok.astype(dtype)) / n
-        )  # worst-iteration solve-success fraction.
+        ok_last = _sum_over_agents(ok.astype(dtype)) / n
+        okf = jnp.minimum(okf, ok_last)  # worst-iteration success fraction.
         return (f_new, F_new, M_new, lam_F_new, lam_M_new, warm_new, it,
-                err_new, err_buf, okf)
+                err_new, err_buf, okf, ok_last)
 
     # Per-lane batch semantics: lax.while_loop's batching rule already
     # selects old-vs-new carry per lane from the full per-lane cond, so
     # converged scenarios stay frozen inside a vmapped batch (see the
     # matching note in cadmm.control) — no manual freeze wrapper.
 
+    retry_cap = base.solve_retry_iters or base.max_iter
+
     def cond(carry):
-        *_, it, err, _buf, _okf = carry
-        return (err >= cfg.prim_inf_tol) & (it <= base.max_iter)
+        *_, it, err, _buf, _okf, ok_last = carry
+        # Solve failures keep the loop alive even at primal feasibility:
+        # fallback values can satisfy the consensus equations trivially
+        # while the failed agents' true solves still need retries (see the
+        # matching note in cadmm.control's cond; bounded by
+        # solve_retry_iters, default the max_iter cap).
+        return (((err >= cfg.prim_inf_tol)
+                 | ((ok_last < 1.0) & (it <= retry_cap)))
+                & (it <= base.max_iter))
 
     err_buf0 = jnp.full((base.max_iter + 1,), jnp.nan, dtype)
     init = (
         dd_state.f, dd_state.F, dd_state.M, dd_state.lam_F, dd_state.lam_M,
         dd_state.warm, jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype),
-        err_buf0, jnp.ones((), dtype),
+        err_buf0, jnp.ones((), dtype), jnp.ones((), dtype),
     )
-    f, F, M, lam_F, lam_M, warm, iters, err, err_buf, ok_frac = lax.while_loop(
-        cond, dd_iter, init
-    )
+    (f, F, M, lam_F, lam_M, warm, iters, err, err_buf, ok_frac,
+     _ok_last) = lax.while_loop(cond, dd_iter, init)
 
     new_state = DDState(f=f, F=F, M=M, lam_F=lam_F, lam_M=lam_M, warm=warm)
     collision = _max_over_agents(env_cbfs.collision.astype(jnp.int32)) > 0

@@ -223,7 +223,7 @@ def control(
     fallback = jnp.tile(f_eq[None], (n_local, 1, 1))
 
     def admm_iter(carry):
-        f, lam, f_mean, warm, it, res, okf = carry
+        f, lam, f_mean, warm, it, res, okf, _ok_last = carry
         # Linear term: <lam_i, f> - rho <f_mean, f> on the force block.
         q = q0.at[:, 6:].add((lam - rho * f_mean[None]).reshape(n_local, -1))
         sols = solve_one(P_aug, q, A, lb, ub, shift, op, warm)
@@ -233,9 +233,13 @@ def control(
         f_new = jnp.where(
             ok[:, None, None], sols.x[:, 6:].reshape(n_local, n, 3), fallback
         )
+        # Keep any FINITE iterate as the warm start (see the matching note
+        # in cadmm._consensus_iter_impl): tolerance-missed solves accumulate
+        # progress across retries; only non-finite iterates revert.
+        finite = socp.solution_is_finite(sols)
         warm_new = jax.tree.map(
             lambda new, old: jnp.where(
-                ok.reshape((n_local,) + (1,) * (new.ndim - 1)), new, old
+                finite.reshape((n_local,) + (1,) * (new.ndim - 1)), new, old
             ),
             sols, warm,
         )
@@ -249,19 +253,24 @@ def control(
         lam_new = jnp.where(
             do_dual, lam + rho * (f_new - f_mean_new[None]), lam
         )
-        okf = jnp.minimum(okf, _mean_over_agents(ok.astype(dtype)))
-        return (f_new, lam_new, f_mean_new, warm_new, it + 1, res_new, okf)
+        ok_last = _mean_over_agents(ok.astype(dtype))
+        okf = jnp.minimum(okf, ok_last)
+        return (f_new, lam_new, f_mean_new, warm_new, it + 1, res_new, okf,
+                ok_last)
 
     def cond(carry):
-        *_, it, res, _okf = carry
-        return (res >= cfg.res_tol) & (it <= cfg.max_iter)
+        *_, it, res, _okf, ok_last = carry
+        # Solve failures keep the loop alive even at consensus agreement
+        # (see the matching note in cadmm.control's cond; bounded by the
+        # max_iter cap).
+        return ((res >= cfg.res_tol) | (ok_last < 1.0)) & (it <= cfg.max_iter)
 
     f_mean0 = _mean_over_agents(cstate.f)
     lam0 = cstate.lam if cfg.carry_duals else jnp.zeros_like(cstate.lam)
     init = (cstate.f, lam0, f_mean0, cstate.warm,
             jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype),
-            jnp.ones((), dtype))
-    f, lam, f_mean, warm, iters, res, ok_frac = lax.while_loop(
+            jnp.ones((), dtype), jnp.ones((), dtype))
+    f, lam, f_mean, warm, iters, res, ok_frac, _ok_last = lax.while_loop(
         cond, admm_iter, init
     )
 

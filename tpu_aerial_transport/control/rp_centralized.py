@@ -186,6 +186,13 @@ def _build_qp(params: RPParams, cfg: RPCentralizedConfig, f_eq, state: RPState,
 
     A_full = jnp.concatenate([A, soc], axis=0)
     shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    # Row equilibration (exact, see socp.equilibrate_rows): the rotation
+    # dynamics rows carry Jl_inv ~ O(50) against O(ml) translation rows;
+    # without rescaling the leader-cost QPs of the distributed RP
+    # controller measurably need ~600 ADMM iterations instead of ~40.
+    A_full, lb, ub, shift, _ = socp.equilibrate_rows(
+        A_full, lb, ub, shift, n_box, (4,) * (2 * n)
+    )
     return P, q, A_full, lb, ub, shift
 
 

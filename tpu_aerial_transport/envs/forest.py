@@ -435,10 +435,29 @@ def cbf_rows_from_distance(
     # resting in contact must still be pushed out, not released until it
     # re-accelerates into the obstacle.
     row_ok = sel_mask & jnp.isfinite(d) & n_valid & (near | (speed > 0))
-    lhs = jnp.where(row_ok[:, None], normal * min_time[:, None], 0.0)
+    rhs_raw = (
+        -alpha_env_cbf * (d - dist_eps)
+        - jnp.sum(normal * vl[None, :], axis=-1)
+    )
+    # Row normalization (identical halfspace, radically better ADMM
+    # conditioning): the reference writes the row as
+    # (normal * min_time) @ dvl >= rhs, whose coefficient norm is
+    # min_time (~0.2-0.3 s) against the O(1) rows of the rest of the QP —
+    # measured consequence: an ACTIVE near row pushed the f32 ADMM from
+    # ~120 iterations to ~3000 for the same solution, so solves failed at
+    # production budgets, fell back to equilibrium forces, and the
+    # momentum carried the payload through the obstacle. Dividing both
+    # sides by min_time (> 0) preserves the constraint exactly and
+    # restores unit row scale; min_time == 0 rows keep the reference's
+    # degenerate semantics (vacuous when rhs < 0, infeasible-by-design
+    # when rhs > 0 — "no braking time left").
+    has_time = min_time > 1e-6
+    lhs = jnp.where(
+        (row_ok & has_time)[:, None], normal, 0.0
+    )
     rhs = jnp.where(
         row_ok,
-        -alpha_env_cbf * (d - dist_eps) - jnp.sum(normal * vl[None, :], axis=-1),
+        jnp.where(has_time, rhs_raw / jnp.maximum(min_time, 1e-6), rhs_raw),
         inactive_rhs,
     )
     return EnvCBF(
