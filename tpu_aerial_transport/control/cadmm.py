@@ -122,9 +122,14 @@ class RQPCADMMConfig:
     # agent's solve still fails tolerance (retries accumulate inner
     # progress through the kept warm starts — without this, a hard agent
     # QP falls back to equilibrium forces every step and e.g. an active
-    # near-contact obstacle row is never enforced). 0 = retries allowed up
-    # to max_iter; set lower to bound the worst-lane burn in huge batches.
-    solve_retry_iters: int = struct.field(pytree_node=False, default=0)
+    # near-contact obstacle row is never enforced; measured: the n=8
+    # forest soak punches through trees). The default bound is SMALL
+    # because warm starts also persist across CONTROL steps, so a stuck
+    # agent still accumulates retry progress step over step (measured: the
+    # soak stays collision-free at 4), while an unbounded gate drags every
+    # lane of a vmapped batch to the worst lane's cap (measured: 4x on the
+    # batched headline). 0 = retries up to max_iter.
+    solve_retry_iters: int = struct.field(pytree_node=False, default=4)
     max_f_ang: float = struct.field(pytree_node=False, default=jnp.pi / 6)
     # Inner-chunk execution mode forwarded to ops/socp.py solve_socp
     # ("auto" | "scan" | "pallas" | "interpret"): "pallas" runs each fixed-
@@ -440,6 +445,12 @@ def _build_agent_qp(
 
     A_full = jnp.concatenate([A, soc], axis=0)
     shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    # Exact row/block equilibration (socp.equilibrate_rows): rotation
+    # dynamics rows carry JT_inv-scale entries against O(m) translation
+    # rows; unit-norm rows cut the f32 ADMM iteration count severalfold.
+    A_full, lb, ub, shift, _ = socp.equilibrate_rows(
+        A_full, lb, ub, shift, n_box, (4, 4)
+    )
     return P, q, A_full, lb, ub, shift
 
 
@@ -750,6 +761,10 @@ def _schur_step_qp(
 
     A_full = jnp.concatenate([A, soc], axis=0)
     shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    # Equilibrated like the full path (see _build_agent_qp).
+    A_full, lb, ub, shift, _ = socp.equilibrate_rows(
+        A_full, lb, ub, shift, n_box, (4, 4)
+    )
     return P_red, q_red0, A_full, lb, ub, shift
 
 
@@ -1006,7 +1021,8 @@ def control(
     solve_warm = make_solve(warm_iters) if two_phase else solve_cold
 
     def _consensus_iter_impl(solve_one, carry):
-        f, lam, f_mean, warm, it, res, err_buf, okf, _ok_last = carry
+        (f, lam, f_mean, warm, it, res, err_buf, okf, _ok_last,
+         fail_count) = carry
         f_new, sols = primal_solve(
             solve_one, qp_at(it), rho_at(it), lam, f_mean, warm
         )
@@ -1048,8 +1064,9 @@ def control(
         # equilibrium-fallback path).
         ok_last = _mean_over_agents(ok_flat.astype(dtype))
         okf = jnp.minimum(okf, ok_last)
+        fail_count = fail_count + (ok_last < 1.0).astype(jnp.int32)
         return (f_new, lam_new, f_mean_new, sols, it, res_new, err_buf, okf,
-                ok_last)
+                ok_last, fail_count)
 
     # Per-lane batch semantics: no manual freeze is needed — lax.while_loop's
     # batching rule re-evaluates the full per-lane cond inside the body and
@@ -1061,21 +1078,24 @@ def control(
     retry_cap = cfg.solve_retry_iters or cfg.max_iter
 
     def cond(carry):
-        *_, it, res, _buf, _okf, ok_last = carry
+        *_, it, res, _buf, _okf, ok_last, fail_count = carry
         # Keep iterating while any agent's solve is still failing, even at
         # consensus agreement: fallback copies agree trivially (all
         # equilibrium), so a residual-only exit would declare convergence
         # at the exact moment protection is most needed. Retries continue
         # the failed solves from their carried finite iterates, bounded by
-        # solve_retry_iters (default: the max_iter cap).
-        return (((res >= cfg.res_tol) | ((ok_last < 1.0) & (it <= retry_cap)))
+        # solve_retry_iters (default 4) FAILING iterations — counted from
+        # failure onset, not from iteration 0, so late-onset failures get
+        # the full budget.
+        return (((res >= cfg.res_tol)
+                 | ((ok_last < 1.0) & (fail_count <= retry_cap)))
                 & (it <= cfg.max_iter))
 
     err_buf0 = jnp.full((cfg.max_iter + 1,), jnp.nan, dtype)
     init = (
         admm_state.f, admm_state.lam, admm_state.f_mean, admm_state.warm,
         jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype), err_buf0,
-        jnp.ones((), dtype), jnp.ones((), dtype),
+        jnp.ones((), dtype), jnp.ones((), dtype), jnp.zeros((), jnp.int32),
     )
     if not two_phase:
         carry = init
@@ -1089,7 +1109,7 @@ def control(
         # every lane.)
         carry = consensus_iter(solve_cold, init)
     (f, lam, f_mean, warm, iters, res, err_buf, ok_frac,
-     _ok_last) = lax.while_loop(
+     _ok_last, _fail_count) = lax.while_loop(
         cond, lambda c: consensus_iter(solve_warm, c), carry
     )
 

@@ -315,6 +315,10 @@ def _build_qp(
 
     A_full = jnp.concatenate([A, soc], axis=0)
     shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    # Exact row/block equilibration (see cadmm._build_agent_qp).
+    A_full, lb, ub, shift, _ = socp.equilibrate_rows(
+        A_full, lb, ub, shift, n_box, (4,) * (2 * n)
+    )
     return P, q, A_full, lb, ub, shift
 
 

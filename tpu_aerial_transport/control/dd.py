@@ -249,6 +249,10 @@ def _build_agent_qp(
 
     A_full = jnp.concatenate([A, soc], axis=0)
     shift = jnp.concatenate([jnp.zeros((n_box,), dtype), shift_soc])
+    # Exact row/block equilibration (see cadmm._build_agent_qp).
+    A_full, lb, ub, shift, _ = socp.equilibrate_rows(
+        A_full, lb, ub, shift, n_box, (4, 4)
+    )
     return P, q, A_full, lb, ub, shift
 
 
@@ -530,7 +534,8 @@ def control(
     fallback_M = -jnp.einsum("ij,njk,nk->ni", params.JT_inv, G_local, f_eq_local)
 
     def dd_iter(carry):
-        f, F, M, lam_F, lam_M, warm, it, err, err_buf, okf, _ok_last = carry
+        (f, F, M, lam_F, lam_M, warm, it, err, err_buf, okf, _ok_last,
+         fail_count) = carry
         # Price assembly (the all-gather, reference :716-722) — two psum
         # reductions over the agent axis.
         sum_lF = _sum_over_agents(lam_F)
@@ -593,8 +598,9 @@ def control(
         lam_M_new = jnp.where(do_dual, lam_M + step[:, 3:], lam_M)
         ok_last = _sum_over_agents(ok.astype(dtype)) / n
         okf = jnp.minimum(okf, ok_last)  # worst-iteration success fraction.
+        fail_count = fail_count + (ok_last < 1.0).astype(jnp.int32)
         return (f_new, F_new, M_new, lam_F_new, lam_M_new, warm_new, it,
-                err_new, err_buf, okf, ok_last)
+                err_new, err_buf, okf, ok_last, fail_count)
 
     # Per-lane batch semantics: lax.while_loop's batching rule already
     # selects old-vs-new carry per lane from the full per-lane cond, so
@@ -604,14 +610,15 @@ def control(
     retry_cap = base.solve_retry_iters or base.max_iter
 
     def cond(carry):
-        *_, it, err, _buf, _okf, ok_last = carry
+        *_, it, err, _buf, _okf, ok_last, fail_count = carry
         # Solve failures keep the loop alive even at primal feasibility:
         # fallback values can satisfy the consensus equations trivially
         # while the failed agents' true solves still need retries (see the
         # matching note in cadmm.control's cond; bounded by
-        # solve_retry_iters, default the max_iter cap).
+        # solve_retry_iters (default 4) FAILING iterations, counted from
+        # failure onset).
         return (((err >= cfg.prim_inf_tol)
-                 | ((ok_last < 1.0) & (it <= retry_cap)))
+                 | ((ok_last < 1.0) & (fail_count <= retry_cap)))
                 & (it <= base.max_iter))
 
     err_buf0 = jnp.full((base.max_iter + 1,), jnp.nan, dtype)
@@ -619,9 +626,10 @@ def control(
         dd_state.f, dd_state.F, dd_state.M, dd_state.lam_F, dd_state.lam_M,
         dd_state.warm, jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype),
         err_buf0, jnp.ones((), dtype), jnp.ones((), dtype),
+        jnp.zeros((), jnp.int32),
     )
     (f, F, M, lam_F, lam_M, warm, iters, err, err_buf, ok_frac,
-     _ok_last) = lax.while_loop(cond, dd_iter, init)
+     _ok_last, _fail_count) = lax.while_loop(cond, dd_iter, init)
 
     new_state = DDState(f=f, F=F, M=M, lam_F=lam_F, lam_M=lam_M, warm=warm)
     collision = _max_over_agents(env_cbfs.collision.astype(jnp.int32)) > 0

@@ -47,6 +47,10 @@ class RPCADMMConfig:
     leader_idx: int = 0
     max_iter: int = struct.field(pytree_node=False, default=20)
     inner_iters: int = struct.field(pytree_node=False, default=20)
+    # Bound on FAILING consensus iterations (retries) per control step,
+    # counted from failure onset; 0 = up to max_iter. Same knob and
+    # default as RQPCADMMConfig.solve_retry_iters.
+    solve_retry_iters: int = struct.field(pytree_node=False, default=4)
     # Carry consensus duals across control steps. Default OFF: measured in
     # closed loop (circle track, tests/test_rp_cadmm.py), carried duals
     # drift — stale consensus prices at a moved reference bias the agent
@@ -65,6 +69,7 @@ def make_config(
     rho: float = 1.0,
     leader_idx: int = 0,
     carry_duals: bool = False,
+    solve_retry_iters: int = 4,
 ) -> RPCADMMConfig:
     """Distributed deltas vs the centralized config (mirroring the RQP
     reference's _set_controller_constants distributed scaling,
@@ -76,6 +81,7 @@ def make_config(
     return RPCADMMConfig(
         base=base, rho=rho, res_tol=res_tol, leader_idx=leader_idx,
         max_iter=max_iter, inner_iters=inner_iters, carry_duals=carry_duals,
+        solve_retry_iters=solve_retry_iters,
     )
 
 
@@ -223,7 +229,7 @@ def control(
     fallback = jnp.tile(f_eq[None], (n_local, 1, 1))
 
     def admm_iter(carry):
-        f, lam, f_mean, warm, it, res, okf, _ok_last = carry
+        f, lam, f_mean, warm, it, res, okf, _ok_last, fail_count = carry
         # Linear term: <lam_i, f> - rho <f_mean, f> on the force block.
         q = q0.at[:, 6:].add((lam - rho * f_mean[None]).reshape(n_local, -1))
         sols = solve_one(P_aug, q, A, lb, ub, shift, op, warm)
@@ -255,24 +261,30 @@ def control(
         )
         ok_last = _mean_over_agents(ok.astype(dtype))
         okf = jnp.minimum(okf, ok_last)
+        fail_count = fail_count + (ok_last < 1.0).astype(jnp.int32)
         return (f_new, lam_new, f_mean_new, warm_new, it + 1, res_new, okf,
-                ok_last)
+                ok_last, fail_count)
+
+    retry_cap = cfg.solve_retry_iters or cfg.max_iter
 
     def cond(carry):
-        *_, it, res, _okf, ok_last = carry
+        *_, it, res, _okf, ok_last, fail_count = carry
         # Solve failures keep the loop alive even at consensus agreement
-        # (see the matching note in cadmm.control's cond; bounded by the
-        # max_iter cap).
-        return ((res >= cfg.res_tol) | (ok_last < 1.0)) & (it <= cfg.max_iter)
+        # (see the matching note in cadmm.control's cond; bounded by
+        # solve_retry_iters (default 4) FAILING iterations from onset —
+        # warm starts persist across control steps too).
+        return (((res >= cfg.res_tol)
+                 | ((ok_last < 1.0) & (fail_count <= retry_cap)))
+                & (it <= cfg.max_iter))
 
     f_mean0 = _mean_over_agents(cstate.f)
     lam0 = cstate.lam if cfg.carry_duals else jnp.zeros_like(cstate.lam)
     init = (cstate.f, lam0, f_mean0, cstate.warm,
             jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype),
-            jnp.ones((), dtype), jnp.ones((), dtype))
-    f, lam, f_mean, warm, iters, res, ok_frac, _ok_last = lax.while_loop(
-        cond, admm_iter, init
-    )
+            jnp.ones((), dtype), jnp.ones((), dtype),
+            jnp.zeros((), jnp.int32))
+    (f, lam, f_mean, warm, iters, res, ok_frac, _ok_last,
+     _fail_count) = lax.while_loop(cond, admm_iter, init)
 
     # Agent i's own column of its copy (local rows index the GLOBAL agent
     # axis by agent_ids under sharding).

@@ -57,10 +57,11 @@ import jax.numpy as jnp
 from jax import lax
 
 EQ_RHO_SCALE = 1e3  # OSQP's rho boost for equality rows.
-# Row norms below this are treated as zero by equilibrate_rows (scale 1):
-# the smallest genuine row in the controller QPs is O(0.1) (translation
-# dynamics ~ payload mass), so 1e-3 cleanly separates real rows from
-# state-dependent rows passing through zero.
+# Smallest row norm equilibrate_rows will normalize by (scale cap 1/floor,
+# applied CONTINUOUSLY — see its docstring): the smallest genuine row in
+# the controller QPs is O(0.1) (translation dynamics ~ payload mass), so
+# rows below 1e-3 are state-dependent rows passing through zero whose
+# boost is capped rather than branched.
 _EQUILIBRATE_FLOOR = 1e-3
 INF = 1e20  # "infinity" bound; keeps arithmetic finite in f32... used via clipping.
 
@@ -406,25 +407,26 @@ def equilibrate_rows(A, lb, ub, shift, n_box: int, soc_dims):
     dynamics rows against O(0.1) translation rows — the RP QP family)
     measurably costs 5-15x in iterations to tolerance.
 
-    Returns ``(A', lb', ub', shift', scales (m,))``. Rows/blocks with norm
-    below ``_EQUILIBRATE_FLOOR`` keep scale 1: state-dependent rows can
-    legitimately pass through zero (e.g. a CBF row ``-2 wl @ dwl`` at
-    hover) and amplifying their numerical-noise direction to unit norm
-    would manufacture a garbage constraint with enormous bounds; such rows
-    are near-vacuous halfspaces and stay that way. Solutions/duals
-    downstream are in the scaled row space — callers that prebuild
+    Returns ``(A', lb', ub', shift', scales (m,))``. The scale is the
+    CONTINUOUS ``1 / max(norm, _EQUILIBRATE_FLOOR)``: state-dependent rows
+    can pass through zero between control steps (e.g. a CBF row
+    ``-2 wl @ dwl`` at hover), and a branchy floor would jump the row's
+    scale by orders of magnitude across consecutive steps, corrupting the
+    cross-step warm-start duals that live in the scaled row space; with
+    the continuous form the scale (and hence the warm duals' space) varies
+    smoothly with state, near-zero rows are boosted by at most 1/floor,
+    and their halfspaces stay vacuous. Callers that prebuild
     :func:`kkt_operator` must build it from the SCALED matrix (equilibrate
     at QP-build time, before the operator)."""
     m = A.shape[0]
     norms = jnp.linalg.norm(A, axis=-1)
     floor = _EQUILIBRATE_FLOOR
-    s = jnp.where(norms[:n_box] > floor,
-                  1.0 / jnp.maximum(norms[:n_box], floor), 1.0)
+    s = 1.0 / jnp.maximum(norms[:n_box], floor)
     scales = [s]
     off = n_box
     for dsoc in soc_dims:
         blk = jnp.max(norms[off:off + dsoc])
-        sb = jnp.where(blk > floor, 1.0 / jnp.maximum(blk, floor), 1.0)
+        sb = 1.0 / jnp.maximum(blk, floor)
         scales.append(jnp.full((dsoc,), sb, A.dtype))
         off += dsoc
     scales = jnp.concatenate(scales)
