@@ -63,9 +63,16 @@ def test_cadmm_k_smooth_full_and_reduced():
         params, col, _ = setup.rqp_setup(n)
         state = _state(n, seed=n)
         f_eq = centralized.equilibrium_forces(params)
+        # inner budget sized for the K_SMOOTH=10 anisotropy UNDER row
+        # equilibration: the unequilibrated builders' large equality-row
+        # norms acted as an accidental preconditioner for exactly this
+        # corner (A^T rho A dominated the smoothing cost's 100:1 P
+        # anisotropy); with unit rows the same QP needs ~300 inner
+        # iterations instead of ~80 — while every production-path QP got
+        # cheaper (see socp.equilibrate_rows).
         base = cadmm.make_config(
             params, col.collision_radius, col.max_deceleration,
-            max_iter=60, inner_iters=80, res_tol=1e-3,
+            max_iter=60, inner_iters=300, res_tol=1e-3,
         )
         a0 = cadmm.init_cadmm_state(params, base)
         f0, _, _ = cadmm.control(params, base, f_eq, a0, state, ACC)

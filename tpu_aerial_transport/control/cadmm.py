@@ -166,6 +166,7 @@ def make_config(
     socp_fused: str = "auto",
     inner_tol: float = 0.0,
     inner_check_every: int = 10,
+    solve_retry_iters: int = 4,
 ) -> RQPCADMMConfig:
     """Defaults are reference-conservative (max_iter mirrors the reference's
     100-iteration cap). For warm-started receding-horizon use, the measured
@@ -211,6 +212,7 @@ def make_config(
         socp_fused=socp.resolve_fused(socp_fused),
         inner_tol=inner_tol,
         inner_check_every=inner_check_every,
+        solve_retry_iters=solve_retry_iters,
     )
 
 
@@ -1064,7 +1066,9 @@ def control(
         # equilibrium-fallback path).
         ok_last = _mean_over_agents(ok_flat.astype(dtype))
         okf = jnp.minimum(okf, ok_last)
-        fail_count = fail_count + (ok_last < 1.0).astype(jnp.int32)
+        # CONSECUTIVE failing iterations: reset on fully-ok ones so a
+        # late-onset failure episode always gets the full retry budget.
+        fail_count = jnp.where(ok_last < 1.0, fail_count + 1, 0)
         return (f_new, lam_new, f_mean_new, sols, it, res_new, err_buf, okf,
                 ok_last, fail_count)
 

@@ -67,6 +67,7 @@ def make_config(
     socp_fused: str = "auto",
     inner_tol: float = 0.0,
     inner_check_every: int = 10,
+    solve_retry_iters: int = 4,
 ) -> RQPDDConfig:
     """Defaults are reference-conservative. For warm-started receding-horizon
     use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
@@ -79,6 +80,7 @@ def make_config(
         n_env_cbfs=n_env_cbfs, max_iter=max_iter, inner_iters=inner_iters,
         k_smooth=k_smooth, dt=dt, socp_fused=socp_fused,
         inner_tol=inner_tol, inner_check_every=inner_check_every,
+        solve_retry_iters=solve_retry_iters,
     )
     return RQPDDConfig(base=base, prim_inf_tol=prim_inf_tol)
 
@@ -598,7 +600,7 @@ def control(
         lam_M_new = jnp.where(do_dual, lam_M + step[:, 3:], lam_M)
         ok_last = _sum_over_agents(ok.astype(dtype)) / n
         okf = jnp.minimum(okf, ok_last)  # worst-iteration success fraction.
-        fail_count = fail_count + (ok_last < 1.0).astype(jnp.int32)
+        fail_count = jnp.where(ok_last < 1.0, fail_count + 1, 0)  # consecutive.
         return (f_new, F_new, M_new, lam_F_new, lam_M_new, warm_new, it,
                 err_new, err_buf, okf, ok_last, fail_count)
 

@@ -47,9 +47,9 @@ class RPCADMMConfig:
     leader_idx: int = 0
     max_iter: int = struct.field(pytree_node=False, default=20)
     inner_iters: int = struct.field(pytree_node=False, default=20)
-    # Bound on FAILING consensus iterations (retries) per control step,
-    # counted from failure onset; 0 = up to max_iter. Same knob and
-    # default as RQPCADMMConfig.solve_retry_iters.
+    # Bound on CONSECUTIVE failing consensus iterations (retries); 0 = up
+    # to max_iter. Same knob and default as
+    # RQPCADMMConfig.solve_retry_iters.
     solve_retry_iters: int = struct.field(pytree_node=False, default=4)
     # Carry consensus duals across control steps. Default OFF: measured in
     # closed loop (circle track, tests/test_rp_cadmm.py), carried duals
@@ -261,7 +261,7 @@ def control(
         )
         ok_last = _mean_over_agents(ok.astype(dtype))
         okf = jnp.minimum(okf, ok_last)
-        fail_count = fail_count + (ok_last < 1.0).astype(jnp.int32)
+        fail_count = jnp.where(ok_last < 1.0, fail_count + 1, 0)  # consecutive.
         return (f_new, lam_new, f_mean_new, warm_new, it + 1, res_new, okf,
                 ok_last, fail_count)
 

@@ -57,12 +57,6 @@ import jax.numpy as jnp
 from jax import lax
 
 EQ_RHO_SCALE = 1e3  # OSQP's rho boost for equality rows.
-# Smallest row norm equilibrate_rows will normalize by (scale cap 1/floor,
-# applied CONTINUOUSLY — see its docstring): the smallest genuine row in
-# the controller QPs is O(0.1) (translation dynamics ~ payload mass), so
-# rows below 1e-3 are state-dependent rows passing through zero whose
-# boost is capped rather than branched.
-_EQUILIBRATE_FLOOR = 1e-3
 INF = 1e20  # "infinity" bound; keeps arithmetic finite in f32... used via clipping.
 
 # What ``fused="auto"`` resolves to on a non-CPU backend. Stays "scan" until
@@ -408,25 +402,26 @@ def equilibrate_rows(A, lb, ub, shift, n_box: int, soc_dims):
     measurably costs 5-15x in iterations to tolerance.
 
     Returns ``(A', lb', ub', shift', scales (m,))``. The scale is the
-    CONTINUOUS ``1 / max(norm, _EQUILIBRATE_FLOOR)``: state-dependent rows
-    can pass through zero between control steps (e.g. a CBF row
-    ``-2 wl @ dwl`` at hover), and a branchy floor would jump the row's
-    scale by orders of magnitude across consecutive steps, corrupting the
-    cross-step warm-start duals that live in the scaled row space; with
-    the continuous form the scale (and hence the warm duals' space) varies
-    smoothly with state, near-zero rows are boosted by at most 1/floor,
-    and their halfspaces stay vacuous. Callers that prebuild
-    :func:`kkt_operator` must build it from the SCALED matrix (equilibrate
-    at QP-build time, before the operator)."""
+    CONTINUOUS ``1 / max(norm, 1)`` — it only ever scales DOWN:
+    normalizing the over-weighted rows (inertia-inverse-bearing dynamics,
+    norms 5-50) is where the measured conditioning win comes from, while
+    UP-scaling sub-unit rows would both (a) jump discontinuously for
+    state-dependent rows passing through zero between control steps,
+    corrupting cross-step warm duals that live in the scaled row space,
+    and (b) tighten the solver's absolute tolerance on near-vacuous rows
+    by the scale factor — measured: a tiny hover-state CBF row boosted
+    ~300x made its agents chronically miss solver_tol and rail the
+    consensus loop. Callers that prebuild :func:`kkt_operator` must build
+    it from the SCALED matrix (equilibrate at QP-build time, before the
+    operator)."""
     m = A.shape[0]
     norms = jnp.linalg.norm(A, axis=-1)
-    floor = _EQUILIBRATE_FLOOR
-    s = 1.0 / jnp.maximum(norms[:n_box], floor)
+    s = 1.0 / jnp.maximum(norms[:n_box], 1.0)
     scales = [s]
     off = n_box
     for dsoc in soc_dims:
         blk = jnp.max(norms[off:off + dsoc])
-        sb = 1.0 / jnp.maximum(blk, floor)
+        sb = 1.0 / jnp.maximum(blk, 1.0)
         scales.append(jnp.full((dsoc,), sb, A.dtype))
         off += dsoc
     scales = jnp.concatenate(scales)
