@@ -1,0 +1,70 @@
+"""Fault-injection demo: an n=4 C-ADMM transport team loses an agent
+mid-flight and degrades gracefully.
+
+Runs three rollouts of the same jit-compiled resilient harness —
+nominal, one agent killed at t = 1 s, and 30% consensus-message dropout —
+and prints a side-by-side summary (tracking error, fallback-ladder rung
+counts, quarantine). CPU-friendly:
+
+    JAX_PLATFORMS=cpu python examples/fault_injection.py
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tpu_aerial_transport import resilience
+from tpu_aerial_transport.control import cadmm, lowlevel
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.resilience import faults as faults_mod
+from tpu_aerial_transport.resilience.rollout import resilient_rollout
+
+N = 4
+N_HL_STEPS = 200  # 2 s at 100 Hz.
+
+
+def main():
+    params, col, state0 = setup.rqp_setup(N)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=15, inner_iters=20,
+    )
+    hl = resilience.make_cadmm_hl_step(params, cfg)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+    cs0 = cadmm.init_cadmm_state(params, cfg)
+
+    scenarios = {
+        "nominal": faults_mod.no_faults(N),
+        "agent 0 killed @ t=1s": faults_mod.make_schedule(
+            N, t_fail={0: 100}
+        ),
+        "30% consensus dropout": faults_mod.make_schedule(
+            N, drop_rate=0.3, drop_hold=5, key=jax.random.PRNGKey(7)
+        ),
+    }
+
+    mTg = float(params.mT) * rqp.GRAVITY
+    print(f"n={N} agents, payload weight mT*g = {mTg:.2f} N")
+    for name, sched in scenarios.items():
+        run = jax.jit(lambda s, c, f=sched: resilient_rollout(
+            hl, ll.control, params, s, c, n_hl_steps=N_HL_STEPS, faults=f
+        ))
+        final, _, logs = run(state0, cs0)
+        rungs = np.bincount(np.asarray(logs.fallback_rung), minlength=4)
+        fz_end = np.asarray(logs.f_des[-1, :, 2])
+        print(f"\n== {name} ==")
+        print(f"  max |x_err|      : {float(jnp.max(logs.x_err)):.3f} m")
+        print(f"  final |x_err|    : {float(logs.x_err[-1]):.3f} m")
+        print(f"  final fz per agent [N]: {np.round(fz_end, 2)}")
+        print(f"  sum fz / mT g    : {fz_end.sum() / mTg:.3f}")
+        print(f"  ladder rungs     : clean={rungs[0]} retry={rungs[1]} "
+              f"hold={rungs[2]} equilibrium={rungs[3]}")
+        print(f"  quarantined      : {bool(logs.quarantined[-1])}")
+
+
+if __name__ == "__main__":
+    main()

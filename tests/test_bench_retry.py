@@ -1,0 +1,92 @@
+"""tools/bench_retry.py: the retry/timeout/backoff harness must emit a
+structured, machine-readable record for every failure mode — wedged chip,
+absent chip, failing bench, healthy run — instead of a bare null."""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+from bench_retry import run_with_retries  # noqa: E402
+
+
+def _probe_ok(timeout_s=60):
+    return True, "BACKEND_OK fake 1"
+
+
+def _probe_wedged(timeout_s=60):
+    return False, f"timeout after {timeout_s}s (chip unreachable/wedged)"
+
+
+def _probe_absent(timeout_s=60):
+    return False, "probe rc=1: ModuleNotFoundError: no accelerator plugin"
+
+
+def test_ok_run_forwards_result_json():
+    cmd = [sys.executable, "-c",
+           "import json; print('noise'); "
+           "print(json.dumps({'metric': 'm', 'value': 1.5}))"]
+    rec = run_with_retries(cmd, attempts=2, timeout_s=30, backoff_s=0.0,
+                           probe_fn=_probe_ok)
+    assert rec["classification"] == "ok"
+    assert rec["result"] == {"metric": "m", "value": 1.5}
+    assert rec["probe_count"] == 1
+    assert rec["attempts"][0]["ok"] is True
+    json.dumps(rec)  # the whole record must be JSON-serializable.
+
+
+def test_wedged_chip_classified_and_counted():
+    rec = run_with_retries([sys.executable, "-c", "pass"], attempts=3, timeout_s=5, backoff_s=0.0,
+                           probe_fn=_probe_wedged)
+    assert rec["classification"] == "wedged"
+    assert rec["probe_count"] == 3  # kept retrying: wedged may recover.
+    assert "timeout" in rec["last_error"]
+    assert len(rec["attempts"]) == 3
+    json.dumps(rec)
+
+
+def test_absent_chip_fails_fast():
+    rec = run_with_retries([sys.executable, "-c", "pass"], attempts=5, timeout_s=5, backoff_s=0.0,
+                           probe_fn=_probe_absent)
+    assert rec["classification"] == "absent"
+    assert rec["probe_count"] == 1  # no chip to wait for: no retries.
+    assert "plugin" in rec["last_error"]
+    json.dumps(rec)
+
+
+def test_failing_bench_records_stderr_tail():
+    cmd = [sys.executable, "-c",
+           "import sys; print('boom-detail', file=sys.stderr); sys.exit(3)"]
+    rec = run_with_retries(cmd, attempts=2, timeout_s=30, backoff_s=0.0,
+                           probe_fn=_probe_ok)
+    assert rec["classification"] == "failed"
+    assert rec["probe_count"] == 2
+    assert "rc=3" in rec["last_error"]
+    assert "boom-detail" in rec["last_error"]
+    json.dumps(rec)
+
+
+def test_hung_bench_classified_wedged():
+    cmd = [sys.executable, "-c", "import time; time.sleep(60)"]
+    rec = run_with_retries(cmd, attempts=1, timeout_s=1, backoff_s=0.0,
+                           probe_fn=_probe_ok)
+    assert rec["classification"] == "wedged"
+    assert "timed out" in rec["last_error"]
+    json.dumps(rec)
+
+
+def test_fast_failure_mentioning_timeout_is_still_absent():
+    """Classification keys on probe()'s structured 'timeout after' prefix,
+    not a substring: a fast rc!=0 failure whose stderr mentions a timeout
+    (e.g. an rpc DEADLINE_EXCEEDED) is an ABSENT chip — retrying with
+    backoff cannot help."""
+    def probe_rpc(timeout_s=60):
+        return False, "probe rc=1: DEADLINE_EXCEEDED: rpc timeout"
+
+    rec = run_with_retries([sys.executable, "-c", "pass"], attempts=5,
+                           timeout_s=5, backoff_s=0.0, probe_fn=probe_rpc)
+    assert rec["classification"] == "absent"
+    assert rec["probe_count"] == 1

@@ -1,0 +1,107 @@
+"""Fallback-ladder property tests: a forced-divergent high-level solve must
+walk the rungs in order (clean -> retry -> hold-previous -> equilibrium) and
+never feed non-finite forces to the physics."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_aerial_transport.control import centralized, lowlevel
+from tpu_aerial_transport.control.types import SolverStats
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.resilience.rollout import (
+    RUNG_CLEAN,
+    RUNG_EQUILIBRIUM,
+    RUNG_HOLD,
+    RUNG_RETRY,
+    resilient_rollout,
+)
+
+
+def _stats(ok_frac):
+    return SolverStats(
+        iters=jnp.zeros((), jnp.int32),
+        solve_res=jnp.zeros(()),
+        collision=jnp.zeros((), bool),
+        min_env_dist=jnp.zeros(()),
+        ok_frac=jnp.asarray(ok_frac, jnp.float32),
+    )
+
+
+def _run_scripted(script_fdes, script_okfrac, n_steps):
+    """Roll out with a scripted stub controller: at step i it returns
+    ``script_fdes(i, f_eq)`` and reports ``script_okfrac(i)``."""
+    params, _, state0 = setup.rqp_setup(3)
+    f_eq = centralized.equilibrium_forces(params)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+
+    def hl_step(cs, state, acc_des, health=None):
+        i = cs
+        return script_fdes(i, f_eq), i + 1, _stats(script_okfrac(i))
+
+    final, _, logs = jax.jit(
+        lambda s, c: resilient_rollout(
+            hl_step, ll.control, params, s, c, n_hl_steps=n_steps
+        )
+    )(state0, jnp.zeros((), jnp.int32))
+    return params, f_eq, final, logs
+
+
+def test_ladder_walks_rungs_in_order():
+    """Scripted failure sequence: clean, internal-retry, NaN (hold), clean
+    again — the logged rungs must be exactly [0, 1, 2, 0] and the held step
+    must reuse the previous step's applied force."""
+    nan = jnp.nan
+
+    def fdes(i, f_eq):
+        good = f_eq * (1.0 + 0.01 * i.astype(f_eq.dtype))
+        return jnp.where(i == 2, jnp.full_like(f_eq, nan), good)
+
+    def okf(i):
+        return jnp.where(i == 1, 0.5, 1.0)
+
+    params, f_eq, final, logs = _run_scripted(fdes, okf, 4)
+    assert [int(r) for r in logs.fallback_rung] == [
+        RUNG_CLEAN, RUNG_RETRY, RUNG_HOLD, RUNG_CLEAN
+    ]
+    # The held step re-applied step 1's force, not the NaNs.
+    assert bool(jnp.all(jnp.isfinite(logs.f_des)))
+    assert float(jnp.abs(logs.f_des[2] - logs.f_des[1]).max()) == 0.0
+    # Physics never saw a non-finite wrench.
+    assert bool(jnp.all(jnp.isfinite(logs.xl)))
+    assert bool(jnp.all(jnp.isfinite(final.xl)))
+
+
+def test_ladder_bottom_rung_equilibrium_on_first_step():
+    """A solver that diverges from the very first step (no previous force to
+    hold) must land on the equilibrium rung, then hold it afterwards."""
+
+    def fdes(i, f_eq):
+        return jnp.full_like(f_eq, jnp.nan)
+
+    def okf(i):
+        return jnp.ones(())
+
+    params, f_eq, final, logs = _run_scripted(fdes, okf, 3)
+    rungs = [int(r) for r in logs.fallback_rung]
+    assert rungs[0] == RUNG_EQUILIBRIUM
+    assert rungs[1:] == [RUNG_HOLD, RUNG_HOLD]
+    # Step 0 applied exactly the equilibrium forces; later steps held them.
+    assert float(jnp.abs(logs.f_des[0] - f_eq).max()) == 0.0
+    assert float(jnp.abs(logs.f_des[1] - f_eq).max()) == 0.0
+    assert bool(jnp.all(jnp.isfinite(final.xl)))
+
+
+def test_ladder_counts_internal_retries():
+    """ok_frac < 1 with finite forces is the retry rung — forces pass
+    through unchanged (the controller already substituted its own internal
+    fallback)."""
+
+    def fdes(i, f_eq):
+        return f_eq * 1.01
+
+    def okf(i):
+        return jnp.full((), 0.75)
+
+    params, f_eq, final, logs = _run_scripted(fdes, okf, 2)
+    assert [int(r) for r in logs.fallback_rung] == [RUNG_RETRY, RUNG_RETRY]
+    assert float(jnp.abs(logs.f_des[0] - f_eq * 1.01).max()) == 0.0

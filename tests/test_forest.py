@@ -222,3 +222,49 @@ def test_cbf_rows_protective_deep_penetration_and_at_rest():
     assert act.any(), "at-rest contact must keep its near row"
     r = int(np.argmax(act))
     assert lhs[r, 0] < 0 and rhs[r] > 0, (lhs[r], rhs[r])
+
+
+def test_cbf_row_survives_exact_axis_surface_contact():
+    """Exact axis-surface contact (dist_axis == 0.0, surface witnesses
+    coincident): the outward normal must fall back to the radial direction
+    from the tree axis instead of vanishing — ``-sign(dist_axis)`` used to
+    zero the protecting row at the worst possible moment (ISSUE 1
+    satellite)."""
+    tree = jnp.array([[1.0, 0.0, 2.0]])
+    forest = fo.forest_from_tree_pos(np.asarray(tree), 1)
+    # Point capsule exactly bark_radius from the tree axis: dist_axis == 0.
+    xl = jnp.array([1.0 - fo.BARK_RADIUS, 0.0, 2.0], jnp.float32)
+    data = fo.capsule_forest_distance(forest, xl, xl, 0.9, 6.0)
+    # Exact contact by construction (f32: dist_axis - cap_radius == -0.9).
+    assert np.float32(data.dists[0]) + np.float32(0.9) == np.float32(0.0)
+    n0 = np.asarray(data.normal_out[0])
+    assert abs(np.linalg.norm(n0) - 1.0) < 1e-5, n0  # unit, not zeroed.
+    assert n0[0] < -0.99, n0  # outward = -x (tree is at +x).
+
+    # End-to-end: the CBF row stays active and protective.
+    cbf = fo.collision_cbf_rows(
+        forest, xl, jnp.zeros(3), collision_radius=0.9,
+        max_deceleration=2.0, vision_radius=6.0, dist_eps=0.1,
+        alpha_env_cbf=1.5, n_rows=4,
+    )
+    lhs, rhs = np.asarray(cbf.lhs), np.asarray(cbf.rhs)
+    act = np.abs(lhs).max(axis=1) > 0
+    assert act.any(), "exact contact must keep its protecting row"
+    r = int(np.argmax(act))
+    assert lhs[r, 0] < 0 and rhs[r] > 0, (lhs[r], rhs[r])
+
+
+def test_cbf_normal_vertical_at_exact_cap_contact():
+    """Exact contact on a tree's flat TOP CAP: the fallback normal must be
+    the signed vertical (+z above the cap), not the horizontal radial — a
+    sideways row would constrain motion in a direction that does not clear
+    the cap."""
+    tree = jnp.array([[1.0, 0.0, 2.0]])  # cylinder z in [0, 4].
+    forest = fo.forest_from_tree_pos(np.asarray(tree), 1)
+    # Point capsule exactly on the top cap (z = 4), inside the radius.
+    xl = jnp.array([1.1, 0.0, 4.0], jnp.float32)
+    data = fo.capsule_forest_distance(forest, xl, xl, 0.9, 6.0)
+    assert np.float32(data.dists[0]) + np.float32(0.9) == np.float32(0.0)
+    n0 = np.asarray(data.normal_out[0])
+    assert abs(np.linalg.norm(n0) - 1.0) < 1e-5, n0
+    assert n0[2] > 0.99, n0  # outward = +z off the cap.

@@ -1,0 +1,120 @@
+"""NaN-quarantine tests: a diverging Monte-Carlo lane is frozen and flagged
+while every other lane's logs and the masked aggregate statistics stay
+bit-identical to a batch without the diverging lane."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_aerial_transport import resilience
+from tpu_aerial_transport.control import cadmm, lowlevel
+from tpu_aerial_transport.envs import forest as forest_mod
+from tpu_aerial_transport.harness import bucketing, setup
+from tpu_aerial_transport.resilience import faults as faults_mod
+from tpu_aerial_transport.resilience.quarantine import (
+    tree_all_finite,
+    tree_where,
+)
+from tpu_aerial_transport.resilience.rollout import resilient_rollout
+from tpu_aerial_transport.utils import stats as stats_mod
+
+
+def test_tree_all_finite_and_where():
+    good = {"a": jnp.ones(3), "b": jnp.zeros((), jnp.int32)}
+    bad = {"a": jnp.array([1.0, jnp.nan, 0.0]), "b": jnp.ones((), jnp.int32)}
+    assert bool(tree_all_finite(good))
+    assert not bool(tree_all_finite(bad))  # int leaves ignored, NaN caught.
+    sel = tree_where(jnp.zeros((), bool), bad, good)
+    assert bool(tree_all_finite(sel))
+
+
+def test_masked_aggregate_statistics():
+    a = jnp.array([[1.0, 2.0], [jnp.nan, jnp.inf], [3.0, 4.0]])
+    valid = jnp.array([True, False, True])
+    mn, mx, avg, std = stats_mod.compute_aggregate_statistics(a, 0, valid)
+    np.testing.assert_allclose(np.asarray(mn), [1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(mx), [3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(avg), [2.0, 3.0])
+    np.testing.assert_allclose(np.asarray(std), [1.0, 1.0])
+    # Unmasked path unchanged (and poisoned, as expected).
+    _, _, avg_all, _ = stats_mod.compute_aggregate_statistics(a, 0)
+    assert not bool(jnp.isfinite(avg_all[0]))
+
+
+def test_bucketing_metric_quarantine_guard():
+    forest = forest_mod.make_forest(seed=0)
+    metric = bucketing.quarantine_guarded_metric(
+        bucketing.env_congestion_metric(forest, vision_radius=8.0)
+    )
+    _, _, state = setup.rqp_setup(3)
+    good = state.replace(xl=jnp.array([5.0, 0.0, 1.5]))
+    bad = state.replace(xl=jnp.array([jnp.nan, 0.0, 1.5]))
+    assert int(metric(good)) >= 0
+    assert int(metric(bad)) == -1
+
+
+def _batched_rollout(n=4, batch=3, n_steps=12):
+    params, col, state0 = setup.rqp_setup(n)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=6, inner_iters=15,
+    )
+    hl = resilience.make_cadmm_hl_step(params, cfg)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+    cs0 = cadmm.init_cadmm_state(params, cfg)
+
+    def run(scheds):
+        return jax.jit(jax.vmap(
+            lambda f: resilient_rollout(
+                hl, ll.control, params, state0, cs0, n_hl_steps=n_steps,
+                faults=f,
+            )
+        ))(scheds)
+
+    return params, run
+
+
+def _stack_schedules(scheds):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *scheds)
+
+
+def test_nan_lane_is_quarantined_and_others_bit_identical():
+    """Lane 1's actuator gain blows up to +inf mid-rollout (direct physics
+    NaN injection, past the fallback ladder); the lane must freeze with its
+    sticky flag raised while lanes 0 and 2 produce BIT-IDENTICAL logs to a
+    batch whose lane 1 is benign — the quarantine keeps the divergence from
+    leaking across the vmap."""
+    n, B = 4, 3
+    params, run = _batched_rollout(n=n, batch=B)
+    benign = [faults_mod.make_schedule(n, key=jax.random.PRNGKey(k))
+              for k in range(B)]
+    killer = faults_mod.make_schedule(
+        n, t_degrade={0: 5}, thrust_scale=jnp.inf,
+        key=jax.random.PRNGKey(1),
+    )
+    batch_bad = _stack_schedules([benign[0], killer, benign[2]])
+    batch_good = _stack_schedules(benign)
+
+    _, _, logs_bad = run(batch_bad)
+    _, _, logs_good = run(batch_good)
+
+    # The poisoned lane froze and flagged instead of emitting NaN physics.
+    assert bool(jnp.any(logs_bad.quarantined[1]))
+    q_from = int(jnp.argmax(logs_bad.quarantined[1]))
+    frozen = logs_bad.xl[1, q_from:]
+    assert bool(jnp.all(frozen == frozen[0:1]))
+    # Other lanes: every logged leaf bit-identical to the all-benign batch.
+    for name in ("xl", "vl", "Rl", "wl", "R", "w", "f_des", "x_err",
+                 "v_err", "iters", "solve_res", "fallback_rung"):
+        a = np.asarray(getattr(logs_bad, name))[[0, 2]]
+        b = np.asarray(getattr(logs_good, name))[[0, 2]]
+        assert np.array_equal(a, b), f"lane leakage in {name}"
+    assert not bool(jnp.any(logs_bad.quarantined[jnp.array([0, 2])]))
+
+    # Masked aggregates over the final tracking error exclude the NaN lane.
+    x_err_final = logs_bad.x_err[:, -1]
+    valid = ~logs_bad.quarantined[:, -1]
+    mn, mx, avg, std = stats_mod.compute_aggregate_statistics(
+        x_err_final, 0, valid
+    )
+    assert all(bool(jnp.isfinite(v)) for v in (mn, mx, avg, std))

@@ -1,0 +1,182 @@
+"""Fault-schedule semantics, per-step controller graceful degradation, and
+the zero-cost-when-disabled guarantee (identical HLO with
+``FaultSchedule.none``-style ``no_faults``)."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_aerial_transport import resilience
+from tpu_aerial_transport.control import cadmm, centralized, dd, lowlevel
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.resilience import faults as faults_mod
+from tpu_aerial_transport.resilience.rollout import resilient_rollout
+
+GRAVITY = rqp.GRAVITY
+
+
+def test_schedule_evaluation_semantics():
+    n = 4
+    sched = faults_mod.make_schedule(
+        n,
+        t_fail={1: 10},
+        t_degrade={2: 5},
+        thrust_scale=0.6,
+        drop_rate=0.5,
+        drop_hold=3,
+        key=jax.random.PRNGKey(0),
+    )
+    h0 = faults_mod.fault_step(sched, 0)
+    assert bool(jnp.all(h0.alive))
+    assert float(h0.thrust_scale[2]) == 1.0  # not yet degraded.
+    h7 = faults_mod.fault_step(sched, 7)
+    assert abs(float(h7.thrust_scale[2]) - 0.6) < 1e-6  # degraded from 5.
+    assert bool(h7.alive[1])
+    h12 = faults_mod.fault_step(sched, 12)
+    assert not bool(h12.alive[1])  # dead from step 10.
+    assert float(h12.thrust_scale[1]) == 0.0
+    assert not bool(h12.msg_ok[1])  # the dead never transmit.
+    # Dropout draws are constant within each drop_hold block (staleness
+    # window) and deterministic under replay.
+    for t0 in (0, 3, 6):  # block starts before any agent dies at 10.
+        block = [faults_mod.fault_step(sched, t0 + k).msg_ok for k in range(3)]
+        for b in block[1:]:
+            assert bool(jnp.all(b == block[0]))
+    again = faults_mod.fault_step(sched, 7)
+    assert bool(jnp.all(again.msg_ok == h7.msg_ok))
+
+
+def test_masked_equilibrium_redistributes():
+    params, _, _ = setup.rqp_setup(4)
+    alive = jnp.array([False, True, True, True])
+    f_eq = centralized.equilibrium_forces(params, alive)
+    mTg = float(params.mT) * GRAVITY
+    assert float(jnp.abs(f_eq[0]).max()) == 0.0  # dead agent carries nothing.
+    assert abs(float(jnp.sum(f_eq[:, 2])) - mTg) < 1e-3 * mTg
+    # Healthy mask reproduces the nominal distribution.
+    f_all = centralized.equilibrium_forces(params, jnp.ones(4, bool))
+    f_nom = centralized.equilibrium_forces(params)
+    assert float(jnp.abs(f_all - f_nom).max()) < 1e-4
+
+
+def test_lowlevel_thrust_scale_and_zero_fdes_guard():
+    params, _, state = setup.rqp_setup(3)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+    f_des = jnp.tile(jnp.array([0.0, 0.0, 5.0]), (3, 1))
+    scale = jnp.array([0.0, 0.5, 1.0])
+    f, M = ll.control(state, f_des, scale)
+    assert float(jnp.abs(f[0])) == 0.0 and float(jnp.abs(M[0]).max()) == 0.0
+    assert abs(float(f[1]) - 2.5) < 1e-5
+    assert abs(float(f[2]) - 5.0) < 1e-5
+    # Zero desired force (a dead agent's masked command) must not emit NaNs.
+    f2, M2 = ll.control(state, f_des.at[0].set(0.0))
+    assert bool(jnp.all(jnp.isfinite(f2))) and bool(jnp.all(jnp.isfinite(M2)))
+
+
+def _one_step(mod, make_cfg, init_state, n=4, health=None):
+    params, col, state = setup.rqp_setup(n)
+    cfg = make_cfg(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=10, inner_iters=20,
+    )
+    alive = None if health is None else health.alive
+    f_eq = centralized.equilibrium_forces(params, alive)
+    cs = init_state(params, cfg)
+    acc_des = (jnp.array([0.2, 0.0, 0.0]), jnp.zeros(3))
+    f, cs, stats = mod.control(
+        params, cfg, f_eq, cs, state, acc_des, health=health
+    )
+    return params, f, stats
+
+
+def test_cadmm_health_step_dead_agent():
+    n = 4
+    sched = faults_mod.make_schedule(n, t_fail={0: 0})
+    health = faults_mod.fault_step(sched, 0)
+    params, f, stats = _one_step(
+        cadmm, cadmm.make_config, cadmm.init_cadmm_state, n, health
+    )
+    assert bool(jnp.all(jnp.isfinite(f)))
+    assert float(jnp.abs(f[0]).max()) == 0.0  # the corpse applies nothing.
+    mTg = float(params.mT) * GRAVITY
+    tot = float(jnp.sum(f[1:, 2]))
+    assert 0.7 * mTg < tot < 1.3 * mTg  # survivors carry the payload.
+
+
+def test_dd_health_step_dead_agent():
+    n = 4
+    sched = faults_mod.make_schedule(n, t_fail={0: 0})
+    health = faults_mod.fault_step(sched, 0)
+    params, f, stats = _one_step(
+        dd, dd.make_config, dd.init_dd_state, n, health
+    )
+    assert bool(jnp.all(jnp.isfinite(f)))
+    assert float(jnp.abs(f[0]).max()) == 0.0
+    mTg = float(params.mT) * GRAVITY
+    tot = float(jnp.sum(f[1:, 2]))
+    assert 0.7 * mTg < tot < 1.3 * mTg
+
+
+def test_disabled_faults_compile_to_identical_hlo():
+    """The acceptance bar for zero-cost fault support: the nominal rollout
+    and a ``no_faults`` rollout lower to the SAME HLO (``active`` is static
+    and every fault branch is Python-level)."""
+    n = 4
+    params, col, state0 = setup.rqp_setup(n)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=4, inner_iters=10,
+    )
+    hl = resilience.make_cadmm_hl_step(params, cfg)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+    cs0 = cadmm.init_cadmm_state(params, cfg)
+    sched = faults_mod.no_faults(n)
+
+    def run(faults):
+        return jax.jit(
+            lambda s, c: resilient_rollout(
+                hl, ll.control, params, s, c, n_hl_steps=3, faults=faults
+            )
+        ).lower(state0, cs0).as_text()
+
+    assert run(None) == run(sched)
+
+
+def test_dropout_holds_last_delivered_snapshot_across_steps():
+    """Staleness is LAST-DELIVERED, not one-step-delayed: across a multi-
+    step dropout window, the peers' view of the dropped agent (the ``held``
+    snapshot) stays frozen at its last delivered copy even though the agent
+    keeps iterating locally."""
+    n = 4
+    params, col, state = setup.rqp_setup(n)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=8, inner_iters=15,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    cs = cadmm.init_cadmm_state(params, cfg).replace(
+        held=jnp.tile(f_eq, (n, 1, 1))
+    )
+    acc = (jnp.array([0.3, 0.0, 0.0]), jnp.zeros(3))
+    alive = jnp.ones(n, bool)
+    ok_all = faults_mod.FaultStep(
+        alive=alive, thrust_scale=jnp.ones(n), msg_ok=alive
+    )
+    drop0 = ok_all.replace(msg_ok=alive.at[0].set(False))
+
+    # Step A: everything delivered -> held == the published copies.
+    _, csA, _ = cadmm.control(params, cfg, f_eq, cs, state, acc, health=ok_all)
+    assert bool(jnp.all(csA.held == csA.f))
+    snapshot = csA.held[0]
+
+    # Steps B, C: agent 0 dropped while the problem moves (new acc target).
+    acc2 = (jnp.array([0.0, 0.4, 0.1]), jnp.zeros(3))
+    _, csB, _ = cadmm.control(params, cfg, f_eq, csA, state, acc2, health=drop0)
+    _, csC, _ = cadmm.control(params, cfg, f_eq, csB, state, acc2, health=drop0)
+    # Agent 0 kept iterating locally...
+    assert float(jnp.abs(csC.f[0] - snapshot).max()) > 1e-5
+    # ...but its held snapshot (what the peers consume) never moved.
+    assert bool(jnp.all(csB.held[0] == snapshot))
+    assert bool(jnp.all(csC.held[0] == snapshot))
+    # Delivered agents' snapshots track their fresh copies.
+    assert bool(jnp.all(csC.held[1:] == csC.f[1:]))

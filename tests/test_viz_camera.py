@@ -1,0 +1,34 @@
+"""viz.scene.smooth_camera_track: a user-supplied EVEN window on a long
+trajectory must be coerced odd instead of raising inside savgol_filter at
+render time (ISSUE 1 satellite)."""
+
+import numpy as np
+
+from tpu_aerial_transport.viz.scene import smooth_camera_track
+
+
+def _traj(T):
+    t = np.linspace(0.0, 1.0, T)
+    return np.stack([t, np.sin(4 * t), 0.1 * t], axis=-1)
+
+
+def test_even_window_on_long_trajectory():
+    xl = _traj(400)
+    out = smooth_camera_track(xl, window=50)  # even, < T: used to raise.
+    assert out.shape == xl.shape
+    assert np.all(np.isfinite(out))
+    # Still an actual smoothing (not a passthrough).
+    assert not np.allclose(out, xl)
+
+
+def test_window_clamped_to_short_trajectory():
+    xl = _traj(20)
+    out = smooth_camera_track(xl, window=51)  # window > T: clamp path.
+    assert out.shape == xl.shape
+    assert np.all(np.isfinite(out))
+
+
+def test_tiny_trajectory_passthrough():
+    xl = _traj(4)
+    out = smooth_camera_track(xl, window=6)
+    assert np.array_equal(out, xl)

@@ -172,7 +172,20 @@ def make_config(
     100-iteration cap). For warm-started receding-horizon use, the measured
     inner-iteration knee is ~20 (below it the agent solves miss ``solver_tol``
     and trip the equilibrium fallback; at 20 forces match an inner=80 solve to
-    < 1e-4 N) — see bench.py / BASELINE.md."""
+    < 1e-4 N) — see bench.py / BASELINE.md.
+
+    **k_smooth x row-equilibration interaction** (measured,
+    tests/test_ksmooth.py:75): with ``k_smooth > 0`` the smoothing cost adds
+    a ~100:1 anisotropy to the force block of P. The UNequilibrated builders'
+    large equality-row norms used to act as an accidental preconditioner for
+    exactly that corner (A^T rho A dominated the anisotropy); with exact
+    row equilibration (unit-norm rows — cheaper for every production-path
+    QP) the same smoothed QP needs ~300 inner iterations to ``solver_tol``
+    instead of ~80. Budget accordingly: keep the default
+    ``inner_iters``/knee (~20) only while ``k_smooth == 0`` (the reference
+    default); when enabling smoothing, raise ``inner_iters`` to >= 300 (or
+    set ``inner_tol > 0`` so converged solves exit early and only the
+    smoothed corner pays the deep budget)."""
     n = params.n
     mTg = float(params.mT) * GRAVITY
     return RQPCADMMConfig(
@@ -283,10 +296,19 @@ class CADMMState:
     """Distributed-solver state carried across control steps (reference
     ``_set_variables`` + ``_set_warm_start``, :569-580)."""
 
-    f: jnp.ndarray  # (n, n, 3): f[i, j] = agent i's copy of agent j's force.
+    f: jnp.ndarray  # (n, n, 3): f[i, j] = agent i's copy of all forces.
     lam: jnp.ndarray  # (n, n, 3) duals.
     f_mean: jnp.ndarray  # (n, 3) consensus mean.
     warm: socp.SOCPSolution  # leading agent axis on every leaf.
+    # Last DELIVERED copy per agent (resilience layer only; None in nominal
+    # use so the nominal pytree/HLO are unchanged): under consensus-message
+    # dropout the peers keep consuming this snapshot — frozen at the end of
+    # the agent's last delivered step — for the whole dropout window,
+    # instead of a merely one-step-delayed view of its undelivered
+    # iterates. Initialized by the resilience rollout adapters
+    # (prepare_ctrl_state); a direct ``control(health=...)`` call with
+    # ``held=None`` falls back to ``f`` (correct at the first step).
+    held: jnp.ndarray | None = None
 
 
 def init_cadmm_state(params: RQPParams, cfg: RQPCADMMConfig) -> CADMMState:
@@ -842,9 +864,27 @@ def control(
     forest: forest_mod.Forest | None = None,
     axis_name: str | None = None,
     plan: SchurPlan | None = None,
+    health=None,
 ):
     """One distributed control step: ``-> (f_app (n_local, 3), CADMMState,
     SolverStats)`` (reference ``RQPCADMMController.control``, :631-675).
+
+    ``health``: optional :class:`resilience.faults.FaultStep` (needs
+    ``.alive``/``.msg_ok``, both global (n,) bool). With it, the consensus
+    degrades gracefully instead of assuming every agent healthy:
+
+    - **dead agents** (``~alive``): their columns are zeroed in every local
+      copy (so the survivors' dynamics equalities redistribute the payload
+      load), their own copy rows / duals / warm starts are frozen, their
+      solves never trigger retries, and their applied force is zero;
+    - **dropped messages** (``alive & ~msg_ok``): the agent's copy is
+      masked out of the consensus mean and residual for this step — the
+      other agents hold its LAST delivered value (the step-start copy)
+      while the dropped agent keeps iterating locally;
+    - the consensus mean divides by the number of ALIVE agents, not n.
+
+    ``health=None`` (the default) compiles the exact nominal program —
+    fault support is zero-cost when unused.
 
     ``plan``: optional precomputed :func:`make_schur_plan` for the reduced
     (n >= 4) formulation, covering exactly this call's local agents. When
@@ -887,6 +927,30 @@ def control(
 
     env_cbfs = agent_env_cbfs_for(params, cfg, forest, state, r_local)
     leaders = (agent_ids == cfg.leader_idx).astype(dtype)
+
+    if health is not None:
+        # Graceful-degradation masks (see the docstring). All (n,) leaves
+        # are global/replicated; local slices follow agent_ids.
+        alive_l = jnp.take(health.alive, agent_ids, axis=0)
+        msg_ok_l = jnp.take(health.msg_ok, agent_ids, axis=0)
+        w_alive = alive_l.astype(dtype)  # (n_local,)
+        contrib = alive_l & msg_ok_l  # copies entering mean/residual fresh.
+        alive_cols = health.alive.astype(dtype)  # (n,) global column mask.
+        n_alive = jnp.sum(w_alive)
+        if axis_name is not None:
+            n_alive = lax.psum(n_alive, axis_name)
+        n_alive = jnp.maximum(n_alive, 1.0)
+        # Dead agents anchor to zero force (callers typically already pass
+        # the alive-masked equilibrium_forces; the mask is idempotent).
+        f_eq = f_eq * alive_cols[:, None]
+        # Peers' view of a dropped agent: its last DELIVERED copy (the
+        # ``held`` snapshot, frozen across the whole dropout window), with
+        # dead agents' columns zeroed so a held pre-death snapshot cannot
+        # re-inject a dead agent's force into the masked mean.
+        f_stale = (
+            admm_state.held if admm_state.held is not None else admm_state.f
+        ) * alive_cols[None, :, None]
+
     use_reduced = _use_reduced(cfg, n)
 
     if use_reduced:
@@ -1033,6 +1097,12 @@ def control(
             jnp.isfinite(f_new), axis=(1, 2), keepdims=True
         )
         f_new = jnp.where(ok, f_new, f_eq[None, :, :])
+        if health is not None:
+            # Dead agents: zero their columns in every survivor's copy (the
+            # dynamics equalities then redistribute the load) and freeze
+            # their own rows at the last pre-death copy.
+            f_new = f_new * alive_cols[None, :, None]
+            f_new = jnp.where(alive_l[:, None, None], f_new, f)
         # Warm starts keep any FINITE iterate — including tolerance-missed
         # ones: a hard agent QP (e.g. a strongly active near-contact env
         # CBF row) then accumulates inner iterations across consensus
@@ -1041,6 +1111,10 @@ def control(
         # every later solve) revert.
         ok_flat = ok[:, 0, 0]
         finite_flat = socp.solution_is_finite(sols)
+        if health is not None:
+            # Corpses never trigger retries and keep frozen warm starts.
+            ok_flat = ok_flat | ~alive_l
+            finite_flat = finite_flat & alive_l
         sols = jax.tree.map(
             lambda new, old: jnp.where(
                 finite_flat.reshape((n_local,) + (1,) * (new.ndim - 1)),
@@ -1050,8 +1124,26 @@ def control(
         )
         # Consensus all-reduce: mean + inf-norm residual (psum/pmax over the
         # mesh axis when agents are sharded).
-        f_mean_new = _mean_over_agents(f_new)
-        res_new = _max_over_agents(jnp.abs(f_new - f_mean_new[None, :, :]))
+        if health is None:
+            f_mean_new = _mean_over_agents(f_new)
+            res_new = _max_over_agents(
+                jnp.abs(f_new - f_mean_new[None, :, :])
+            )
+        else:
+            # Masked consensus: dropped agents contribute their HELD copy,
+            # dead agents contribute nothing, and the mean divides by the
+            # alive count. The residual measures agreement of the FRESH
+            # delivered copies only (a permanently-dropped agent's stale
+            # copy is expected to disagree — it must not stall the loop).
+            f_eff = jnp.where(msg_ok_l[:, None, None], f_new, f_stale)
+            s = jnp.sum(f_eff * w_alive[:, None, None], axis=0)
+            if axis_name is not None:
+                s = lax.psum(s, axis_name)
+            f_mean_new = s / n_alive
+            res_new = _max_over_agents(jnp.where(
+                contrib[:, None, None],
+                jnp.abs(f_eff - f_mean_new[None, :, :]), 0.0,
+            ))
         err_buf = err_buf.at[it].set(res_new)
         it = it + 1
         # Dual update, gated exactly like the reference's loop (:655-665):
@@ -1062,6 +1154,9 @@ def control(
         lam_new = jnp.where(
             do_dual, lam + rho_at(it) * (f_new - f_mean_new[None, :, :]), lam
         )
+        if health is not None:
+            # Frozen duals for dead agents.
+            lam_new = jnp.where(alive_l[:, None, None], lam_new, lam)
         # Worst-iteration solve-success fraction (observability of the
         # equilibrium-fallback path).
         ok_last = _mean_over_agents(ok_flat.astype(dtype))
@@ -1119,7 +1214,15 @@ def control(
 
     # Applied forces: agent i applies its own column (reference :669-675).
     f_app = f[jnp.arange(n_local), agent_ids, :]
-    new_state = CADMMState(f=f, lam=lam, f_mean=f_mean, warm=warm)
+    if health is not None:
+        f_app = f_app * w_alive[:, None]  # dead agents actuate nothing.
+        # Delivered-snapshot update: agents whose messages went through
+        # this step publish their final copies; dropped agents' snapshots
+        # stay frozen for the peers until their next delivered step.
+        held = jnp.where(msg_ok_l[:, None, None], f, f_stale)
+    else:
+        held = admm_state.held
+    new_state = CADMMState(f=f, lam=lam, f_mean=f_mean, warm=warm, held=held)
     collision = _max_over_agents(env_cbfs.collision.astype(jnp.int32)) > 0
     stats = SolverStats(
         iters=iters,

@@ -132,16 +132,32 @@ def smooth_block(cfg, R_i: jnp.ndarray, w_i: jnp.ndarray) -> jnp.ndarray:
     return 2.0 * cfg.k_smooth * (Rq_orth @ Rq_orth.T)
 
 
-def equilibrium_forces(params: RQPParams) -> jnp.ndarray:
+def equilibrium_forces(params: RQPParams, alive=None) -> jnp.ndarray:
     """Static equilibrium forces ``f_eq (n, 3)``: vertical thrusts solving the
-    least-squares wrench balance (reference :155-164)."""
+    least-squares wrench balance (reference :155-164).
+
+    ``alive``: optional (n,) healthy-agent mask (bool or 0/1). Dead agents'
+    wrench columns are zeroed and the min-norm pseudoinverse solution
+    redistributes the payload load over the SURVIVORS (zero thrust on dead
+    agents) — the graceful-degradation load share consumed by the
+    resilience layer. ``alive=None`` keeps the historical lstsq path
+    bit-for-bit (a dynamic mask would force the pinv path into every
+    nominal trace)."""
     n = params.n
     # hat(r_com_i) e3 = r_com_i x e3; rows [1, (r_com_i x e3)_x, (r_com_i x e3)_y].
     e3 = jnp.array([0.0, 0.0, 1.0], dtype=params.r.dtype)
     rxe = jnp.cross(params.r_com, e3)  # (n, 3)
     wrench = jnp.concatenate([jnp.ones((n, 1), params.r.dtype), rxe[:, :2]], axis=1).T
     rhs = jnp.array([params.mT * GRAVITY, 0.0, 0.0], dtype=params.r.dtype)
-    fz = jnp.linalg.lstsq(wrench, rhs)[0]  # (n,)
+    if alive is None:
+        fz = jnp.linalg.lstsq(wrench, rhs)[0]  # (n,)
+    else:
+        w = jnp.asarray(alive).astype(params.r.dtype)  # (n,)
+        # SVD pinv handles the rank drop from zeroed columns (and the
+        # all-dead corner, where it returns all-zero thrusts) under jit
+        # with a traced mask; the min-norm solution puts exactly 0 on the
+        # zeroed (dead) columns.
+        fz = w * (jnp.linalg.pinv(wrench * w[None, :]) @ rhs)
     return jnp.concatenate([jnp.zeros((n, 2), params.r.dtype), fz[:, None]], axis=1)
 
 

@@ -72,7 +72,17 @@ def make_config(
     """Defaults are reference-conservative. For warm-started receding-horizon
     use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
     needs tighter primal optima than C-ADMM's consensus (at 20 it rails
-    against the outer cap) — see bench.py / BASELINE.md."""
+    against the outer cap) — see bench.py / BASELINE.md.
+
+    **k_smooth x row-equilibration interaction**: same caveat as
+    :func:`control.cadmm.make_config` (measured there,
+    tests/test_ksmooth.py:75) — exact row equilibration removed the
+    accidental preconditioning that hid the smoothing cost's ~100:1 P
+    anisotropy, so a ``k_smooth > 0`` agent QP needs ~300 inner ADMM
+    iterations instead of ~80. DD is hit harder than C-ADMM by
+    under-budgeted inner solves (tolerance-missed primal optima bias the
+    quasi-Newton dual ascent), so when enabling smoothing raise
+    ``inner_iters`` to >= 300 or set ``inner_tol > 0`` for early exit."""
     from tpu_aerial_transport.control import cadmm as cadmm_mod
 
     base = cadmm_mod.make_config(
@@ -96,6 +106,13 @@ class DDState:
     lam_F: jnp.ndarray  # (n, 3) duals of the force consensus rows.
     lam_M: jnp.ndarray  # (n, 3) duals of the moment consensus rows.
     warm: socp.SOCPSolution  # leading agent axis.
+    # Last DELIVERED network-visible values (resilience layer only; None in
+    # nominal use — see the matching ``CADMMState.held`` note): under
+    # message dropout the peers' price/violation aggregations keep
+    # consuming these snapshots, frozen at the agent's last delivered step.
+    held_f: jnp.ndarray | None = None
+    held_lam_F: jnp.ndarray | None = None
+    held_lam_M: jnp.ndarray | None = None
 
 
 def init_dd_state(params: RQPParams, cfg: RQPDDConfig) -> DDState:
@@ -425,9 +442,23 @@ def control(
     forest: forest_mod.Forest | None = None,
     axis_name: str | None = None,
     plan: DDPlan | None = None,
+    health=None,
 ):
     """One DD control step: ``-> (f (n_local, 3), DDState, SolverStats)``
     (reference ``RQPDDController.control``, :695-752).
+
+    ``health``: optional :class:`resilience.faults.FaultStep` (``.alive``/
+    ``.msg_ok``, global (n,) bool) for graceful degradation, mirroring
+    :func:`control.cadmm.control`: dead agents are masked out of the price
+    and consensus-violation aggregations (their force contribution is
+    zero, so survivors' aggregate-of-others targets redistribute the
+    load), their primal/dual state and warm starts freeze, and their
+    applied force is zero; dropped messages (``alive & ~msg_ok``) hold the
+    agent's step-start prices/forces in the aggregations while it keeps
+    iterating locally. The QN preconditioner keeps its all-healthy cores —
+    a curvature bound used as a dual-ascent scaling, so masking only makes
+    the masked agents' (zeroed) steps trivially consistent. ``health=None``
+    compiles the exact nominal program.
 
     ``plan``: optional precomputed :func:`make_dd_plan` (state-independent
     QN cores). When None it is computed inline; passing it explicitly keeps
@@ -472,6 +503,25 @@ def control(
         if axis_name is None:
             return x
         return lax.all_gather(x, axis_name).reshape(n, x.shape[-1])
+
+    if health is not None:
+        # Graceful-degradation masks (see the docstring; cadmm.control has
+        # the matching construction).
+        alive_l = jnp.take(health.alive, agent_ids, axis=0)
+        msg_ok_l = jnp.take(health.msg_ok, agent_ids, axis=0)
+        w_alive = alive_l.astype(dtype)  # (n_local,)
+        # Dead agents anchor to zero force; their implied aggregates follow.
+        f_eq = f_eq * health.alive.astype(dtype)[:, None]
+        # Peers' view of a dropped agent: its last DELIVERED values (held
+        # snapshots frozen across the whole dropout window; see
+        # CADMMState.held). None (direct call, first step) falls back to
+        # the carried values.
+        lamF_stale = (dd_state.held_lam_F if dd_state.held_lam_F is not None
+                      else dd_state.lam_F)
+        lamM_stale = (dd_state.held_lam_M if dd_state.held_lam_M is not None
+                      else dd_state.lam_M)
+        f_stale = (dd_state.held_f if dd_state.held_f is not None
+                   else dd_state.f)
 
     r_local = jnp.take(params.r, agent_ids, axis=0)
     r_com_local = jnp.take(params.r_com, agent_ids, axis=0)
@@ -539,15 +589,28 @@ def control(
         (f, F, M, lam_F, lam_M, warm, it, err, err_buf, okf, _ok_last,
          fail_count) = carry
         # Price assembly (the all-gather, reference :716-722) — two psum
-        # reductions over the agent axis.
-        sum_lF = _sum_over_agents(lam_F)
-        sum_lM = _sum_over_agents(lam_M)
+        # reductions over the agent axis. With health, each agent's
+        # NETWORK-VISIBLE price contribution is its held (stale) value
+        # while dropped and zero while dead; the aggregation and the
+        # subtract-own step use the same visible values so "sum of the
+        # others' prices" stays exact w.r.t. delivered messages.
+        if health is None:
+            lamF_eff, lamM_eff = lam_F, lam_M
+        else:
+            lamF_eff = jnp.where(
+                msg_ok_l[:, None], lam_F, lamF_stale
+            ) * w_alive[:, None]
+            lamM_eff = jnp.where(
+                msg_ok_l[:, None], lam_M, lamM_stale
+            ) * w_alive[:, None]
+        sum_lF = _sum_over_agents(lamF_eff)
+        sum_lM = _sum_over_agents(lamM_eff)
         c_F = lam_F
         c_M = lam_M
-        c_f = -(sum_lF[None, :] - lam_F) + jnp.einsum(
+        c_f = -(sum_lF[None, :] - lamF_eff) + jnp.einsum(
             "nij,nj->ni",
             jax.vmap(lambda r: state.Rl @ lie.hat(r))(r_com_local),
-            sum_lM[None, :] - lam_M,
+            sum_lM[None, :] - lamM_eff,
         )
         q = q0.at[:, 9:12].add(c_f).at[:, 12:15].add(c_F).at[:, 15:18].add(c_M)
         sols = solve_one(P, q, A, lb, ub, shift, op, warm)
@@ -559,23 +622,45 @@ def control(
         f_new = jnp.where(okc, x[:, 9:12], f_eq_local)
         F_new = jnp.where(okc, x[:, 12:15], fallback_F)
         M_new = jnp.where(okc, x[:, 15:18], fallback_M)
+        if health is not None:
+            # Dead agents freeze at their last pre-death primal and never
+            # trigger retries; their warm starts freeze too.
+            f_new = jnp.where(alive_l[:, None], f_new, f)
+            F_new = jnp.where(alive_l[:, None], F_new, F)
+            M_new = jnp.where(alive_l[:, None], M_new, M)
+            ok = ok | ~alive_l
         # Keep any FINITE iterate as the warm start (tolerance-missed solves
         # accumulate inner progress across dual-ascent retries instead of
         # restarting identically); only non-finite iterates revert (see the
         # matching note in cadmm._consensus_iter_impl).
         finite = socp.solution_is_finite(sols)
+        if health is not None:
+            finite = finite & alive_l
         warm_new = jax.tree.map(
             lambda new, old: jnp.where(
                 finite.reshape((n_local,) + (1,) * (new.ndim - 1)), new, old
             ),
             sols, warm,
         )
-        # Primal infeasibility (the all-reduce, reference :659-676).
-        moments = jnp.einsum("nij,nj->ni", G_local, f_new)
-        sum_f = _sum_over_agents(f_new)
+        # Primal infeasibility (the all-reduce, reference :659-676). With
+        # health, the force sums see each agent's network-visible value
+        # (held while dropped, zero while dead) and dead agents' violation
+        # blocks are zeroed so they drive neither the residual nor the
+        # dual ascent.
+        if health is None:
+            f_c = f_new
+        else:
+            f_c = jnp.where(
+                msg_ok_l[:, None], f_new, f_stale
+            ) * w_alive[:, None]
+        moments = jnp.einsum("nij,nj->ni", G_local, f_c)
+        sum_f = _sum_over_agents(f_c)
         sum_m = _sum_over_agents(moments)
-        err_F = F_new - (sum_f[None, :] - f_new)
+        err_F = F_new - (sum_f[None, :] - f_c)
         err_M = M_new - (sum_m[None, :] - moments)
+        if health is not None:
+            err_F = err_F * w_alive[:, None]
+            err_M = err_M * w_alive[:, None]
         err_new = _max_over_agents(
             jnp.maximum(jnp.max(jnp.abs(err_F)), jnp.max(jnp.abs(err_M)))
         )
@@ -598,6 +683,10 @@ def control(
         do_dual = (err_new >= cfg.prim_inf_tol) & (it <= base.max_iter)
         lam_F_new = jnp.where(do_dual, lam_F + step[:, :3] @ state.Rl.T, lam_F)
         lam_M_new = jnp.where(do_dual, lam_M + step[:, 3:], lam_M)
+        if health is not None:
+            # Frozen duals for dead agents.
+            lam_F_new = jnp.where(alive_l[:, None], lam_F_new, lam_F)
+            lam_M_new = jnp.where(alive_l[:, None], lam_M_new, lam_M)
         ok_last = _sum_over_agents(ok.astype(dtype)) / n
         okf = jnp.minimum(okf, ok_last)  # worst-iteration success fraction.
         fail_count = jnp.where(ok_last < 1.0, fail_count + 1, 0)  # consecutive.
@@ -633,7 +722,20 @@ def control(
     (f, F, M, lam_F, lam_M, warm, iters, err, err_buf, ok_frac,
      _ok_last, _fail_count) = lax.while_loop(cond, dd_iter, init)
 
-    new_state = DDState(f=f, F=F, M=M, lam_F=lam_F, lam_M=lam_M, warm=warm)
+    if health is not None:
+        # Delivered-snapshot updates (see the matching cadmm.control note).
+        ok_m = msg_ok_l[:, None]
+        held_f = jnp.where(ok_m, f, f_stale)
+        held_lF = jnp.where(ok_m, lam_F, lamF_stale)
+        held_lM = jnp.where(ok_m, lam_M, lamM_stale)
+    else:
+        held_f, held_lF, held_lM = (
+            dd_state.held_f, dd_state.held_lam_F, dd_state.held_lam_M
+        )
+    new_state = DDState(f=f, F=F, M=M, lam_F=lam_F, lam_M=lam_M, warm=warm,
+                        held_f=held_f, held_lam_F=held_lF, held_lam_M=held_lM)
+    if health is not None:
+        f = f * w_alive[:, None]  # dead agents actuate nothing.
     collision = _max_over_agents(env_cbfs.collision.astype(jnp.int32)) > 0
     stats = SolverStats(
         iters=iters,

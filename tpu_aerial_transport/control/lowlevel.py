@@ -30,9 +30,13 @@ class LowLevelController:
     J: jnp.ndarray  # (n, 3, 3) quad inertias.
     so3_params: so3_tracking.So3PDParams | so3_tracking.So3SMParams
 
-    def control(self, state: RQPState, f_des: jnp.ndarray):
-        """``f_des (n, 3)`` -> ``(f (n,), M (n, 3))``. Jit/vmap-safe."""
-        return lowlevel_control(self.J, self.so3_params, state, f_des)
+    def control(self, state: RQPState, f_des: jnp.ndarray,
+                thrust_scale: jnp.ndarray | None = None):
+        """``f_des (n, 3)`` -> ``(f (n,), M (n, 3))``. Jit/vmap-safe.
+        ``thrust_scale``: optional (n,) actuator-health scale (see
+        :func:`lowlevel_control`)."""
+        return lowlevel_control(self.J, self.so3_params, state, f_des,
+                                thrust_scale)
 
 
 def make_lowlevel_controller(
@@ -50,15 +54,26 @@ def make_lowlevel_controller(
     return LowLevelController(J=params.J, so3_params=ll)
 
 
-def lowlevel_control(J, so3_params, state: RQPState, f_des):
+def lowlevel_control(J, so3_params, state: RQPState, f_des,
+                     thrust_scale=None):
     """Batched low-level control step (the body of ``RQPLowLevelController.control``,
-    reference :518-535, without the per-agent Python loop)."""
+    reference :518-535, without the per-agent Python loop).
+
+    ``thrust_scale``: optional (n,) per-agent actuator-health scale from the
+    resilience layer — rotor/actuator degradation caps both the scalar
+    thrust and the moment authority multiplicatively (0 = dead agent:
+    zero wrench). ``None`` is the nominal path.
+    """
     # Scalar thrusts: projection of the desired force on each quad's body z-axis.
     body_z = state.R[..., :, 2]  # (n, 3) = R_i e3.
     f = jnp.sum(f_des * body_z, axis=-1)  # (n,)
 
-    # Attitude targets: zero-yaw rotation with z-axis along f_des.
-    qd = f_des / jnp.linalg.norm(f_des, axis=-1, keepdims=True)
+    # Attitude targets: zero-yaw rotation with z-axis along f_des. A zero
+    # desired force (a dead agent's masked command) keeps the current
+    # attitude target direction well-defined instead of emitting NaNs.
+    norm = jnp.linalg.norm(f_des, axis=-1, keepdims=True)
+    qd = f_des / jnp.where(norm > 0, norm, 1.0)
+    qd = jnp.where(norm > 0, qd, state.R[..., :, 2])
     Rd = lie.rotation_from_z(qd)  # (n, 3, 3)
 
     wd = jnp.zeros_like(state.w)
@@ -71,4 +86,7 @@ def lowlevel_control(J, so3_params, state: RQPState, f_des):
         M = so3_tracking.so3_sm_tracking_control(
             state.R, Rd, state.w, wd, dwd, J, so3_params
         )
+    if thrust_scale is not None:
+        f = f * thrust_scale
+        M = M * thrust_scale[:, None]
     return f, M

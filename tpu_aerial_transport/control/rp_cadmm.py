@@ -124,7 +124,7 @@ def _agent_qp(params: RPParams, cfg: RPCADMMConfig, f_eq, state: RPState,
     n = params.n
     dtype = state.xl.dtype
     base = cfg.base
-    P, q, A, lb, ub, shift = rp_centralized._build_qp(
+    P, q, A, lb, ub, shift, scales = rp_centralized._build_qp(
         params, base, f_eq, state, acc_des
     )
     n_box = 9 + n
@@ -141,9 +141,12 @@ def _agent_qp(params: RPParams, cfg: RPCADMMConfig, f_eq, state: RPState,
     P = P.at[6:, 6:].add(-jnp.diag(damp))
     q = q.at[6:].add(2.0 * base.k_feq * f_eq.reshape(-1) * (1.0 - own3))
 
-    # Other agents' min-thrust rows: relax to -inf (rows 6 : 6+n).
+    # Other agents' min-thrust rows: relax to -inf (rows 6 : 6+n). The own
+    # row's bound must carry the row-equilibration scale the builder
+    # applied — writing the raw base.min_fz against a rescaled A row would
+    # silently tighten/loosen the constraint by the row norm.
     lb = lb.at[6:6 + n].set(
-        jnp.where(onehot > 0, base.min_fz, -socp.INF)
+        jnp.where(onehot > 0, base.min_fz * scales[6:6 + n], -socp.INF)
     )
     # Other agents' SOC blocks: zero the rows (2 blocks of 4 per agent,
     # after the n_box rows). Row-mask of shape (8n,): 1 for own block.

@@ -190,10 +190,14 @@ def _build_qp(params: RPParams, cfg: RPCentralizedConfig, f_eq, state: RPState,
     # dynamics rows carry Jl_inv ~ O(50) against O(ml) translation rows;
     # without rescaling the leader-cost QPs of the distributed RP
     # controller measurably need ~600 ADMM iterations instead of ~40.
-    A_full, lb, ub, shift, _ = socp.equilibrate_rows(
+    A_full, lb, ub, shift, scales = socp.equilibrate_rows(
         A_full, lb, ub, shift, n_box, (4,) * (2 * n)
     )
-    return P, q, A_full, lb, ub, shift
+    # scales returned so callers that rewrite individual bounds (the
+    # distributed rp_cadmm._agent_qp min-thrust relaxation) can stay in the
+    # equilibrated row scaling instead of silently mixing raw constants
+    # into rescaled rows.
+    return P, q, A_full, lb, ub, shift, scales
 
 
 def control(
@@ -207,7 +211,7 @@ def control(
     """One control step: ``-> (f (n, 3), CtrlState, SolverStats)`` with
     previous-solution fallback (reference ``control``, :291-302)."""
     n = params.n
-    P, q, A, lb, ub, shift = _build_qp(params, cfg, f_eq, state, acc_des)
+    P, q, A, lb, ub, shift, _ = _build_qp(params, cfg, f_eq, state, acc_des)
     sol = socp.solve_socp(
         P, q, A, lb, ub,
         n_box=9 + n, soc_dims=(4,) * (2 * n), iters=cfg.solver_iters,

@@ -27,6 +27,15 @@ class SolverStats:
     ok_frac: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.ones(())
     )
+    # Fallback-ladder rung the rollout landed on this step (stamped via
+    # stats.replace by resilience.rollout.resilient_rollout after the
+    # ladder select; controllers themselves leave it 0):
+    # 0 = clean warm solve, 1 = internal retry/equilibrium substitution
+    # (ok_frac < 1), 2 = non-finite forces -> held previous force,
+    # 3 = non-finite forces and no finite previous -> equilibrium forces.
+    fallback_rung: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((), jnp.int32)
+    )
 
 
 @struct.dataclass

@@ -277,14 +277,36 @@ def capsule_forest_distance(
     pts_sys = p_seg + cap_radius * normal
     # Outward (obstacle -> system) unit normal from the signed axis-level
     # distance: -normal while the capsule axis is outside the tree surface
-    # (dist_axis > 0, the ordinary case — identical to normalizing
+    # (dist_axis >= 0, the ordinary case — identical to normalizing
     # pts_sys - pts_env), +normal when the axis is inside the bark
     # (dist_axis < 0, where the surface-witness difference would flip).
-    # Zero where the direction is undefined (axis touching the surface).
+    # The dist_axis >= 0 -> -1 convention keeps the normal (and so the
+    # protecting CBF row) alive at EXACT axis-surface contact, where
+    # -sign(0) = 0 used to zero the row at the worst possible moment; when
+    # the surface witnesses themselves coincide there (zero witness
+    # difference), a surface-consistent fallback direction stands in:
+    # the outward RADIAL direction from the tree axis while the witness
+    # sits on the lateral (bark) surface, the SIGNED VERTICAL direction
+    # when it sits on a flat cap (a horizontal normal there would point
+    # the protecting row sideways instead of off the cap).
+    radial = p_seg[:, :2] - centers[:, :2]
+    rn = jnp.linalg.norm(radial, axis=-1, keepdims=True)
+    dz_seg = p_seg[:, 2] - centers[:, 2]
+    on_wall = (jnp.abs(dz_seg)[:, None] < forest.bark_height / 2.0) & (
+        rn > 1e-12
+    )
+    radial_dir = jnp.concatenate(
+        [radial / jnp.where(rn > 1e-12, rn, 1.0), jnp.zeros_like(rn)],
+        axis=-1,
+    )
+    vertical_dir = jnp.concatenate(
+        [jnp.zeros_like(radial), jnp.where(dz_seg >= 0, 1.0, -1.0)[:, None]],
+        axis=-1,
+    )
     normal_out = jnp.where(
         valid_n[:, None],
-        -jnp.sign(dist_axis)[:, None] * normal,
-        0.0,
+        jnp.where(dist_axis >= 0, -1.0, 1.0)[:, None] * normal,
+        jnp.where(on_wall, radial_dir, vertical_dir),
     )
 
     # Vision gating mirrors the reference: the query capsule's hppfcl transform

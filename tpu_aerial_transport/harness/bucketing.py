@@ -58,6 +58,22 @@ def env_congestion_metric(forest, vision_radius: float) -> Callable:
     return metric
 
 
+def quarantine_guarded_metric(metric_fn: Callable) -> Callable:
+    """Wrap a congestion metric so a quarantined/diverged scenario (any
+    non-finite leaf in its state) maps to -1 — sorted into the quietest
+    bucket with a well-defined key — instead of feeding NaN/garbage
+    distances into the argsort that groups the batch. Compose with
+    :func:`env_congestion_metric` when running bucketed Monte-Carlo under
+    the resilience layer's NaN quarantine."""
+    from tpu_aerial_transport.resilience.quarantine import tree_all_finite
+
+    def metric(state):
+        m = metric_fn(state)
+        return jnp.where(tree_all_finite(state), m, -1)
+
+    return metric
+
+
 def bucketed_step(step_fn: Callable, metric_fn: Callable,
                   n_buckets: int = 2) -> Callable:
     """Wrap a per-scenario MPC step ``step_fn(cs, state) -> (cs, state,

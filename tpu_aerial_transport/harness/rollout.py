@@ -41,6 +41,16 @@ class RQPLogStep:
     solve_res: jnp.ndarray
     collision: jnp.ndarray
     min_env_dist: jnp.ndarray
+    # Resilience extensions (defaults keep the nominal harness construction
+    # unchanged; resilience.rollout.resilient_rollout fills them):
+    # fallback-ladder rung taken this step (see SolverStats.fallback_rung)
+    # and the sticky per-scenario NaN-quarantine flag.
+    fallback_rung: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((), jnp.int32)
+    )
+    quarantined: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((), bool)
+    )
 
 
 def make_forest_acc_des(forest: forest_mod.Forest):
@@ -153,6 +163,8 @@ def logs_to_dict(logs: RQPLogStep, n: int, dt: float, hl_rel_freq: int,
         "solve_res_seq": np.asarray(logs.solve_res),
         "min_env_dist_seq": np.asarray(logs.min_env_dist),
         "collision_seq": np.asarray(logs.collision),
+        "fallback_rung_seq": np.asarray(logs.fallback_rung),
+        "quarantined_seq": np.asarray(logs.quarantined),
     }
     if forest is not None:
         num = int(forest.num_trees)

@@ -1,0 +1,44 @@
+"""Fault-injection and graceful-degradation layer.
+
+The reference (and the reproduction until this subsystem) only ever simulates
+the nominal case: every agent healthy, every consensus message delivered,
+every solve converging. This package turns "a team carries the payload" into
+a claim that survives stress:
+
+- :mod:`faults` — :class:`FaultSchedule`, a scan/vmap/jit-compatible pytree
+  describing per-HL-step, per-agent faults (actuator degradation, full agent
+  loss, sensor noise, consensus-message dropout/staleness), evaluated to a
+  per-step :class:`FaultStep` health mask.
+- :mod:`quarantine` — per-scenario NaN quarantine for Monte-Carlo batches:
+  a diverging scenario is frozen and flagged instead of poisoning batched
+  statistics.
+- :mod:`rollout` — :func:`resilient_rollout`, the harness rollout threaded
+  with fault evaluation, the explicit fallback ladder (warm solve -> retry ->
+  hold previous force -> equilibrium forces), and the quarantine, plus
+  ``make_cadmm_hl_step`` / ``make_dd_hl_step`` controller adapters that
+  recompute the equilibrium force distribution from the healthy-agent mask
+  each step.
+"""
+
+from tpu_aerial_transport.resilience.faults import (  # noqa: F401
+    NEVER,
+    FaultSchedule,
+    FaultStep,
+    apply_sensor_noise,
+    fault_step,
+    make_schedule,
+    no_faults,
+)
+from tpu_aerial_transport.resilience.quarantine import (  # noqa: F401
+    tree_all_finite,
+    tree_where,
+)
+from tpu_aerial_transport.resilience.rollout import (  # noqa: F401
+    RUNG_CLEAN,
+    RUNG_EQUILIBRIUM,
+    RUNG_HOLD,
+    RUNG_RETRY,
+    make_cadmm_hl_step,
+    make_dd_hl_step,
+    resilient_rollout,
+)

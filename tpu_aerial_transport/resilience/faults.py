@@ -1,0 +1,156 @@
+"""Fault schedules: scan/vmap/jit-compatible fault injection for rollouts.
+
+A :class:`FaultSchedule` is a pytree of STATIC SHAPE describing, per agent:
+
+- **actuator degradation**: from HL step ``t_degrade[i]`` on, agent i's
+  low-level thrust (and moment authority) is scaled by ``thrust_scale[i]``
+  (the thrust-cap scaling applied in :mod:`control.lowlevel`);
+- **full agent loss**: at HL step ``t_fail[i]`` agent i dies — zero thrust,
+  zero moment, its consensus contributions masked and its duals frozen;
+- **state-sensor noise**: Gaussian noise of std ``noise_std`` on the payload
+  position/velocity and per-quad body rates the *controller* sees (the
+  physics integrates the true state);
+- **consensus-message dropout/staleness**: per block of ``drop_hold`` HL
+  steps, each agent's outgoing consensus message (its ``f^(i)`` copy in
+  C-ADMM, its price/violation contribution in DD) is dropped with
+  probability ``drop_rate``; while dropped, the other agents hold its LAST
+  delivered value (the stale copy from the step start).
+
+All randomness is stateless (``jax.random.fold_in`` of ``key`` with the HL
+step index), so the same schedule replayed or resumed mid-rollout produces
+identical faults — and a vmapped batch of schedules gives per-scenario
+fault draws from per-scenario keys.
+
+``active`` is a STATIC field: with :func:`no_faults` (``active=False``) every
+consumer skips the fault branches at trace time, so the compiled nominal
+rollout is bit-identical (same HLO) to one built with no schedule at all.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# Sentinel HL-step index for "never": comparisons `t < NEVER` are always true
+# for any reachable step count.
+NEVER = jnp.iinfo(jnp.int32).max
+
+
+@struct.dataclass
+class FaultStep:
+    """One HL step's evaluated health state (all leaves length-n over the
+    GLOBAL agent axis; replicated under sharding)."""
+
+    alive: jnp.ndarray  # (n,) bool — False once t >= t_fail.
+    thrust_scale: jnp.ndarray  # (n,) float — 0 for dead agents.
+    msg_ok: jnp.ndarray  # (n,) bool — consensus message delivered this step.
+
+
+@struct.dataclass
+class FaultSchedule:
+    """Per-rollout fault description. See module docstring for semantics."""
+
+    t_fail: jnp.ndarray  # (n,) int32 HL step of agent loss; NEVER = none.
+    t_degrade: jnp.ndarray  # (n,) int32 onset of actuator degradation.
+    thrust_scale: jnp.ndarray  # (n,) float scale once degraded (1 = nominal).
+    drop_rate: jnp.ndarray  # () float per-(block, agent) dropout probability.
+    drop_hold: jnp.ndarray  # () int32 HL steps a dropout draw persists (K).
+    noise_std: jnp.ndarray  # () float sensor-noise std [m, m/s, rad/s].
+    key: jnp.ndarray  # PRNG key for dropout/noise draws.
+    # STATIC master switch: False compiles the exact nominal program.
+    active: bool = struct.field(pytree_node=False, default=True)
+    # STATIC noise switch (set by make_schedule from noise_std != 0): False
+    # skips the per-step RNG draws of apply_sensor_noise at trace time —
+    # noise_std is a traced leaf, so a zero value alone cannot be
+    # dead-code-eliminated from the compiled scan. When enabling noise on
+    # an existing schedule via .replace(noise_std=...), also pass
+    # noisy=True.
+    noisy: bool = struct.field(pytree_node=False, default=True)
+
+    @property
+    def n(self) -> int:
+        return self.t_fail.shape[-1]
+
+
+def make_schedule(
+    n: int,
+    *,
+    t_fail=None,
+    t_degrade=None,
+    thrust_scale=None,
+    drop_rate: float = 0.0,
+    drop_hold: int = 1,
+    noise_std: float = 0.0,
+    key=None,
+    dtype=jnp.float32,
+) -> FaultSchedule:
+    """Build a schedule. ``t_fail``/``t_degrade`` accept a per-agent array or
+    a ``{agent: step}`` dict (unlisted agents never fault); ``thrust_scale``
+    accepts an array or a scalar applied to every degraded agent."""
+
+    def _steps(spec):
+        if spec is None:
+            return jnp.full((n,), NEVER, jnp.int32)
+        if isinstance(spec, dict):
+            out = jnp.full((n,), NEVER, jnp.int32)
+            for i, t in spec.items():
+                out = out.at[int(i)].set(int(t))
+            return out
+        return jnp.asarray(spec, jnp.int32)
+
+    if thrust_scale is None:
+        scale = jnp.ones((n,), dtype)
+    else:
+        scale = jnp.broadcast_to(jnp.asarray(thrust_scale, dtype), (n,))
+    return FaultSchedule(
+        t_fail=_steps(t_fail),
+        t_degrade=_steps(t_degrade),
+        thrust_scale=scale,
+        drop_rate=jnp.asarray(drop_rate, dtype),
+        drop_hold=jnp.asarray(max(int(drop_hold), 1), jnp.int32),
+        noise_std=jnp.asarray(noise_std, dtype),
+        key=key if key is not None else jax.random.PRNGKey(0),
+        active=True,
+        noisy=float(noise_std) != 0.0,
+    )
+
+
+def no_faults(n: int, dtype=jnp.float32) -> FaultSchedule:
+    """The nominal schedule: ``active=False`` (STATIC), so every consumer
+    compiles its fault-free path — same HLO as passing no schedule."""
+    return make_schedule(n, dtype=dtype).replace(active=False)
+
+
+def fault_step(sched: FaultSchedule, t) -> FaultStep:
+    """Evaluate the schedule at HL step ``t`` (traced int ok). Dropout draws
+    are constant within each block of ``drop_hold`` steps, so a dropped
+    agent stays dropped (its last value held) for K consecutive HL steps."""
+    n = sched.n
+    t = jnp.asarray(t, jnp.int32)
+    alive = t < sched.t_fail
+    dtype = sched.thrust_scale.dtype
+    scale = jnp.where(
+        t >= sched.t_degrade, sched.thrust_scale, jnp.ones((), dtype)
+    ) * alive.astype(dtype)
+    block = t // sched.drop_hold
+    drop = jax.random.bernoulli(
+        jax.random.fold_in(jax.random.fold_in(sched.key, 1), block),
+        sched.drop_rate, (n,),
+    )
+    return FaultStep(alive=alive, thrust_scale=scale, msg_ok=alive & ~drop)
+
+
+def apply_sensor_noise(sched: FaultSchedule, t, state):
+    """The state the CONTROLLER senses at HL step ``t``: payload position/
+    velocity and per-quad body rates perturbed by N(0, noise_std^2). The
+    physics keeps integrating the true ``state``."""
+    t = jnp.asarray(t, jnp.int32)
+    k = jax.random.fold_in(jax.random.fold_in(sched.key, 2), t)
+    k1, k2, k3 = jax.random.split(k, 3)
+    std = sched.noise_std.astype(state.xl.dtype)
+    return state.replace(
+        xl=state.xl + std * jax.random.normal(k1, state.xl.shape, state.xl.dtype),
+        vl=state.vl + std * jax.random.normal(k2, state.vl.shape, state.vl.dtype),
+        w=state.w + std * jax.random.normal(k3, state.w.shape, state.w.dtype),
+    )

@@ -1,0 +1,35 @@
+"""Per-scenario NaN quarantine utilities.
+
+Under a Monte-Carlo ``vmap``, one diverging scenario would otherwise poison
+every batched statistic (NaN min/max/mean/std over the batch axis) and — via
+``lax.while_loop``'s batch-max trip count — can even stall the whole batch.
+Quarantine freezes a scenario at its last finite state and raises a sticky
+``quarantined`` flag; aggregate statistics then exclude flagged lanes
+(:func:`utils.stats.compute_aggregate_statistics` with ``valid=``).
+
+Everything here is scalar-per-scenario and composes with ``vmap``: inside
+the per-scenario program the predicates are ``()`` booleans, so a vmapped
+rollout gets independent per-lane quarantine for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_all_finite(tree) -> jnp.ndarray:
+    """() bool — True iff every inexact leaf of ``tree`` is entirely finite.
+    Integer/bool leaves (step counters, flags) are ignored: they cannot hold
+    NaN/inf and ``isfinite`` rejects exact dtypes."""
+    ok = jnp.ones((), bool)
+    for leaf in jax.tree.leaves(tree):
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.inexact):
+            ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
+def tree_where(pred, on_true, on_false):
+    """``jnp.where`` over matching pytrees with a scalar predicate."""
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), on_true, on_false)

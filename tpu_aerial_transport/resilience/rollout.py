@@ -1,0 +1,241 @@
+"""Fault-aware receding-horizon rollout: the harness rollout threaded with
+fault evaluation, an explicit force-fallback ladder, and per-scenario NaN
+quarantine. One jit-compiled two-rate ``lax.scan``, vmappable over
+Monte-Carlo scenarios exactly like :func:`harness.rollout.rollout`.
+
+**Fallback ladder** (each rung counted in the extended
+:class:`control.types.SolverStats` / :class:`harness.rollout.RQPLogStep`
+``fallback_rung`` field):
+
+  0. clean warm-started solve (``ok_frac == 1``, finite forces);
+  1. the controller retried internally and/or substituted equilibrium
+     forces for failed agent solves (``ok_frac < 1``) but returned finite
+     forces;
+  2. the controller returned non-finite forces — hold the previous step's
+     applied forces (and the previous controller state, so the poisoned
+     solve does not seed the next warm start);
+  3. non-finite forces and no finite previous force exists (first step, or
+     the hold itself was poisoned) — fall back to the equilibrium force
+     distribution (healthy-mask aware), which is always finite.
+
+**Quarantine**: if a scenario's physics state goes non-finite despite the
+ladder, the scenario freezes at its last finite state and its sticky
+``quarantined`` flag raises in the log — inside a vmapped batch the other
+lanes are untouched (bit-identical to a run without the diverging lane) and
+aggregate statistics can exclude flagged lanes via
+``utils.stats.compute_aggregate_statistics(..., valid=~quarantined)``.
+
+**Zero-cost when disabled**: ``faults=None`` and
+``faults=resilience.faults.no_faults(n)`` compile the IDENTICAL program
+(``active`` is a static field and every fault branch is a Python-level
+``if``), asserted by tests/test_resilience_faults.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_aerial_transport.control import centralized
+from tpu_aerial_transport.harness.rollout import RQPLogStep
+from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.resilience import faults as faults_mod
+from tpu_aerial_transport.resilience.quarantine import (
+    tree_all_finite,
+    tree_where,
+)
+
+RUNG_CLEAN = 0
+RUNG_RETRY = 1
+RUNG_HOLD = 2
+RUNG_EQUILIBRIUM = 3
+
+
+def make_cadmm_hl_step(params, cfg, forest=None, plan=None) -> Callable:
+    """Health-aware C-ADMM high-level step for :func:`resilient_rollout`:
+    recomputes the equilibrium force distribution from the healthy-agent
+    mask each step (survivors share the dead agents' load) and forwards the
+    health mask into the consensus reductions."""
+    from tpu_aerial_transport.control import cadmm
+
+    if plan is None:
+        plan = cadmm.make_plan(params, cfg)
+
+    def hl_step(cs, state, acc_des, health=None):
+        alive = None if health is None else health.alive
+        f_eq = centralized.equilibrium_forces(params, alive)
+        return cadmm.control(
+            params, cfg, f_eq, cs, state, acc_des, forest, plan=plan,
+            health=health,
+        )
+
+    # Seed the delivered-snapshot carry (CADMMState.held) so the scan carry
+    # structure is fixed from step 0; resilient_rollout calls this when
+    # fault injection is active.
+    hl_step.prepare_ctrl_state = lambda cs: cs.replace(held=cs.f)
+    return hl_step
+
+
+def make_dd_hl_step(params, cfg, forest=None, plan=None) -> Callable:
+    """Health-aware DD high-level step (see :func:`make_cadmm_hl_step`)."""
+    from tpu_aerial_transport.control import dd
+
+    if plan is None:
+        plan = dd.make_dd_plan(params, cfg)
+
+    def hl_step(cs, state, acc_des, health=None):
+        alive = None if health is None else health.alive
+        f_eq = centralized.equilibrium_forces(params, alive)
+        return dd.control(
+            params, cfg, f_eq, cs, state, acc_des, forest, plan=plan,
+            health=health,
+        )
+
+    hl_step.prepare_ctrl_state = lambda cs: cs.replace(
+        held_f=cs.f, held_lam_F=cs.lam_F, held_lam_M=cs.lam_M
+    )
+    return hl_step
+
+
+def resilient_rollout(
+    hl_step: Callable,
+    ll_control: Callable,
+    params: rqp.RQPParams,
+    state0: rqp.RQPState,
+    ctrl_state0,
+    n_hl_steps: int,
+    hl_rel_freq: int = 10,
+    dt: float = 1e-3,
+    acc_des_fn: Callable | None = None,
+    faults: faults_mod.FaultSchedule | None = None,
+):
+    """Run ``n_hl_steps`` high-level control periods with fault injection,
+    the fallback ladder, and NaN quarantine.
+
+    Args:
+      hl_step: ``(ctrl_state, state, acc_des, health) -> (f_des (n, 3),
+        ctrl_state, SolverStats)`` — e.g. :func:`make_cadmm_hl_step`.
+        ``health`` is ``None`` whenever fault injection is inactive.
+      ll_control: ``(state, f_des[, thrust_scale]) -> (f (n,), M (n, 3))``
+        — :meth:`control.lowlevel.LowLevelController.control` qualifies;
+        the third argument is only passed when fault injection is active.
+      faults: optional :class:`FaultSchedule`. ``None`` or a schedule with
+        ``active=False`` compiles the identical nominal program.
+
+    Returns ``(final_state, final_ctrl_state, logs: RQPLogStep)``; the
+    sticky quarantine flag is ``logs.quarantined`` (last entry = final).
+    """
+    active = faults is not None and faults.active
+    if active and hasattr(hl_step, "prepare_ctrl_state"):
+        # Controller adapters seed resilience-only state carries (e.g. the
+        # delivered-snapshot ``held`` fields) so the scan carry structure
+        # is fixed from step 0.
+        ctrl_state0 = hl_step.prepare_ctrl_state(ctrl_state0)
+    if acc_des_fn is None:
+        x0 = state0.xl
+
+        def acc_des_fn(state, t):
+            del t
+            dvl_des = -1.0 * state.vl - 1.0 * (state.xl - x0)
+            return (dvl_des, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
+
+    n = params.n
+    dtype = state0.xl.dtype
+    f_eq_full = centralized.equilibrium_forces(params)
+
+    def hl_body(carry, i):
+        state, cs, prev_f, quar = carry
+        t = i * hl_rel_freq * dt
+        if active:
+            health = faults_mod.fault_step(faults, i)
+            # faults.noisy is static: noise-free schedules (agent kill /
+            # dropout only) skip the per-step RNG draws at trace time.
+            sensed = (faults_mod.apply_sensor_noise(faults, i, state)
+                      if faults.noisy else state)
+            # The rung-3 fallback needs the healthy-mask equilibrium even
+            # though the hl_step adapters compute their own copy — a pinv
+            # of a 3 x n wrench matrix, noise next to one agent QP solve,
+            # accepted to keep the hl_step protocol controller-agnostic.
+            f_eq_t = centralized.equilibrium_forces(params, health.alive)
+        else:
+            health = None
+            sensed = state
+            f_eq_t = f_eq_full
+        acc_des, x_ref, v_ref = acc_des_fn(sensed, t)
+        f_des, cs_new, stats = hl_step(cs, sensed, acc_des, health)
+
+        # --- Fallback ladder (rungs 0-3, module docstring). ---
+        finite_f = jnp.all(jnp.isfinite(f_des))
+        if active:
+            prev_hold = prev_f * health.alive.astype(dtype)[:, None]
+        else:
+            prev_hold = prev_f
+        prev_ok = jnp.all(jnp.isfinite(prev_hold))
+        retried = stats.ok_frac < 1.0
+        if active:
+            # Consensus blackout: no alive agent delivered a message this
+            # step, so the masked consensus residual is vacuously 0 and the
+            # controller exits immediately on held values — a degraded
+            # step, not a clean one. Surface it on the retry rung so
+            # solve_res=0 steps cannot read as the healthiest in the run.
+            retried = retried | ~jnp.any(health.alive & health.msg_ok)
+        # jnp.where does not propagate NaNs from the unselected branch in
+        # the primal computation, so the nested select is NaN-safe.
+        f_used = jnp.where(
+            finite_f, f_des, jnp.where(prev_ok, prev_hold, f_eq_t)
+        )
+        rung = jnp.where(
+            finite_f,
+            jnp.where(retried, RUNG_RETRY, RUNG_CLEAN),
+            jnp.where(prev_ok, RUNG_HOLD, RUNG_EQUILIBRIUM),
+        ).astype(jnp.int32)
+        stats = stats.replace(fallback_rung=rung)
+        # A poisoned solve must not seed the next warm start: keep the new
+        # controller state only while it is entirely finite.
+        cs_next = tree_where(tree_all_finite(cs_new), cs_new, cs)
+
+        def ll_body(s, _):
+            if active:
+                f, M = ll_control(s, f_used, health.thrust_scale)
+            else:
+                f, M = ll_control(s, f_used)
+            return rqp.integrate(params, s, (f, M), dt), None
+
+        new_state, _ = lax.scan(ll_body, state, None, length=hl_rel_freq)
+
+        # --- Per-scenario NaN quarantine (sticky). ---
+        quar_new = quar | ~tree_all_finite(new_state)
+        new_state = tree_where(quar_new, state, new_state)
+        cs_next = tree_where(quar_new, cs, cs_next)
+        prev_next = jnp.where(quar_new, prev_f, f_used)
+
+        log = RQPLogStep(
+            xl=new_state.xl,
+            vl=new_state.vl,
+            Rl=new_state.Rl,
+            wl=new_state.wl,
+            R=new_state.R,
+            w=new_state.w,
+            f_des=f_used,
+            x_err=jnp.linalg.norm(x_ref - new_state.xl),
+            v_err=jnp.linalg.norm(v_ref - new_state.vl),
+            iters=stats.iters,
+            solve_res=stats.solve_res,
+            collision=stats.collision,
+            min_env_dist=stats.min_env_dist,
+            fallback_rung=stats.fallback_rung,
+            quarantined=quar_new,
+        )
+        return (new_state, cs_next, prev_next, quar_new), log
+
+    init = (
+        state0, ctrl_state0,
+        jnp.full((n, 3), jnp.nan, dtype),  # no previous force yet.
+        jnp.zeros((), bool),
+    )
+    (state, cs, _, _), logs = lax.scan(
+        hl_body, init, jnp.arange(n_hl_steps)
+    )
+    return state, cs, logs

@@ -5,12 +5,37 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def compute_aggregate_statistics(a, axis: int = 0):
-    """Return ``(min, max, avg, std)`` of ``a`` along ``axis``."""
+def compute_aggregate_statistics(a, axis: int = 0, valid=None):
+    """Return ``(min, max, avg, std)`` of ``a`` along ``axis``.
+
+    ``valid``: optional boolean mask of length ``a.shape[axis]`` selecting
+    the slices that enter the statistics — the NaN-quarantine hook: pass
+    ``~logs.quarantined[-1]`` (per-scenario) so a diverged Monte-Carlo lane
+    is excluded instead of poisoning every aggregate with NaN. With no
+    valid slice the min/max identities are ``+inf``/``-inf`` and avg/std
+    are 0. ``valid=None`` is the historical unmasked path, bit-identical.
+    """
     a = jnp.asarray(a)
+    if valid is None:
+        return (
+            jnp.min(a, axis=axis),
+            jnp.max(a, axis=axis),
+            jnp.mean(a, axis=axis),
+            jnp.std(a, axis=axis),
+        )
+    valid = jnp.asarray(valid, bool)
+    shape = [1] * a.ndim
+    shape[axis] = valid.shape[0]
+    m = valid.reshape(shape)
+    w = m.astype(a.dtype)
+    cnt = jnp.maximum(jnp.sum(w, axis=axis), 1.0)
+    avg = jnp.sum(jnp.where(m, a, 0.0), axis=axis) / cnt
+    var = jnp.sum(
+        jnp.where(m, (a - jnp.expand_dims(avg, axis)) ** 2, 0.0), axis=axis
+    ) / cnt
     return (
-        jnp.min(a, axis=axis),
-        jnp.max(a, axis=axis),
-        jnp.mean(a, axis=axis),
-        jnp.std(a, axis=axis),
+        jnp.min(jnp.where(m, a, jnp.inf), axis=axis),
+        jnp.max(jnp.where(m, a, -jnp.inf), axis=axis),
+        avg,
+        jnp.sqrt(var),
     )

@@ -335,7 +335,8 @@ def smooth_camera_track(xl_seq: np.ndarray, window: int = 51,
     scipy is importable, else a centered windowed mean (same intent:
     low-pass the camera so it doesn't shake with the payload)."""
     xl_seq = np.asarray(xl_seq)
-    window = min(window, len(xl_seq) - (len(xl_seq) + 1) % 2)  # odd, <= T.
+    window = min(window, len(xl_seq) - (len(xl_seq) + 1) % 2)  # <= T, T-odd.
+    window -= 1 - window % 2  # force odd: savgol rejects even windows.
     if window < 5:
         return xl_seq.copy()
     try:
