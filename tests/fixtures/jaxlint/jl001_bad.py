@@ -1,0 +1,17 @@
+"""Seeded violation: host cast of a traced expression (JL001)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def energy(x):
+    scale = float(jnp.sum(x * x))  # expect: JL001
+    return scale * x
+
+
+def loop(x):
+    n = int(jnp.max(x))  # expect: JL001
+    return n
+
+
+jax.vmap(loop)

@@ -1,0 +1,16 @@
+"""Clean twin of jl001_bad: casts on static config values are host-safe."""
+import jax
+import jax.numpy as jnp
+
+SCALE = float(jnp.pi / 4)  # module level — not traced context.
+
+
+@jax.jit
+def energy(x, cfg_gain=2.0):
+    gain = cfg_gain * SCALE  # no host cast of a traced value.
+    return gain * jnp.sum(x * x)
+
+
+def make_config(theta):
+    # Host-side factory (never traced): eager casts are fine.
+    return {"sec": float(jnp.cos(theta)), "n": int(theta // 1)}

@@ -1,0 +1,10 @@
+"""Seeded violation: .item()/.tolist() host sync under trace (JL002)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    worst = jnp.max(x).item()  # expect: JL002
+    rows = x.tolist()  # expect: JL002
+    return worst, rows

@@ -1,0 +1,13 @@
+"""Clean twin of jl002_bad: materialize on the host, outside the jit."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    return jnp.max(x), x
+
+
+def report(x):
+    worst, rows = step(x)
+    return worst.item(), rows.tolist()  # host context — fine.

@@ -1,0 +1,19 @@
+"""Seeded violation: numpy call inside traced code (JL003)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def normalize(x):
+    nrm = np.linalg.norm(x)  # expect: JL003
+    return x / nrm
+
+
+def body(carry, _):
+    return carry + np.asarray([1.0, 2.0]), None  # expect: JL003
+
+
+def run(c0):
+    return lax.scan(body, c0, None, length=3)

@@ -1,0 +1,19 @@
+"""Clean twin of jl003_bad: jnp under trace; np behind a Tracer guard."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def normalize(x):
+    return x / jnp.linalg.norm(x)
+
+
+def checked_plan(scale):
+    if not isinstance(scale, jax.core.Tracer):
+        # Host-only region (Tracer-guard idiom): numpy is fine here.
+        assert np.all(np.isfinite(np.asarray(scale)))
+    return jnp.sqrt(scale)
+
+
+jax.jit(checked_plan)

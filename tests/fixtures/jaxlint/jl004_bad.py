@@ -1,0 +1,19 @@
+"""Seeded violation: f64 dtype reaching jnp code (JL004)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def widen(x):
+    hi = jnp.asarray(x, np.float64)  # expect: JL004
+    return hi
+
+
+@jax.jit
+def accumulate(x):
+    acc = np.float64(0.0)  # expect: JL004
+    return x + acc
+
+
+def stringly(x):
+    return jnp.zeros_like(x, dtype="float64")  # expect: JL004

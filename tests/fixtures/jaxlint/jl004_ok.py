@@ -1,0 +1,16 @@
+"""Clean twin of jl004_bad: stay in f32; host-side np f64 is fine."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def widen(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def host_geometry(vertices):
+    # Host-side double-precision geometry (never traced) is legitimate.
+    return np.asarray(vertices, np.float64)
+
+
+def stringly(x):
+    return jnp.zeros_like(x, dtype="float32")

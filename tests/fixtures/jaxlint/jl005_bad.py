@@ -1,0 +1,12 @@
+"""Seeded violation: Python branch on a traced value (JL005)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp(x):
+    if jnp.max(x) > 1.0:  # expect: JL005
+        x = x / jnp.max(x)
+    while jnp.any(x > 2.0):  # expect: JL005
+        x = x * 0.5
+    return x

@@ -1,0 +1,14 @@
+"""Clean twin of jl005_bad: data branches via where; static branches ok."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp(x, n_steps: int = 3):
+    mx = jnp.max(x)
+    x = jnp.where(mx > 1.0, x / mx, x)
+    if n_steps > 2:  # static Python value — fine.
+        x = x * 0.5
+    if jnp.issubdtype(x.dtype, jnp.inexact):  # dtype metadata — fine.
+        x = x + 0.0
+    return x

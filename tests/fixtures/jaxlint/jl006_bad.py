@@ -1,0 +1,15 @@
+"""Seeded violation: jnp.asarray inside a scan body (JL006)."""
+import jax.numpy as jnp
+from jax import lax
+
+OFFSETS = [1.0, 2.0, 3.0]
+
+
+def body(carry, _):
+    ofs = jnp.asarray(OFFSETS)  # expect: JL006
+    bias = jnp.array([0.5, 0.5, 0.5])  # expect: JL006
+    return carry + ofs + bias, None
+
+
+def run(c0):
+    return lax.scan(body, c0, None, length=8)

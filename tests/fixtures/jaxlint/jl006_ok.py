@@ -1,0 +1,14 @@
+"""Clean twin of jl006_bad: conversions hoisted out of the loop."""
+import jax.numpy as jnp
+from jax import lax
+
+OFFSETS = jnp.asarray([1.0, 2.0, 3.0])
+BIAS = jnp.array([0.5, 0.5, 0.5])
+
+
+def body(carry, _):
+    return carry + OFFSETS + BIAS, None
+
+
+def run(c0):
+    return lax.scan(body, c0, None, length=8)

@@ -1,0 +1,9 @@
+"""Seeded violation: bare assert on a traced expression (JL007)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def project(x):
+    assert jnp.all(jnp.isfinite(x)), "non-finite input"  # expect: JL007
+    return x / jnp.linalg.norm(x)

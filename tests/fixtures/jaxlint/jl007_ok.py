@@ -1,0 +1,11 @@
+"""Clean twin of jl007_bad: shape asserts are static; value checks via
+where/checkify or host code."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def project(x):
+    assert x.ndim == 1, x.shape  # static shape metadata — fine.
+    nrm = jnp.linalg.norm(x)
+    return x / jnp.where(nrm > 0, nrm, 1.0)

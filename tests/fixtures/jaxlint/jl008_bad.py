@@ -1,0 +1,19 @@
+"""Seeded violation: static_argnames not matching the signature (JL008)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("iters", "mode"))  # expect: JL008
+def solve(x, iters: int = 10):
+    # "mode" is not a parameter: the static declaration is dead.
+    return x * iters
+
+
+def outer(y):
+    return jax.jit(scale, static_argnums=(2,))(y, 2.0)  # expect: JL008
+
+
+def scale(x, s):
+    return x * s

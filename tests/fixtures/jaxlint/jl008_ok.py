@@ -1,0 +1,17 @@
+"""Clean twin of jl008_bad: static declarations match the signature."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def solve(x, iters: int = 10):
+    return x * iters
+
+
+def outer(y):
+    return jax.jit(scale, static_argnums=(1,))(y, 2.0)
+
+
+def scale(x, s):
+    return x * s

@@ -1,0 +1,10 @@
+"""Seeded violation: jitted str-defaulted parameter not static (JL009)."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("iters",))  # expect: JL009
+def solve(x, iters: int = 10, mode: str = "auto"):
+    # "mode" is a string — it can never be traced; passing it will raise.
+    return x * iters

@@ -1,0 +1,9 @@
+"""Clean twin of jl009_bad: every str-defaulted parameter is static."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("iters", "mode"))
+def solve(x, iters: int = 10, mode: str = "auto"):
+    return x * iters

@@ -1,0 +1,14 @@
+"""Seeded violation: host callback in a traced hot path (JL010)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def solve(x):
+    y = jax.pure_callback(  # expect: JL010
+        lambda a: np.linalg.solve(np.eye(a.shape[0]), a),
+        jax.ShapeDtypeStruct(x.shape, x.dtype),
+        x,
+    )
+    return y

@@ -1,0 +1,8 @@
+"""Clean twin of jl010_bad: solve on-device instead of calling back."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def solve(x):
+    return jnp.linalg.solve(jnp.eye(x.shape[0], dtype=x.dtype), x)

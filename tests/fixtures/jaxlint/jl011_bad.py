@@ -1,0 +1,9 @@
+"""Seeded violation: print() under trace (JL011, warn)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    print("residual:", jnp.max(x))  # expect: JL011
+    return x * 0.5

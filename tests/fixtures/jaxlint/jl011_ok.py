@@ -1,0 +1,9 @@
+"""Clean twin of jl011_bad: jax.debug.print survives compilation."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("residual: {r}", r=jnp.max(x))
+    return x * 0.5

@@ -176,11 +176,15 @@ def test_sharded_cadmm_fused_matches_single_program():
         max_iter=6, inner_iters=10, res_tol=1e-3, socp_fused="scan",
     )
     astate = cadmm.init_cadmm_state(params, cfg_ref)
-    f_ref, _, _ = cadmm.control(params, cfg_ref, f_eq, astate, state, acc_des)
+    # jit both paths: eager consensus dispatch costs ~2k one-op compiles
+    # per step (see tests/test_parallel.py sharded tests).
+    f_ref, _, _ = jax.jit(
+        lambda a, s: cadmm.control(params, cfg_ref, f_eq, a, s, acc_des)
+    )(astate, state)
 
     cfg = cfg_ref.replace(socp_fused="interpret")
     m = mesh_mod.make_mesh({"agent": 4})
-    step = mesh_mod.cadmm_control_sharded(params, cfg, f_eq, m)
+    step = jax.jit(mesh_mod.cadmm_control_sharded(params, cfg, f_eq, m))
     f_sh, _, _ = step(astate, state, acc_des)
     assert np.abs(np.asarray(f_sh) - np.asarray(f_ref)).max() < 5e-3
 

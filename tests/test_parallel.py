@@ -10,6 +10,7 @@ import pytest
 from tpu_aerial_transport.control import cadmm, centralized, dd
 from tpu_aerial_transport.harness import setup
 from tpu_aerial_transport.parallel import mesh as mesh_mod
+from tpu_aerial_transport.utils import compat
 
 
 def test_eight_devices_available():
@@ -42,10 +43,15 @@ def test_sharded_cadmm_matches_single_program(n, n_shards):
     acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
 
     astate = cadmm.init_cadmm_state(params, cfg)
-    f_ref, _, stats_ref = cadmm.control(params, cfg, f_eq, astate, state, acc_des)
+    # jit both paths: eagerly each consensus step dispatches ~2k one-op
+    # programs (measured: ~90 s/test, none persistently cacheable) vs a
+    # handful of cached compiles jitted — same numerics, same oracle.
+    f_ref, _, stats_ref = jax.jit(
+        lambda a, s: cadmm.control(params, cfg, f_eq, a, s, acc_des)
+    )(astate, state)
 
     m = mesh_mod.make_mesh({"agent": n_shards})
-    step = mesh_mod.cadmm_control_sharded(params, cfg, f_eq, m)
+    step = jax.jit(mesh_mod.cadmm_control_sharded(params, cfg, f_eq, m))
     f_sh, astate_sh, stats_sh = step(astate, state, acc_des)
 
     assert f_sh.shape == (n, 3)
@@ -76,10 +82,13 @@ def test_sharded_dd_matches_single_program(n, n_shards):
     acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
 
     ds = dd.init_dd_state(params, cfg)
-    f_ref, _, stats_ref = dd.control(params, cfg, f_eq, ds, state, acc_des)
+    # jit both paths (see the C-ADMM twin above for the why + measurement).
+    f_ref, _, stats_ref = jax.jit(
+        lambda d, s: dd.control(params, cfg, f_eq, d, s, acc_des)
+    )(ds, state)
 
     m = mesh_mod.make_mesh({"agent": n_shards})
-    step = mesh_mod.dd_control_sharded(params, cfg, f_eq, m)
+    step = jax.jit(mesh_mod.dd_control_sharded(params, cfg, f_eq, m))
     f_sh, ds_sh, stats_sh = step(ds, state, acc_des)
 
     assert f_sh.shape == (n, 3)
@@ -230,14 +239,21 @@ def test_2d_mesh_scenario_by_agent_cadmm():
         ),
     )
     state_spec = jax.tree.map(lambda _: P("scenario"), states)
-    stats_spec = SolverStats(
-        iters=P("scenario"), solve_res=P("scenario"), collision=P("scenario"),
-        min_env_dist=P("scenario"), err_seq=P("scenario"),
-        ok_frac=P("scenario"),
+    # Spec built by tree.map over a throwaway instance (the
+    # __graft_entry__.dryrun_multichip pattern) so EVERY SolverStats leaf —
+    # including defaulted fields like the PR-1 fallback_rung, which the
+    # inner vmap broadcasts to the local scenario batch like the rest —
+    # gets the scenario spec; spelling leaves out by hand silently leaves
+    # new defaults as array leaves that shard_map rejects (or, worse, as
+    # P() on a batched output, which assembles a wrong-shaped global).
+    stats_spec = jax.tree.map(
+        lambda _: P("scenario"),
+        SolverStats(iters=0, solve_res=0, collision=0, min_env_dist=0,
+                    err_seq=0, ok_frac=0),
     )
 
     @partial(
-        jax.shard_map, mesh=m,
+        compat.shard_map, mesh=m,
         in_specs=(admm_spec, state_spec, (P(), P())),
         out_specs=(P("scenario", "agent"), admm_spec, stats_spec),
         check_vma=False,

@@ -6,6 +6,13 @@ here hypothesis searches problem scale and conditioning."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="optional dev dependency (pip install -e .[test]); property "
+    "tests skip without it instead of failing collection",
+)
 from hypothesis import assume, given, settings
 from hypothesis import strategies as st
 

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# CI gate: jaxlint (Tier A) + formatting checks over the package.
+#
+# Exits nonzero on ANY finding. Formatters (black/isort) are optional dev
+# deps — when absent the formatting step is SKIPPED with a notice (the
+# container image is network-isolated; pip install -e .[dev] where
+# available). jaxlint has no dependencies at all and always runs.
+#
+# tests/test_jaxlint.py invokes this script so tier-1 exercises exactly
+# the path CI and humans run.
+#
+# Usage: tools/ci_check.sh [paths...]   (default: the package + tools)
+
+set -u
+cd "$(dirname "$0")/.."
+
+PATHS=("$@")
+if [ ${#PATHS[@]} -eq 0 ]; then
+    PATHS=(tpu_aerial_transport tools)
+fi
+
+fail=0
+
+echo "== jaxlint (Tier A) =="
+python tools/jaxlint.py "${PATHS[@]}" || fail=1
+
+echo "== black --check =="
+if python -c "import black" 2>/dev/null; then
+    python -m black --check --quiet "${PATHS[@]}" || fail=1
+else
+    echo "black not installed — skipped (pip install -e .[dev])"
+fi
+
+echo "== isort --check =="
+if python -c "import isort" 2>/dev/null; then
+    python -m isort --check-only --quiet "${PATHS[@]}" || fail=1
+else
+    echo "isort not installed — skipped (pip install -e .[dev])"
+fi
+
+if [ "$fail" -ne 0 ]; then
+    echo "ci_check: FAILED"
+    exit 1
+fi
+echo "ci_check: OK"

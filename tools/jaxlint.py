@@ -1,0 +1,50 @@
+#!/usr/bin/env python3
+"""jaxlint CLI — jit-safety / trace-contract analyzer for the package.
+
+Usage:
+    python tools/jaxlint.py [paths...]           # Tier A (pure AST, no jax)
+    python tools/jaxlint.py --list-rules
+    python tools/jaxlint.py --format json tpu_aerial_transport/
+    python tools/jaxlint.py --disable JL003,JL011 path/to/file.py
+    python tools/jaxlint.py --contracts          # + Tier B (imports jax)
+
+Exit status: 0 clean, 1 error-severity findings (warnings too with
+--strict-warn), 2 if --assert-no-jax tripped.
+
+Tier A is loaded by FILE PATH (not via the package) so running the lint
+never imports jax or initializes a backend — safe on CI boxes with no
+accelerator stack; tests/test_jaxlint.py asserts this with
+--assert-no-jax. Tier B (--contracts) imports the package normally.
+"""
+
+import importlib.util
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ANALYSIS = os.path.join(
+    os.path.dirname(_HERE), "tpu_aerial_transport", "analysis"
+)
+
+
+def _load_by_path(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ANALYSIS, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    # Sibling-import order matters: rules/entrypoints first so linter's
+    # path-loaded fallback imports resolve to these exact modules.
+    _load_by_path("rules")
+    _load_by_path("entrypoints")
+    linter = _load_by_path("linter")
+    return linter.main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

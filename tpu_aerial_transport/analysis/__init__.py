@@ -1,0 +1,30 @@
+"""jaxlint: jit-safety / trace-contract static analysis for the package.
+
+Two tiers (ISSUE 2):
+
+- **Tier A** (:mod:`rules`, :mod:`linter`): a pure-AST lint with NO jax
+  import — host-sync idioms, f64 literal promotion, Python branching on
+  traced values, ``jnp.asarray`` in loop bodies, bare asserts on arrays,
+  static_argnames mistakes, callbacks/prints under trace. Safe to run in
+  any environment (CI boxes without an accelerator stack, pre-commit).
+- **Tier B** (:mod:`contracts`): a trace-contract harness that lowers every
+  registered public jitted entrypoint and asserts no retrace across
+  same-shape calls, no f64 ``convert_element_type`` with x64 off, no
+  ``pure_callback``/``io_callback`` in hot paths, and flags non-TPU-tile
+  operand shapes (with an explicit allowlist). Imports jax.
+
+Keep Tier A import-light: importing ``analysis.rules`` / ``analysis.linter``
+/ ``analysis.entrypoints`` must never pull in jax (asserted by
+tests/test_jaxlint.py via a subprocess). ``analysis.contracts`` is the only
+module here allowed to import jax, and only lazily via this namespace.
+"""
+
+__all__ = ["rules", "linter", "entrypoints", "contracts"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        import importlib
+
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(name)

@@ -1,0 +1,537 @@
+"""Tier-B jaxlint: trace contracts over the package's public jitted
+entrypoints. This module imports jax (unlike the Tier-A modules).
+
+Every entry in :data:`REGISTRY` lowers a real entrypoint on tiny arguments
+and checks four contracts:
+
+- **TC101 no-retrace**: calling the jitted entrypoint twice with freshly
+  built same-shape/same-dtype arguments must not grow the jit cache
+  (cache-miss counting via the jit function's ``_cache_size``). A miss
+  here means some argument leaks object identity / Python hashing into
+  the trace key (e.g. an unhashable "static" config rebuilt per call).
+- **TC102 no-f64**: with x64 disabled, the lowered StableHLO must contain
+  no ``f64`` tensors — an f64 type here means a float64 literal/dtype
+  sneaked into the graph and will either widen everything under x64 or
+  pay convert_element_type churn without it.
+- **TC103 no-callback**: the lowered text must contain no host callback
+  custom-calls (``pure_callback``/``io_callback``); a callback in a hot
+  path serializes every step through the host.
+- **TC104 tile-shape** (warn): flags ``dot_general`` operands whose
+  trailing dims are not multiples of the f32 TPU tile (8, 128). Every
+  current entrypoint carries an explicit waiver in
+  ``entrypoints.TILE_WAIVERS`` (the physics is 3-vector shaped and the
+  KKT operators are deliberately sub-tile); the check exists so a NEW
+  heavy operand must either be tile-aligned or add a waiver with a
+  reason.
+
+Builders use deliberately tiny problem sizes: the contracts are about
+program STRUCTURE (dtypes, callbacks, cache keys), which is size-
+independent, and tier-1 runs a subset of these on every commit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_aerial_transport.analysis import entrypoints as entry_data
+from tpu_aerial_transport.analysis.rules import Finding
+
+_F64_RE = re.compile(r"f64>")
+# Host-round-trip primitives at the JAXPR level. TC103 cannot work on the
+# lowered StableHLO text: pure_callback, io_callback AND jax.debug.print
+# all lower to the SAME `custom_call @xla_python_cpu_callback` target
+# (verified on jax 0.4.37), and debug prints are exactly what JL011 tells
+# people to use — only the jaxpr distinguishes them (`debug_callback` vs
+# `pure_callback`/`io_callback`).
+_CALLBACK_PRIMS = frozenset({"pure_callback", "io_callback"})
+
+# Fast subset exercised by tier-1 on every run (tests/test_jaxlint.py);
+# the full registry runs under -m slow and via `tools/jaxlint.py
+# --contracts`. Chosen to cover the solver core, one consensus
+# controller, and one scan-of-solves rollout within a few seconds of
+# CPU compile time each.
+FAST_SUBSET = (
+    "ops.socp:solve_socp",
+    "control.cadmm:control",
+    "harness.rollout:rollout",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    """One registered entrypoint. ``build()`` returns ``(fn, make_args)``
+    where ``fn`` is the UNjitted callable (statics closed over) and
+    ``make_args()`` builds a fresh argument tuple (called twice by the
+    retrace check — the two pytrees must be independent objects)."""
+
+    name: str
+    build: Callable[[], tuple[Callable, Callable[[], tuple]]]
+    min_devices: int = 1
+    # Entries whose lowering legitimately contains the string "callback"
+    # (none today) would set this with a reason.
+    allow_callbacks: str = ""
+
+
+REGISTRY: dict[str, Contract] = {}
+
+
+def _register(name: str, **kw):
+    def deco(build):
+        REGISTRY[name] = Contract(name=name, build=build, **kw)
+        return build
+
+    return deco
+
+
+# ----------------------------------------------------------------------
+# Argument builders.
+# ----------------------------------------------------------------------
+
+def _acc():
+    return (jnp.zeros(3), jnp.zeros(3))
+
+
+def _rqp_bits(n=4):
+    from tpu_aerial_transport.harness import setup
+
+    params, col, state = setup.rqp_setup(n)
+    return params, col, state
+
+
+@_register("control.centralized:control")
+def _build_centralized():
+    from tpu_aerial_transport.control import centralized
+
+    params, col, state = _rqp_bits(4)
+    cfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=10
+    )
+    f_eq = centralized.equilibrium_forces(params)
+
+    def fn(cs, s, a):
+        return centralized.control(params, cfg, f_eq, cs, s, a)
+
+    def make_args():
+        return (centralized.init_ctrl_state(params, cfg),
+                _rqp_bits(4)[2], _acc())
+
+    return fn, make_args
+
+
+def _cadmm_bits(forest=None):
+    from tpu_aerial_transport.control import cadmm, centralized
+
+    params, col, state = _rqp_bits(4)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=2, inner_iters=4,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    plan = cadmm.make_plan(params, cfg)
+
+    def fn(cs, s, a):
+        return cadmm.control(
+            params, cfg, f_eq, cs, s, a, forest, plan=plan
+        )
+
+    def make_args():
+        return (cadmm.init_cadmm_state(params, cfg), _rqp_bits(4)[2], _acc())
+
+    return fn, make_args
+
+
+@_register("control.cadmm:control")
+def _build_cadmm():
+    return _cadmm_bits()
+
+
+@_register("control.cadmm:control_forest")
+def _build_cadmm_forest():
+    from tpu_aerial_transport.envs import forest as forest_mod
+
+    return _cadmm_bits(forest=forest_mod.make_forest(0))
+
+
+@_register("control.dd:control")
+def _build_dd():
+    from tpu_aerial_transport.control import centralized, dd
+
+    params, col, state = _rqp_bits(4)
+    cfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=2, inner_iters=4,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    plan = dd.make_dd_plan(params, cfg)
+
+    def fn(cs, s, a):
+        return dd.control(params, cfg, f_eq, cs, s, a, plan=plan)
+
+    def make_args():
+        return (dd.init_dd_state(params, cfg), _rqp_bits(4)[2], _acc())
+
+    return fn, make_args
+
+
+@_register("control.rp_cadmm:control")
+def _build_rp_cadmm():
+    from tpu_aerial_transport.control import rp_cadmm, rp_centralized
+    from tpu_aerial_transport.harness import setup
+
+    params, col, state = setup.rp_setup(3)
+    cfg = rp_cadmm.make_config(params, max_iter=2, inner_iters=4)
+    f_eq = rp_centralized.equilibrium_forces(params)
+
+    def fn(cs, s, a):
+        return rp_cadmm.control(params, cfg, f_eq, cs, s, a)
+
+    def make_args():
+        return (rp_cadmm.init_state(params, cfg, f_eq),
+                setup.rp_setup(3)[2], _acc())
+
+    return fn, make_args
+
+
+@_register("control.rp_centralized:control")
+def _build_rp_centralized():
+    from tpu_aerial_transport.control import rp_centralized
+    from tpu_aerial_transport.harness import setup
+
+    params, col, state = setup.rp_setup(3)
+    cfg = rp_centralized.make_config(params, solver_iters=10)
+    f_eq = rp_centralized.equilibrium_forces(params)
+
+    def fn(cs, s, a):
+        return rp_centralized.control(params, cfg, f_eq, cs, s, a)
+
+    def make_args():
+        return (rp_centralized.init_ctrl_state(params, cfg),
+                setup.rp_setup(3)[2], _acc())
+
+    return fn, make_args
+
+
+@_register("control.pmrl_centralized:control")
+def _build_pmrl():
+    from tpu_aerial_transport.control import pmrl_centralized
+    from tpu_aerial_transport.harness import setup
+
+    params, col, state = setup.pmrl_setup(3)
+    cfg = pmrl_centralized.make_config(params, solver_iters=10)
+
+    def fn(cs, s, a):
+        return pmrl_centralized.control(params, cfg, cs, s, a)
+
+    def make_args():
+        return (pmrl_centralized.init_ctrl_state(params, cfg, state),
+                setup.pmrl_setup(3)[2], _acc())
+
+    return fn, make_args
+
+
+def _socp_problem(nv=8, n_box=6, soc=(4,)):
+    rng = np.random.default_rng(0)
+    L = rng.standard_normal((nv, nv))
+    P = jnp.asarray(L @ L.T + np.eye(nv), jnp.float32)
+    q = jnp.asarray(rng.standard_normal(nv), jnp.float32)
+    m = n_box + sum(soc)
+    A = jnp.asarray(rng.standard_normal((m, nv)) * 0.5, jnp.float32)
+    lb = jnp.asarray(rng.uniform(-2.0, -0.5, n_box), jnp.float32)
+    ub = jnp.asarray(rng.uniform(0.5, 2.0, n_box), jnp.float32)
+    return P, q, A, lb, ub
+
+
+@_register("ops.socp:solve_socp")
+def _build_socp():
+    from tpu_aerial_transport.ops import socp
+
+    def fn(P, q, A, lb, ub):
+        return socp.solve_socp(
+            P, q, A, lb, ub, n_box=6, soc_dims=(4,), iters=20, fused="scan"
+        )
+
+    return fn, _socp_problem
+
+
+@_register("ops.admm_kernel:solve_socp_interpret")
+def _build_socp_interpret():
+    from tpu_aerial_transport.ops import socp
+
+    def fn(P, q, A, lb, ub):
+        # The Pallas chunk kernel engages only under a batch axis (the
+        # unbatched path is plain scan — see socp._fused_chunk_runner).
+        return jax.vmap(
+            lambda Pb, qb: socp.solve_socp(
+                Pb, qb, A, lb, ub, n_box=6, soc_dims=(4,), iters=8,
+                fused="interpret",
+            )
+        )(P, q)
+
+    def make_args():
+        P, q, A, lb, ub = _socp_problem()
+        return (jnp.tile(P[None], (2, 1, 1)), jnp.tile(q[None], (2, 1)),
+                A, lb, ub)
+
+    return fn, make_args
+
+
+def _rollout_bits():
+    from tpu_aerial_transport.control import centralized, lowlevel
+
+    params, col, state = _rqp_bits(4)
+    cfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=10
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    llc = lowlevel.make_lowlevel_controller("pd", params)
+
+    def hl(cs, s, a):
+        return centralized.control(params, cfg, f_eq, cs, s, a)
+
+    return params, cfg, centralized, llc, hl
+
+
+@_register("harness.rollout:rollout")
+def _build_rollout():
+    from tpu_aerial_transport.harness import rollout as h_rollout
+
+    params, cfg, centralized, llc, hl = _rollout_bits()
+
+    def fn(s0, cs0):
+        return h_rollout.rollout(
+            hl, llc.control, params, s0, cs0, n_hl_steps=2, hl_rel_freq=2
+        )
+
+    def make_args():
+        return (_rqp_bits(4)[2], centralized.init_ctrl_state(params, cfg))
+
+    return fn, make_args
+
+
+@_register("resilience.rollout:resilient_rollout")
+def _build_resilient():
+    from tpu_aerial_transport.control import cadmm, lowlevel
+    from tpu_aerial_transport.resilience import faults as faults_mod
+    from tpu_aerial_transport.resilience import rollout as r_rollout
+
+    params, col, state = _rqp_bits(4)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=2, inner_iters=4,
+    )
+    sched = faults_mod.make_schedule(4, t_fail={1: 1}, drop_rate=0.3)
+    hl = r_rollout.make_cadmm_hl_step(params, cfg)
+    llc = lowlevel.make_lowlevel_controller("pd", params)
+
+    def fn(s0, cs0):
+        return r_rollout.resilient_rollout(
+            hl, llc.control, params, s0, cs0, n_hl_steps=2, hl_rel_freq=2,
+            faults=sched,
+        )
+
+    def make_args():
+        return (_rqp_bits(4)[2], cadmm.init_cadmm_state(params, cfg))
+
+    return fn, make_args
+
+
+@_register("parallel.mesh:cadmm_control_sharded", min_devices=4)
+def _build_mesh_cadmm():
+    from tpu_aerial_transport.control import cadmm, centralized
+    from tpu_aerial_transport.parallel import mesh as mesh_mod
+
+    params, col, state = _rqp_bits(4)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=2, inner_iters=4,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    m = mesh_mod.make_mesh({"agent": 4})
+    step = mesh_mod.cadmm_control_sharded(params, cfg, f_eq, m)
+
+    def make_args():
+        return (cadmm.init_cadmm_state(params, cfg), _rqp_bits(4)[2], _acc())
+
+    return step, make_args
+
+
+@_register("parallel.mesh:scenario_rollout", min_devices=2)
+def _build_mesh_scenarios():
+    from tpu_aerial_transport.harness import rollout as h_rollout
+    from tpu_aerial_transport.parallel import mesh as mesh_mod
+
+    params, cfg, centralized, llc, hl = _rollout_bits()
+    m = mesh_mod.make_mesh({"scenario": 2})
+
+    def rollout_fn(s0, cs0):
+        return h_rollout.rollout(
+            hl, llc.control, params, s0, cs0, n_hl_steps=2, hl_rel_freq=2
+        )
+
+    run = mesh_mod.scenario_rollout(rollout_fn, m)
+    # The contract drives the jit UNDER the wrapper (run.batched_jit) so
+    # cache-miss counting sees the real compiled object.
+    fn = run.batched_jit
+
+    def make_args():
+        state = _rqp_bits(4)[2]
+        batch = jax.tree.map(
+            lambda x: jnp.tile(x[None], (2,) + (1,) * x.ndim), state
+        )
+        cs = centralized.init_ctrl_state(params, cfg)
+        cs_b = jax.tree.map(
+            lambda x: jnp.tile(x[None], (2,) + (1,) * x.ndim), cs
+        )
+        return (batch, cs_b)
+
+    return fn, make_args
+
+
+# ----------------------------------------------------------------------
+# Checks.
+# ----------------------------------------------------------------------
+
+def scan_lowered_text(text: str, path: str) -> list[Finding]:
+    """String-level TC102 over lowered StableHLO, factored out so the
+    detection logic is unit-testable without having to synthesize an f64
+    graph under x64-off canonicalization. (TC103 is jaxpr-level — see
+    :data:`_CALLBACK_PRIMS` — because debug prints and real callbacks
+    lower to the same custom_call target.)"""
+    out: list[Finding] = []
+    n = len(_F64_RE.findall(text))
+    if n:
+        out.append(Finding(
+            rule="TC102", path=path, line=0, col=0,
+            message=f"lowered StableHLO contains {n} f64 tensor "
+            "type(s) with x64 disabled (f64 literal/dtype in the "
+            "graph)",
+        ))
+    return out
+
+
+def callback_primitives(jaxpr) -> list[str]:
+    """Names of host-round-trip callback primitives anywhere in a (closed)
+    jaxpr, recursing into scan/while/cond sub-jaxprs. ``debug_callback``
+    (jax.debug.print) is deliberately NOT counted — it is the sanctioned
+    replacement JL011 recommends."""
+    return sorted(
+        eqn.primitive.name
+        for eqn in _iter_eqns(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr")
+                              else jaxpr)
+        if eqn.primitive.name in _CALLBACK_PRIMS
+    )
+
+
+def _iter_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(v):
+    ClosedJaxpr = jax.core.ClosedJaxpr
+    Jaxpr = jax.core.Jaxpr
+    if isinstance(v, ClosedJaxpr):
+        yield v.jaxpr
+    elif isinstance(v, Jaxpr):
+        yield v
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            yield from _sub_jaxprs(x)
+
+
+def check_entry(contract: Contract,
+                disabled: frozenset[str] = frozenset()) -> list[Finding]:
+    """Run all trace contracts for one registry entry."""
+    out: list[Finding] = []
+    path = f"contracts:{contract.name}"
+    if jax.device_count() < contract.min_devices:
+        return out  # environment cannot host this entry; not a finding.
+    fn, make_args = contract.build()
+    jitted = fn if hasattr(fn, "lower") and hasattr(fn, "_cache_size") \
+        else jax.jit(fn)
+
+    # TC101: no retrace across same-shape calls with fresh arguments.
+    if "TC101" not in disabled:
+        jax.block_until_ready(jitted(*make_args()))
+        before = jitted._cache_size()
+        jax.block_until_ready(jitted(*make_args()))
+        after = jitted._cache_size()
+        if after != before:
+            out.append(Finding(
+                rule="TC101", path=path, line=0, col=0,
+                message=(
+                    f"retrace on a second same-shape call (jit cache "
+                    f"{before} -> {after}): an argument leaks identity "
+                    "into the trace key"
+                ),
+            ))
+
+    # TC102: no f64 in the lowered StableHLO while x64 is off.
+    if "TC102" not in disabled and not jax.config.jax_enable_x64:
+        text = jitted.lower(*make_args()).as_text()
+        out.extend(scan_lowered_text(text, path))
+
+    # TC103 needs the jaxpr (see _CALLBACK_PRIMS); TC104 walks it too.
+    check_callbacks = ("TC103" not in disabled
+                       and not contract.allow_callbacks)
+    tile_waived = (
+        "TC104" in disabled
+        or entry_data.TILE_WAIVERS.get(contract.name) is not None
+    )
+    if check_callbacks or not tile_waived:
+        jaxpr = jax.make_jaxpr(fn)(*make_args())
+
+    if check_callbacks:
+        cbs = callback_primitives(jaxpr)
+        if cbs:
+            out.append(Finding(
+                rule="TC103", path=path, line=0, col=0,
+                message=f"hot path contains host callback primitive(s) "
+                f"{', '.join(sorted(set(cbs)))} "
+                "(pure_callback/io_callback round-trip every step)",
+            ))
+
+    # TC104: TPU tile alignment of dot operands (warn; waivable).
+    if not tile_waived:
+        bad: list[str] = []
+        for eqn in _iter_eqns(jaxpr.jaxpr):
+            if eqn.primitive.name != "dot_general":
+                continue
+            for v in eqn.invars:
+                shape = getattr(v.aval, "shape", ())
+                if len(shape) >= 2 and (
+                    shape[-1] % 128 or shape[-2] % 8
+                ):
+                    bad.append(str(tuple(shape)))
+        if bad:
+            uniq = sorted(set(bad))[:6]
+            out.append(Finding(
+                rule="TC104", path=path, line=0, col=0,
+                message=(
+                    f"{len(bad)} dot_general operand(s) not (8, 128) "
+                    f"tile-aligned, e.g. {', '.join(uniq)}; align or "
+                    "add an entrypoints.TILE_WAIVERS entry with a "
+                    "reason"
+                ),
+                severity="warn",
+            ))
+    return out
+
+
+def run_contracts(names=None,
+                  disabled: frozenset[str] = frozenset()) -> list[Finding]:
+    """Run contracts for ``names`` (default: the whole registry)."""
+    selected = names if names is not None else sorted(REGISTRY)
+    out: list[Finding] = []
+    for name in selected:
+        out.extend(check_entry(REGISTRY[name], disabled))
+    return out

@@ -1,0 +1,147 @@
+"""The package's jit surface, as pure data (NO jax import — Tier A and the
+CLI load this by file path).
+
+Three tables:
+
+- :data:`TRACED_FUNCTIONS`: per-module names whose bodies run under a jax
+  trace when the system is in use (callers jit them, or they are called
+  from jitted rollouts). Tier A seeds its traced-context inference with
+  these — cross-module call graphs are invisible to a per-file AST pass,
+  so the hot surface is declared here instead.
+- :data:`CONTRACT_ENTRYPOINTS`: the public jitted entrypoints that MUST
+  have a Tier-B contract in ``analysis.contracts.REGISTRY``. The
+  registry-coverage test (tests/test_jaxlint.py) fails when a new public
+  hot function (one containing lax.scan/while_loop) appears in the package
+  without either a registry entry or an entry in
+  :data:`HOT_NON_ENTRYPOINTS`.
+- :data:`HOT_NON_ENTRYPOINTS`: public functions that contain hot loops but
+  are deliberately not contract entrypoints, each with a reason.
+
+Keys are POSIX path suffixes relative to the repo root.
+"""
+
+from __future__ import annotations
+
+TRACED_FUNCTIONS: dict[str, tuple[str, ...]] = {
+    "tpu_aerial_transport/control/centralized.py": (
+        "control", "equilibrium_forces", "smooth_block",
+    ),
+    "tpu_aerial_transport/control/cadmm.py": ("control",),
+    "tpu_aerial_transport/control/dd.py": ("control",),
+    "tpu_aerial_transport/control/rp_cadmm.py": ("control",),
+    "tpu_aerial_transport/control/rp_centralized.py": (
+        "control", "equilibrium_forces",
+    ),
+    "tpu_aerial_transport/control/pmrl_centralized.py": (
+        "control", "equilibrium_forces",
+    ),
+    "tpu_aerial_transport/control/lowlevel.py": ("lowlevel_control",),
+    "tpu_aerial_transport/control/so3_tracking.py": (
+        "so3_pd_tracking_control", "so3_sm_tracking_control",
+    ),
+    "tpu_aerial_transport/ops/socp.py": ("solve_socp",),
+    "tpu_aerial_transport/ops/lie.py": (
+        "hat", "hat_square", "expm_so3", "log_so3", "polar_project",
+        "polar_project_svd", "rotation_from_z", "rotation_a_to_b",
+    ),
+    "tpu_aerial_transport/ops/admm_kernel.py": ("admm_chunk_lanes",),
+    "tpu_aerial_transport/models/rqp.py": (
+        "forward_dynamics", "integrate_state", "integrate",
+    ),
+    "tpu_aerial_transport/models/rp.py": (
+        "forward_dynamics", "integrate_state", "integrate",
+    ),
+    "tpu_aerial_transport/models/pmrl.py": (
+        "forward_dynamics", "integrate_state", "integrate",
+    ),
+    "tpu_aerial_transport/envs/forest.py": (
+        "ground_height", "braking_capsule", "capsule_forest_distance",
+        "cbf_rows_from_distance", "vision_cone_mask",
+        "point_cylinder_distance", "segment_cylinder_distance",
+        "collision_cbf_rows",
+    ),
+    "tpu_aerial_transport/harness/rollout.py": ("rollout",),
+    "tpu_aerial_transport/harness/diff.py": (
+        "substep_rollout", "payload_pd_forces", "simulate_commands",
+        "plan_share_forces",
+    ),
+    "tpu_aerial_transport/resilience/rollout.py": ("resilient_rollout",),
+    "tpu_aerial_transport/resilience/faults.py": (
+        "fault_step", "apply_sensor_noise",
+    ),
+    "tpu_aerial_transport/resilience/quarantine.py": (
+        "tree_all_finite", "tree_where",
+    ),
+}
+
+# name -> short description; analysis.contracts.REGISTRY must carry
+# exactly this key set (asserted by tests/test_jaxlint.py).
+CONTRACT_ENTRYPOINTS: dict[str, str] = {
+    "control.centralized:control": "centralized SOCP+CBF control step",
+    "control.cadmm:control": "C-ADMM consensus control step (Schur path)",
+    "control.cadmm:control_forest": "C-ADMM step with env CBF rows active",
+    "control.dd:control": "dual-decomposition control step",
+    "control.rp_cadmm:control": "RP consensus-ADMM control step",
+    "control.rp_centralized:control": "RP centralized QP control step",
+    "control.pmrl_centralized:control": "PMRL centralized control step",
+    "ops.socp:solve_socp": "batched conic-QP solve (scan path)",
+    "ops.admm_kernel:solve_socp_interpret":
+        "fused ADMM chunk kernel (Pallas, interpret mode)",
+    "harness.rollout:rollout": "nominal two-rate receding-horizon rollout",
+    "resilience.rollout:resilient_rollout":
+        "fault-injected rollout with fallback ladder + quarantine",
+    "parallel.mesh:cadmm_control_sharded":
+        "agent-sharded C-ADMM step (shard_map + psum/pmax)",
+    "parallel.mesh:scenario_rollout":
+        "scenario-sharded Monte-Carlo batch rollout",
+}
+
+# Public functions containing lax.scan / lax.while_loop / lax.fori_loop
+# that are NOT contract entrypoints, with the reason they are exempt. The
+# coverage test computes the live set of public hot functions from the AST
+# and requires each to appear either here or (via its module) in a
+# REGISTRY entry — a new hot entrypoint therefore cannot land unregistered.
+HOT_NON_ENTRYPOINTS: dict[str, str] = {
+    "tpu_aerial_transport/envs/forest.py:segment_cylinder_distance":
+        "geometry kernel exercised inside every forest-coupled control "
+        "contract (capsule sweep)",
+    "tpu_aerial_transport/ops/lie.py:polar_project":
+        "fixed-iteration Newton polar decomposition; exercised inside "
+        "every integrate() call of the rollout contracts",
+    "tpu_aerial_transport/harness/diff.py:substep_rollout":
+        "differentiable-rollout research harness; tier-1 covers it via "
+        "test_diff.py, not a hot serving path",
+    "tpu_aerial_transport/harness/diff.py:make_rollout_loss":
+        "loss factory over substep_rollout (see above)",
+    "tpu_aerial_transport/harness/diff.py:simulate_commands":
+        "sysid data generator, offline tooling",
+    "tpu_aerial_transport/harness/diff.py:make_trajopt_loss":
+        "trajectory-optimization research harness, offline tooling",
+    "tpu_aerial_transport/harness/diff.py:tune_gains":
+        "host-side Adam loop around a jitted loss, not itself traced",
+}
+
+# Tier-B tile-shape waivers: entrypoint name -> reason the (8, 128) TPU
+# tile-alignment warning is accepted. The physics is n-agent-by-3-vector
+# shaped; the MXU-relevant operands are the solver's KKT operators, whose
+# padding strategy is tracked in ROADMAP open items rather than forced
+# onto every 3-vector op.
+TILE_WAIVERS: dict[str, str] = {
+    "control.centralized:control":
+        "QP dims (9+3n, m) are problem-defined; padding tracked in ROADMAP",
+    "control.cadmm:control": "per-agent 12-var Schur QPs; sub-tile by design",
+    "control.cadmm:control_forest": "same operands as control.cadmm:control",
+    "control.dd:control": "per-agent QPs + 6n dual system; sub-tile by design",
+    "control.rp_cadmm:control": "per-agent (6+3n)-var QPs; sub-tile",
+    "control.rp_centralized:control": "single (6+3n)-var QP; sub-tile",
+    "control.pmrl_centralized:control": "single QP; sub-tile",
+    "ops.socp:solve_socp": "KKT operator (nv+m)^2 < 128; fused via MXU matmul",
+    "ops.admm_kernel:solve_socp_interpret":
+        "kernel pads lanes to the sublane tile internally (_pad_lanes)",
+    "harness.rollout:rollout": "3-vector rigid-body physics; no MXU operands",
+    "resilience.rollout:resilient_rollout": "same as harness.rollout",
+    "parallel.mesh:cadmm_control_sharded":
+        "per-shard agent blocks; sub-tile by design",
+    "parallel.mesh:scenario_rollout":
+        "scenario axis is data-parallel; per-lane ops are 3-vectors",
+}

@@ -1,0 +1,187 @@
+"""Tier-A lint driver: file discovery, entrypoint-table lookup, rule
+execution, and output formatting. Stdlib-only (no jax import — see
+rules.py module docstring); loadable by file path from tools/jaxlint.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+if __package__:
+    from tpu_aerial_transport.analysis import entrypoints as _entry
+    from tpu_aerial_transport.analysis import rules as _rules
+else:  # loaded by file path (tools/jaxlint.py) — sibling modules on sys.path.
+    import entrypoints as _entry  # type: ignore
+    import rules as _rules  # type: ignore
+
+Finding = _rules.Finding
+RULES = _rules.RULES
+RULE_DOCS = _rules.RULE_DOCS
+
+
+def _posix(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def entry_names_for(path: str) -> frozenset[str]:
+    """Traced-function seeds for a file, matched by path suffix. The path
+    is made absolute first so relative invocations (e.g. linting
+    ``control/cadmm.py`` from inside the package dir) still resolve their
+    entrypoint seeds instead of silently analyzing without them."""
+    p = _posix(os.path.abspath(path))
+    for suffix, names in _entry.TRACED_FUNCTIONS.items():
+        if p.endswith(suffix):
+            return frozenset(names)
+    return frozenset()
+
+
+def iter_py_files(paths: list[str]):
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+        else:
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in {"__pycache__", ".git", ".pytest_cache"}
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_file(path: str,
+              disabled: frozenset[str] = frozenset()) -> list[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        ctx = _rules.ModuleContext(path, source, entry_names_for(path))
+    except SyntaxError as e:
+        return [Finding(
+            rule="JL000", path=path, line=e.lineno or 0, col=e.offset or 0,
+            message=f"syntax error: {e.msg}",
+        )]
+    return _rules.run_rules(ctx, disabled)
+
+
+def lint_paths(paths: list[str],
+               disabled: frozenset[str] = frozenset()) -> list[Finding]:
+    out: list[Finding] = []
+    for f in iter_py_files(paths):
+        out.extend(lint_file(f, disabled))
+    return out
+
+
+def module_context(path: str) -> "_rules.ModuleContext":
+    """Parse one file with the standard entrypoint seeding (test helper)."""
+    with open(path, encoding="utf-8") as fh:
+        return _rules.ModuleContext(path, fh.read(), entry_names_for(path))
+
+
+def public_hot_functions(paths: list[str]) -> dict[str, str]:
+    """``{"pkg/mod.py:func": "scan|while_loop|fori_loop"}`` for every
+    PUBLIC module-level function lexically containing a hot loop — the
+    live universe the Tier-B registry-coverage test checks against."""
+    import ast
+
+    out: dict[str, str] = {}
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
+                continue
+        for node in tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if node.name.startswith("_"):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    name = _rules._terminal_name(sub.func)
+                    if name in ("scan", "while_loop", "fori_loop"):
+                        out[f"{_posix(path)}:{node.name}"] = name
+                        break
+            else:
+                continue
+    return out
+
+
+def render_text(findings: list[Finding]) -> str:
+    if not findings:
+        return "jaxlint: clean (0 findings)"
+    lines = [f.render() for f in findings]
+    n_err = sum(f.severity == "error" for f in findings)
+    n_warn = len(findings) - n_err
+    lines.append(f"jaxlint: {n_err} error(s), {n_warn} warning(s)")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    return json.dumps(
+        {
+            "findings": [f.to_dict() for f in findings],
+            "errors": sum(f.severity == "error" for f in findings),
+            "warnings": sum(f.severity == "warn" for f in findings),
+            "rules": sorted(RULES),
+        },
+        indent=2,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI body shared by tools/jaxlint.py (which execs this by path)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="jit-safety / trace-contract analyzer (Tier A: pure "
+        "AST, no jax import; Tier B via --contracts).",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--disable", default="",
+                    help="comma-separated rule ids to skip (e.g. JL003,JL011)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--contracts", action="store_true",
+                    help="also run Tier-B trace contracts (imports jax)")
+    ap.add_argument("--assert-no-jax", action="store_true",
+                    help="exit 2 if jax was imported by the Tier-A run "
+                    "(self-check used by the test suite)")
+    ap.add_argument("--strict-warn", action="store_true",
+                    help="exit nonzero on warnings too")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULE_DOCS):
+            print(f"{rid}  {RULE_DOCS[rid]}")
+        return 0
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [pkg_root]
+    disabled = frozenset(
+        s.strip() for s in args.disable.split(",") if s.strip()
+    )
+    findings = lint_paths(paths, disabled)
+
+    if args.contracts:
+        sys.path.insert(0, os.path.dirname(pkg_root))
+        from tpu_aerial_transport.analysis import contracts
+
+        findings.extend(contracts.run_contracts(disabled=disabled))
+
+    print(render_json(findings) if args.format == "json"
+          else render_text(findings))
+
+    if args.assert_no_jax and "jax" in sys.modules:
+        print("jaxlint: FAIL — Tier A imported jax", file=sys.stderr)
+        return 2
+    n_err = sum(f.severity == "error" for f in findings)
+    n_warn = len(findings) - n_err
+    if n_err or (args.strict_warn and n_warn):
+        return 1
+    return 0

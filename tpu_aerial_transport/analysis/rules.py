@@ -1,0 +1,821 @@
+"""Tier-A jaxlint rules: pure-AST jit-safety checks.
+
+HARD CONSTRAINT: this module must import nothing beyond the stdlib — no
+jax, no numpy, no package modules (tools/jaxlint.py loads it by file path
+so the lint runs on machines with no accelerator stack at all). The no-jax
+property is asserted by tests/test_jaxlint.py in a subprocess.
+
+Every rule operates on one :class:`ModuleContext` (a parsed module plus
+the traced-context inference described below) and yields
+:class:`Finding` records. Rule functions are registered in :data:`RULES`;
+``tools/jaxlint.py --list-rules`` prints :data:`RULE_DOCS`.
+
+**Traced-context inference.** A purely syntactic over/under-approximation
+of "this code runs under a jax trace":
+
+- seeds: functions decorated with jit/vmap/grad/shard_map/etc. (including
+  through ``partial``), functions passed as the callable argument to
+  ``jax.jit`` / ``lax.scan`` / ``lax.while_loop`` / ``lax.fori_loop`` /
+  ``lax.cond`` / ``lax.switch`` / ``jax.vmap`` / ``shard_map`` call sites,
+  and functions named in the per-module entrypoint table
+  (``analysis.entrypoints.TRACED_FUNCTIONS`` — the public controller /
+  solver / rollout surface that callers jit);
+- propagation: any module-level function called (by bare name, directly or
+  as an attribute) from a traced function's body becomes traced, to a
+  fixpoint. Cross-module propagation is intentionally NOT performed —
+  instead each module's hot surface is named in the entrypoint table (the
+  Tier-B registry-coverage test keeps that table honest).
+
+**Host-region exemption.** Code inside an ``if`` whose test mentions
+``Tracer`` (the ``isinstance(x, jax.core.Tracer)`` guard idiom) is treated
+as host-only and exempt from every rule.
+
+**Suppression.** ``# jaxlint: disable=JL003`` on a line suppresses those
+rules for that line; ``# jaxlint: disable=all`` suppresses every rule;
+``# jaxlint: skip-file`` anywhere in the first 10 lines skips the module.
+Suppressions are the allowlist mechanism — each one should carry a short
+justification comment (see README "Static analysis / jaxlint").
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``severity`` is "error" (fails CI) or "warn"."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    context: str = ""  # enclosing function, dotted.
+    severity: str = "error"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        ctx = f" [{self.context}]" if self.context else ""
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"({self.severity}){ctx} {self.message}"
+        )
+
+
+RULE_DOCS = {
+    "JL001": (
+        "host-float-cast: float()/int()/bool()/complex() applied to a "
+        "jnp/lax expression in traced code forces a device->host sync "
+        "(ConcretizationTypeError under jit)."
+    ),
+    "JL002": (
+        "host-item-sync: .item()/.tolist()/.block_until_ready() in traced "
+        "code is a host round-trip; return arrays and materialize outside "
+        "the jitted region."
+    ),
+    "JL003": (
+        "numpy-in-trace: calling numpy (np.*) inside traced code runs on "
+        "the host per trace and concretizes tracers; use jnp, or guard "
+        "with an isinstance(..., Tracer) host-region check."
+    ),
+    "JL004": (
+        "f64-promotion: float64 dtype reachable from jnp code (np.float64 "
+        "/ jnp.float64 / dtype='float64' / dtype=float) silently widens "
+        "f32 graphs when x64 is enabled and adds convert_element_type "
+        "churn when it is not."
+    ),
+    "JL005": (
+        "traced-branch: Python if/while on a jnp/lax expression in traced "
+        "code concretizes the value (crash under jit) or silently bakes "
+        "one branch into the trace; use lax.cond/jnp.where."
+    ),
+    "JL006": (
+        "asarray-in-loop-body: jnp.asarray/jnp.array inside a "
+        "scan/while/fori body re-stages host data every iteration and "
+        "defeats constant folding; hoist the conversion out of the loop."
+    ),
+    "JL007": (
+        "assert-on-traced: bare assert on a jnp/lax expression is a "
+        "no-op or a crash under jit; use checkify or move the check to "
+        "host code."
+    ),
+    "JL008": (
+        "static-argnames-unknown: static_argnames/static_argnums "
+        "referencing parameters the jitted function does not have — the "
+        "declaration silently does nothing (or raises at call time)."
+    ),
+    "JL009": (
+        "static-argnames-missing: a jitted function has a str-defaulted "
+        "parameter not declared static; strings are unhashable-as-tracers "
+        "and will fail (or retrace) when passed."
+    ),
+    "JL010": (
+        "callback-in-trace: pure_callback/io_callback/host_callback in "
+        "traced code inserts a host round-trip into the hot path."
+    ),
+    "JL011": (
+        "print-in-trace: print() in traced code fires at trace time only "
+        "(silent after compilation); use jax.debug.print if the value is "
+        "needed, or log outside the jitted region."
+    ),
+}
+
+# Transform names whose callable argument(s) run under trace. Maps the
+# callee's terminal name to the positional indices of callable args.
+_TRANSFORM_CALLARGS = {
+    "jit": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "shard_map": (0,),
+    "custom_vmap": (0,),
+    "named_call": (0,),
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2, 3),
+    "switch": (1,),
+    "associative_scan": (0,),
+}
+
+_TRACED_DECORATOR_NAMES = frozenset(
+    {"jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint",
+     "remat", "shard_map", "custom_vmap"}
+)
+
+_PRAGMA_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SKIP_FILE_RE = re.compile(r"#\s*jaxlint:\s*skip-file")
+
+_HOST_CASTS = frozenset({"float", "int", "bool", "complex"})
+_HOST_SYNC_ATTRS = frozenset({"item", "tolist", "block_until_ready"})
+_CALLBACK_NAMES = frozenset(
+    {"pure_callback", "io_callback", "host_callback", "call_tf"}
+)
+_LOOP_TRANSFORMS = frozenset({"scan", "while_loop", "fori_loop"})
+_STAGING_CALLS = frozenset({"asarray", "array"})
+# Calls that inspect trace-time METADATA (dtypes, shapes, tree structure)
+# — concrete under tracing, so branching/asserting on them is host-safe.
+_TRACE_SAFE_CALLS = frozenset(
+    {"issubdtype", "isdtype", "result_type", "promote_types", "dtype",
+     "ndim", "shape", "size", "len", "isinstance", "hasattr",
+     "tree_structure", "treedef_is_leaf"}
+)
+
+
+def _terminal_name(node: ast.expr) -> str | None:
+    """``jax.lax.while_loop`` -> "while_loop"; ``scan`` -> "scan"."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root_name(node: ast.expr) -> str | None:
+    """``jnp.linalg.norm`` -> "jnp"; ``np.asarray`` -> "np"."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node: ast.expr) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _iter_names(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _tracer_guard_host_span(node: ast.If) -> tuple[int, int] | None:
+    """Line span of the HOST branch of an isinstance-Tracer guard, if this
+    `if` is one: `not isinstance(x, ..Tracer)` -> the body is host-only;
+    `isinstance(x, ..Tracer)` -> the else branch is. Anything fancier
+    (compound tests) gets no exemption — conservatively traced."""
+    test = node.test
+    negated = False
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        test = test.operand
+        negated = True
+    if not (isinstance(test, ast.Call)
+            and _terminal_name(test.func) == "isinstance"
+            and any(n == "Tracer" for n in _iter_names(test))):
+        return None
+    stmts = node.body if negated else node.orelse
+    if not stmts:
+        return None
+    return (stmts[0].lineno, stmts[-1].end_lineno or stmts[-1].lineno)
+
+
+class ModuleContext:
+    """Parsed module + alias tables + traced-context inference (class
+    docstring of this module). One instance per linted file."""
+
+    def __init__(self, path: str, source: str,
+                 entry_names: frozenset[str] = frozenset()):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.skip_file = any(
+            _SKIP_FILE_RE.search(ln) for ln in self.lines[:10]
+        )
+        self.entry_names = entry_names
+
+        # Per-line suppressed rule ids ("all" suppresses everything).
+        self.suppressed: dict[int, frozenset[str]] = {}
+        for i, ln in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(ln)
+            if m:
+                ids = frozenset(
+                    s.strip() for s in m.group(1).split(",") if s.strip()
+                )
+                self.suppressed[i] = ids
+
+        # Alias tables (module-wide, including function-local imports).
+        self.np_aliases: set[str] = set()
+        self.jnp_aliases: set[str] = set()
+        self.lax_aliases: set[str] = set()
+        self.jax_aliases: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    if a.name == "numpy":
+                        self.np_aliases.add(a.asname or "numpy")
+                    elif a.name == "jax.numpy":
+                        self.jnp_aliases.add(a.asname or "jax")
+                    elif a.name == "jax.lax":
+                        self.lax_aliases.add(a.asname or "jax")
+                    elif a.name == "jax":
+                        self.jax_aliases.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "numpy":
+                            self.jnp_aliases.add(a.asname or "numpy")
+                        elif a.name == "lax":
+                            self.lax_aliases.add(a.asname or "lax")
+                elif node.module == "numpy":
+                    pass  # from numpy import x — rare; not tracked.
+
+        # Parent / enclosing-function annotation.
+        self.func_of: dict[ast.AST, ast.AST | None] = {}
+        self.parent: dict[ast.AST, ast.AST] = {}
+        self.functions: list[ast.AST] = []
+
+        def annotate(node, func):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+                if isinstance(child, _FUNC_NODES):
+                    self.functions.append(child)
+                    self.func_of[child] = func
+                    annotate(child, child)
+                else:
+                    self.func_of[child] = func
+                    annotate(child, func)
+
+        annotate(self.tree, None)
+
+        # Module-level function table (top-level defs only — propagation
+        # targets). Nested defs are reached through their parents.
+        self.top_funcs: dict[str, ast.AST] = {
+            n.name: n
+            for n in self.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # ALL named defs (any nesting), for resolving callables passed to
+        # transforms — e.g. a scan body defined inside its caller. Name
+        # collisions resolve to every candidate (over-approximation).
+        self.funcs_by_name: dict[str, list[ast.AST]] = {}
+        for fn in self.functions:
+            if not isinstance(fn, ast.Lambda):
+                self.funcs_by_name.setdefault(fn.name, []).append(fn)
+
+        # Host-only regions: the branch of a Tracer-isinstance guard whose
+        # test PROVES host context — the body of
+        # `if not isinstance(x, Tracer):` or the else of
+        # `if isinstance(x, Tracer):`. Only these two canonical shapes
+        # are exempt; the traced branch of either guard is NOT (a host
+        # sync inside `if isinstance(x, Tracer): ...` is a real bug).
+        self.host_ranges: list[tuple[int, int]] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.If):
+                span = _tracer_guard_host_span(node)
+                if span is not None:
+                    self.host_ranges.append(span)
+
+        self._infer_traced()
+
+    # --- traced-context inference -------------------------------------
+    def _infer_traced(self) -> None:
+        traced: set[ast.AST] = set()
+        self.loop_bodies: set[ast.AST] = set()
+        # Functions passed TO a callback primitive run on the host by
+        # definition — they must not inherit the enclosing traced context.
+        self.host_funcs: set[ast.AST] = set()
+        # jit call sites for JL008/JL009: (call_node, fn_node_or_None,
+        # decorated_def_or_None).
+        self.jit_sites: list[tuple[ast.Call, ast.AST | None]] = []
+
+        def resolve_all(arg: ast.expr) -> list[ast.AST]:
+            """A callable argument -> candidate function nodes (any
+            nesting level; name collisions yield every candidate)."""
+            if isinstance(arg, ast.Lambda):
+                return [arg]
+            if isinstance(arg, ast.Name):
+                return self.funcs_by_name.get(arg.id, [])
+            if isinstance(arg, ast.Call):
+                # partial(f, ...) / jax.jit(f) nested in another transform.
+                tname = _terminal_name(arg.func)
+                if tname == "partial" and arg.args:
+                    return resolve_all(arg.args[0])
+                if tname in _TRANSFORM_CALLARGS and arg.args:
+                    return resolve_all(arg.args[0])
+            return []
+
+        def resolve(arg: ast.expr) -> ast.AST | None:
+            cands = resolve_all(arg)
+            return cands[0] if len(cands) == 1 else None
+
+        # Seeds from decorators.
+        for fn in self.functions:
+            if isinstance(fn, ast.Lambda):
+                continue
+            for dec in fn.decorator_list:
+                names = set(_iter_names(dec))
+                if names & _TRACED_DECORATOR_NAMES:
+                    traced.add(fn)
+                if "jit" in names:
+                    call = dec if isinstance(dec, ast.Call) else None
+                    self.jit_sites.append((call, fn))
+
+        # Seeds from entrypoint table.
+        for name in self.entry_names:
+            fn = self.top_funcs.get(name)
+            if fn is not None:
+                traced.add(fn)
+
+        # Seeds from transform call sites.
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tname = _terminal_name(node.func)
+            if tname in _CALLBACK_NAMES and node.args:
+                target = resolve(node.args[0])
+                if target is not None:
+                    self.host_funcs.add(target)
+            if tname not in _TRANSFORM_CALLARGS:
+                continue
+            for idx in _TRANSFORM_CALLARGS[tname]:
+                if idx < len(node.args):
+                    for target in resolve_all(node.args[idx]):
+                        traced.add(target)
+                        if tname in _LOOP_TRANSFORMS and (
+                            tname != "while_loop" or idx == 1
+                        ):
+                            self.loop_bodies.add(target)
+            if tname == "switch" and len(node.args) > 1 and isinstance(
+                node.args[1], (ast.List, ast.Tuple)
+            ):
+                for el in node.args[1].elts:
+                    for target in resolve_all(el):
+                        traced.add(target)
+            if tname == "jit":
+                target = resolve(node.args[0]) if node.args else None
+                self.jit_sites.append((node, target))
+
+        # Propagation to a fixpoint: bare-name calls from traced bodies.
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(traced):
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = _terminal_name(node.func)
+                    target = self.top_funcs.get(callee)
+                    if target is not None and target not in traced:
+                        traced.add(target)
+                        changed = True
+                # Nested defs inherit their parent's traced-ness (a
+                # closure defined inside a traced function runs under
+                # the same trace when called).
+            for fn in self.functions:
+                if fn in traced:
+                    continue
+                outer = self.func_of.get(fn)
+                if outer is not None and outer in traced:
+                    traced.add(fn)
+                    changed = True
+        self.traced = traced
+
+    # --- helpers used by rules ----------------------------------------
+    def in_traced(self, node: ast.AST) -> bool:
+        fn = self.func_of.get(node)
+        while fn is not None:
+            if fn in self.host_funcs:
+                return False  # callback body: host by definition.
+            if fn in self.traced:
+                return True
+            fn = self.func_of.get(fn)
+        return False
+
+    def in_host_region(self, node: ast.AST) -> bool:
+        line = getattr(node, "lineno", None)
+        if line is None:
+            return False
+        return any(lo <= line <= hi for lo, hi in self.host_ranges)
+
+    def enclosing_name(self, node: ast.AST) -> str:
+        parts = []
+        fn = self.func_of.get(node)
+        while fn is not None:
+            parts.append(getattr(fn, "name", "<lambda>"))
+            fn = self.func_of.get(fn)
+        return ".".join(reversed(parts))
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        ids = self.suppressed.get(line)
+        return ids is not None and (rule in ids or "all" in ids)
+
+    def mentions_jnp_call(self, node: ast.AST) -> bool:
+        """Does this expression CALL into jnp/lax (not merely read a
+        constant attribute like jnp.pi)?"""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                callee = _terminal_name(sub.func)
+                if callee in _TRACE_SAFE_CALLS:
+                    continue  # dtype/shape metadata — concrete under trace.
+                root = _root_name(sub.func)
+                if root in self.jnp_aliases or root in self.lax_aliases:
+                    return True
+                if root in self.jax_aliases:
+                    return True
+                target = self.top_funcs.get(callee)
+                if target is not None and target in self.traced:
+                    return True
+        return False
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                severity: str = "error") -> Finding | None:
+        line = getattr(node, "lineno", 0)
+        if self.is_suppressed(rule, line) or self.in_host_region(node):
+            return None
+        return Finding(
+            rule=rule, path=self.path, line=line,
+            col=getattr(node, "col_offset", 0), message=message,
+            context=self.enclosing_name(node), severity=severity,
+        )
+
+
+# ----------------------------------------------------------------------
+# Rules. Each takes a ModuleContext and yields Findings.
+# ----------------------------------------------------------------------
+
+def rule_jl001_host_cast(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in _HOST_CASTS and node.args):
+            continue
+        if not ctx.in_traced(node):
+            continue
+        if ctx.mentions_jnp_call(node.args[0]):
+            f = ctx.finding(
+                "JL001", node,
+                f"`{node.func.id}()` on a jnp/lax expression forces a "
+                "host sync (ConcretizationTypeError under jit)",
+            )
+            if f:
+                yield f
+
+
+def rule_jl002_host_item(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_ATTRS):
+            continue
+        if ctx.in_traced(node):
+            f = ctx.finding(
+                "JL002", node,
+                f"`.{node.func.attr}()` in traced code is a device->host "
+                "round-trip",
+            )
+            if f:
+                yield f
+
+
+def rule_jl003_numpy_in_trace(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        root = _root_name(node.func)
+        if root not in ctx.np_aliases:
+            continue
+        if ctx.in_traced(node):
+            f = ctx.finding(
+                "JL003", node,
+                f"numpy call `{_dotted(node.func)}(...)` in traced code "
+                "runs on the host and concretizes tracers; use jnp or a "
+                "Tracer-guarded host region",
+            )
+            if f:
+                yield f
+
+
+def rule_jl004_f64(ctx: ModuleContext):
+    jnp_roots = ctx.jnp_aliases
+    np_roots = ctx.np_aliases
+
+    def call_root(node):
+        p = ctx.parent.get(node)
+        while p is not None and not isinstance(p, ast.Call):
+            p = ctx.parent.get(p)
+        if isinstance(p, ast.Call):
+            return _root_name(p.func)
+        return None
+
+    for node in ast.walk(ctx.tree):
+        # jnp.float64 anywhere; np.float64 when traced or inside a jnp call.
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            root = _root_name(node)
+            bad = root in jnp_roots or (
+                root in np_roots
+                and (ctx.in_traced(node) or call_root(node) in jnp_roots)
+            )
+            if bad:
+                f = ctx.finding(
+                    "JL004", node,
+                    f"`{_dotted(node)}` feeds an f64 dtype into jnp code "
+                    "(f32 graphs widen under x64; convert churn otherwise)",
+                )
+                if f:
+                    yield f
+        # dtype="float64" / astype("float64") / dtype=float builtin —
+        # gated like the attribute branch above: only when traced or fed
+        # into a jnp call (host-side numpy f64 is legitimate).
+        elif isinstance(node, ast.keyword) and node.arg == "dtype":
+            v = node.value
+            is_str64 = isinstance(v, ast.Constant) and v.value == "float64"
+            is_pyfloat = isinstance(v, ast.Name) and v.id == "float"
+            if (is_str64 or is_pyfloat) and (
+                ctx.in_traced(node.value) or call_root(v) in jnp_roots
+            ):
+                f = ctx.finding(
+                    "JL004", v,
+                    "dtype=%s promotes to float64 under x64"
+                    % ("'float64'" if is_str64 else "float (Python)"),
+                )
+                if f:
+                    yield f
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "astype" and node.args):
+            a = node.args[0]
+            if (isinstance(a, ast.Constant) and a.value == "float64"
+                    and (ctx.in_traced(node)
+                         or _root_name(node.func) in jnp_roots)):
+                f = ctx.finding(
+                    "JL004", node, "astype('float64') widens to f64"
+                )
+                if f:
+                    yield f
+
+
+def rule_jl005_traced_branch(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        if not ctx.in_traced(node):
+            continue
+        if ctx.mentions_jnp_call(node.test):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            f = ctx.finding(
+                "JL005", node,
+                f"Python `{kind}` on a jnp/lax expression in traced code "
+                "(concretization crash under jit, or one branch silently "
+                "baked in); use lax.cond / jnp.where",
+            )
+            if f:
+                yield f
+
+
+def rule_jl006_asarray_in_loop(ctx: ModuleContext):
+    for body in ctx.loop_bodies:
+        for node in ast.walk(body):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _STAGING_CALLS):
+                continue
+            root = _root_name(node.func)
+            if root in ctx.jnp_aliases:
+                f = ctx.finding(
+                    "JL006", node,
+                    f"`{_dotted(node.func)}(...)` inside a scan/while/fori "
+                    "body re-stages data every iteration; hoist it out of "
+                    "the loop",
+                )
+                if f:
+                    yield f
+
+
+def rule_jl007_assert_on_traced(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assert):
+            continue
+        if not ctx.in_traced(node):
+            continue
+        if ctx.mentions_jnp_call(node.test):
+            f = ctx.finding(
+                "JL007", node,
+                "bare `assert` on a jnp/lax expression in traced code is "
+                "a trace-time no-op or a concretization crash; use "
+                "checkify or a host-side check",
+            )
+            if f:
+                yield f
+
+
+def _static_decls(call: ast.Call | None):
+    """(static_argnames, static_argnums) constants from a jit call node."""
+    names: list[str] = []
+    nums: list[int] = []
+    if call is None:
+        return names, nums
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names.extend(
+                    el.value for el in v.elts
+                    if isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)
+                )
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums.extend(
+                    el.value for el in v.elts
+                    if isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)
+                )
+    return names, nums
+
+
+def _params_of(fn: ast.AST):
+    if isinstance(fn, ast.Lambda):
+        a = fn.args
+    elif isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fn.args
+    else:
+        return None
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    kwonly = [p.arg for p in a.kwonlyargs]
+    return pos, kwonly, a
+
+
+def rule_jl008_static_unknown(ctx: ModuleContext):
+    for call, fn in ctx.jit_sites:
+        names, nums = _static_decls(call)
+        if fn is None or (not names and not nums):
+            continue
+        params = _params_of(fn)
+        if params is None:
+            continue
+        pos, kwonly, _ = params
+        all_names = set(pos) | set(kwonly)
+        node = call if call is not None else fn
+        for nm in names:
+            if nm not in all_names:
+                f = ctx.finding(
+                    "JL008", node,
+                    f"static_argnames names `{nm}` which is not a "
+                    f"parameter of the jitted function",
+                )
+                if f:
+                    yield f
+        for i in nums:
+            if i >= len(pos):
+                f = ctx.finding(
+                    "JL008", node,
+                    f"static_argnums index {i} out of range for the "
+                    f"jitted function ({len(pos)} positional params)",
+                )
+                if f:
+                    yield f
+
+
+def rule_jl009_static_missing(ctx: ModuleContext):
+    for call, fn in ctx.jit_sites:
+        if fn is None:
+            continue
+        params = _params_of(fn)
+        if params is None:
+            continue
+        pos, kwonly, a = params
+        names, nums = _static_decls(call)
+        static = set(names) | {pos[i] for i in nums if i < len(pos)}
+        # str-defaulted params MUST be static: strings cannot be traced.
+        defaults = list(a.defaults)
+        defaulted = (a.posonlyargs + a.args)[-len(defaults):] if defaults \
+            else []
+        pairs = list(zip(defaulted, defaults)) + [
+            (p, d) for p, d in zip(a.kwonlyargs, a.kw_defaults)
+            if d is not None
+        ]
+        for p, d in pairs:
+            if (isinstance(d, ast.Constant) and isinstance(d.value, str)
+                    and p.arg not in static):
+                node = call if call is not None else fn
+                f = ctx.finding(
+                    "JL009", node,
+                    f"jitted function parameter `{p.arg}` has a str "
+                    f"default ({d.value!r}) but is not in static_argnames "
+                    "— passing it will fail or mis-cache",
+                )
+                if f:
+                    yield f
+
+
+def rule_jl010_callback(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tname = _terminal_name(node.func)
+        if tname in _CALLBACK_NAMES and ctx.in_traced(node):
+            f = ctx.finding(
+                "JL010", node,
+                f"`{_dotted(node.func)}` inserts a host callback into a "
+                "traced hot path",
+            )
+            if f:
+                yield f
+
+
+def rule_jl011_print(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "print" and ctx.in_traced(node)):
+            f = ctx.finding(
+                "JL011", node,
+                "print() in traced code fires at trace time only; use "
+                "jax.debug.print or log outside the jitted region",
+                severity="warn",
+            )
+            if f:
+                yield f
+
+
+RULES = {
+    "JL001": rule_jl001_host_cast,
+    "JL002": rule_jl002_host_item,
+    "JL003": rule_jl003_numpy_in_trace,
+    "JL004": rule_jl004_f64,
+    "JL005": rule_jl005_traced_branch,
+    "JL006": rule_jl006_asarray_in_loop,
+    "JL007": rule_jl007_assert_on_traced,
+    "JL008": rule_jl008_static_unknown,
+    "JL009": rule_jl009_static_missing,
+    "JL010": rule_jl010_callback,
+    "JL011": rule_jl011_print,
+}
+
+
+def run_rules(ctx: ModuleContext,
+              disabled: frozenset[str] = frozenset()) -> list[Finding]:
+    if ctx.skip_file:
+        return []
+    out: list[Finding] = []
+    for rule_id, impl in RULES.items():
+        if rule_id in disabled:
+            continue
+        out.extend(impl(ctx))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_aerial_transport.control import cadmm, dd, rp_cadmm
 from tpu_aerial_transport.envs import forest as forest_mod
 from tpu_aerial_transport.models.rqp import RQPParams, RQPState
+from tpu_aerial_transport.utils import compat
 
 
 def make_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
@@ -56,7 +57,8 @@ def _sharded_control(mesh: Mesh, axis: str, n: int, state_spec,
     assert n % n_shards == 0, (n, n_shards)
 
     @partial(
-        jax.shard_map,
+        compat.shard_map,  # version shim: jax.shard_map on new jax,
+        # experimental shard_map (check_rep) on 0.4.x.
         mesh=mesh,
         in_specs=(state_spec, P(), (P(), P())),
         out_specs=(P(axis), state_spec, P()),
@@ -187,4 +189,7 @@ def scenario_rollout(rollout_fn: Callable, mesh: Mesh, axis: str = "scenario"):
         batch_args = shard_scenarios(mesh, batch_args, axis)
         return batched_jit(*batch_args)
 
+    # Observability hook: the jaxlint trace contracts (analysis/contracts.py)
+    # count cache misses and lower through the REAL compiled object.
+    run.batched_jit = batched_jit
     return run

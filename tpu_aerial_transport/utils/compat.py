@@ -1,0 +1,47 @@
+"""Version-compat shims over the small jax API surface whose location or
+keyword names moved across the jax releases this package supports.
+
+``shard_map``: promoted from ``jax.experimental.shard_map.shard_map`` to
+``jax.shard_map`` (and its replication-check kwarg renamed
+``check_rep`` -> ``check_vma``) in newer jax. On the installed 0.4.37
+only the experimental path and the old kwarg exist. All package/test code
+goes through :func:`shard_map` below, which accepts the NEW spelling
+(``check_vma``) and translates as needed — so call sites are written
+against the modern API and keep working when jax upgrades.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+
+def _resolve_shard_map():
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn  # type: ignore
+    return fn
+
+
+_SHARD_MAP = _resolve_shard_map()
+_SHARD_MAP_PARAMS = frozenset(inspect.signature(_SHARD_MAP).parameters)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` with the modern signature on every supported jax.
+
+    ``check_vma`` maps to the installed implementation's replication-check
+    kwarg (``check_vma`` on new jax, ``check_rep`` on <= 0.4.x); ``None``
+    leaves the implementation default. Usable exactly like the real one,
+    including ``functools.partial(compat.shard_map, mesh=..., ...)`` as a
+    decorator.
+    """
+    if check_vma is not None:
+        if "check_vma" in _SHARD_MAP_PARAMS:
+            kwargs["check_vma"] = check_vma
+        elif "check_rep" in _SHARD_MAP_PARAMS:
+            kwargs["check_rep"] = check_vma
+    return _SHARD_MAP(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
