@@ -1,0 +1,80 @@
+"""bench.py backend handling: the XLA-CPU fallback must produce a TAGGED
+valid record path instead of a null-valued error row, and hard failures
+must carry the machine-readable ``backend_unavailable`` status. Also the
+env-gated fused-mode resolution (ops/socp.py TPU_AERIAL_FUSED)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from tpu_aerial_transport.ops import socp  # noqa: E402
+
+
+def test_ensure_backend_cpu_fallback(monkeypatch):
+    monkeypatch.setattr(
+        bench, "_probe_backend",
+        lambda: (False, "attempt 1: backend probe timed out after 60s"),
+    )
+    platform, note = bench.ensure_backend(cpu_fallback=True)
+    assert platform == "cpu"
+    assert "unavailable" in note
+
+
+def test_ensure_backend_silent_cpu_fallback_is_tagged(monkeypatch):
+    """Plugin absent -> probe 'succeeds' on cpu without an explicit CPU
+    request: with fallback enabled this becomes a tagged cpu record, not a
+    refusal."""
+    monkeypatch.setattr(bench, "_probe_backend", lambda: (True, "cpu"))
+    monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")  # TPU request.
+    platform, note = bench.ensure_backend(cpu_fallback=True)
+    assert platform == "cpu"
+    assert "silently fell back" in note
+
+
+def test_ensure_backend_hard_failure_is_structured(monkeypatch, capsys):
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda: (False, "chip wedged")
+    )
+    with pytest.raises(SystemExit):
+        bench.ensure_backend(metric="bench_sweep", cpu_fallback=False)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["status"] == "backend_unavailable"
+    assert rec["value"] is None
+    assert rec["metric"] == "bench_sweep"
+
+
+def test_explicit_cpu_request_is_not_a_fallback(monkeypatch):
+    monkeypatch.setattr(bench, "_probe_backend", lambda: (True, "cpu"))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    platform, note = bench.ensure_backend(cpu_fallback=True)
+    assert platform == "cpu"
+    assert note is None  # an explicit CPU run is not tagged as degraded.
+
+
+def test_resolve_fused_env_gate(monkeypatch):
+    """TPU_AERIAL_FUSED overrides the non-CPU 'auto' default; CPU always
+    resolves to scan; junk values raise."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("TPU_AERIAL_FUSED", raising=False)
+    assert socp.resolve_fused("auto") == socp._AUTO_FUSED_NONCPU
+    monkeypatch.setenv("TPU_AERIAL_FUSED", "pallas")
+    assert socp.resolve_fused("auto") == "pallas"
+    monkeypatch.setenv("TPU_AERIAL_FUSED", "scan")
+    assert socp.resolve_fused("auto") == "scan"
+    monkeypatch.setenv("TPU_AERIAL_FUSED", "auto")
+    assert socp.resolve_fused("auto") == socp._AUTO_FUSED_NONCPU
+    monkeypatch.setenv("TPU_AERIAL_FUSED", "vector")
+    with pytest.raises(ValueError):
+        socp.resolve_fused("auto")
+    # Explicit modes pass through untouched, env ignored.
+    assert socp.resolve_fused("pallas") == "pallas"
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    monkeypatch.setenv("TPU_AERIAL_FUSED", "pallas")
+    assert socp.resolve_fused("auto") == "scan"

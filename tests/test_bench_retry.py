@@ -90,3 +90,32 @@ def test_fast_failure_mentioning_timeout_is_still_absent():
                            timeout_s=5, backoff_s=0.0, probe_fn=probe_rpc)
     assert rec["classification"] == "absent"
     assert rec["probe_count"] == 1
+
+
+def test_wedged_run_emits_structured_backend_unavailable_result():
+    """S6 null-record fix: a wedged/absent round's ``result`` is a
+    structured backend_unavailable record (not null), distinguishable
+    from a genuine regression by downstream tooling."""
+    rec = run_with_retries([sys.executable, "-c", "pass"], attempts=2,
+                           timeout_s=5, backoff_s=0.0,
+                           probe_fn=_probe_wedged)
+    assert rec["backend_unavailable"] is True
+    assert rec["result"]["status"] == "backend_unavailable"
+    assert rec["result"]["classification"] == "wedged"
+    assert rec["result"]["value"] is None
+    json.dumps(rec)
+    rec = run_with_retries([sys.executable, "-c", "pass"], attempts=2,
+                           timeout_s=5, backoff_s=0.0,
+                           probe_fn=_probe_absent)
+    assert rec["result"]["classification"] == "absent"
+
+
+def test_failed_bench_keeps_null_result():
+    """A bench-side failure (rc != 0 with a live chip) is a CODE problem:
+    result stays null and no backend_unavailable tag appears."""
+    rec = run_with_retries([sys.executable, "-c", "import sys; sys.exit(2)"],
+                           attempts=1, timeout_s=30, backoff_s=0.0,
+                           probe_fn=_probe_ok)
+    assert rec["classification"] == "failed"
+    assert rec["result"] is None
+    assert "backend_unavailable" not in rec

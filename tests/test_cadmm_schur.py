@@ -73,8 +73,13 @@ def test_reduced_qp_matches_full_qp():
         f_full = sol_full.x[9:].reshape(n, 3)
         c_full = sol_full.x[:9]
 
-        # Reduced QP (payload-frame plan) + reconstruction.
-        plan = cadmm.make_schur_plan(params, acfg)
+        # Reduced QP (payload-frame plan) + reconstruction. The plan is
+        # built UNPADDED here (pad_operators=False): this test pins the
+        # raw Schur algebra at V = 3(n-1); the padded-plan path is covered
+        # by tests/test_socp_padded.py's controller parity test.
+        plan = cadmm.make_schur_plan(
+            params, acfg.replace(pad_operators=False)
+        )
         pk = jax.tree.map(lambda x: x[0, int(agent_id)], plan)
         Rl = state.Rl
         Ecc, e0s, xq = cadmm._schur_state_pieces(
@@ -164,8 +169,11 @@ def test_reduced_warm_start_shapes_and_rollout():
     n = 6
     params, col, state0, acfg, f_eq = _setup(n)
     astate = cadmm.init_cadmm_state(params, acfg)
-    assert astate.warm.x.shape == (n, 12)
-    assert astate.warm.y.shape == (n, 7 + acfg.n_env_cbfs + 8)
+    # Warm starts live in the tile-padded solve layout (ops/socp.py
+    # padded tier): 12 vars -> 16, m = 25 rows -> 32.
+    _, _, nv_p, _, m_p = cadmm._qp_dims(acfg, n)
+    assert astate.warm.x.shape == (n, nv_p)
+    assert astate.warm.y.shape == (n, m_p)
     acc_des = (jnp.array([0.2, 0.0, 0.0]), jnp.zeros(3))
 
     def body(carry, _):

@@ -281,21 +281,34 @@ def test_tc103_flags_callbacks_but_not_debug_print():
         jax.make_jaxpr(with_debug)(x)) == []
 
 
-def test_tc104_flags_unaligned_dot_without_waiver():
+def test_tc104_flags_long_misaligned_contraction():
+    """TC104 v2 (enforced): a LONG contraction (>= MIN_ALIGNED_CONTRACT)
+    over a non-sublane-multiple dim is an ERROR finding; short contractions
+    and misaligned FREE dims (the folded batch supplies the lane axis) are
+    exempt."""
     import jax.numpy as jnp
     import numpy as np
 
-    def build():
-        A = jnp.asarray(np.ones((9, 130), np.float32))
+    def build(k):
+        A = jnp.asarray(np.ones((9, k), np.float32))
 
         def fn(x):
-            return A @ x  # (9, 130) @ (130,): sublane dim 9 % 8 != 0.
+            return A @ x  # contraction over k.
 
         def make_args():
-            return (jnp.ones((130,), jnp.float32),)
+            return (jnp.ones((k,), jnp.float32),)
 
         return fn, make_args
 
-    c = contracts.Contract(name="test:unaligned", build=build)
+    # k = 130: long misaligned contraction -> error-severity finding.
+    c = contracts.Contract(name="test:unaligned", build=lambda: build(130))
     findings = [f for f in contracts.check_entry(c) if f.rule == "TC104"]
-    assert findings and findings[0].severity == "warn"
+    assert findings and findings[0].severity == "error"
+    # k = 128: aligned contraction -> clean, even though the free dim is 9
+    # (free-dim alignment comes from the folded batch, not the instance).
+    c = contracts.Contract(name="test:aligned", build=lambda: build(128))
+    assert not [f for f in contracts.check_entry(c) if f.rule == "TC104"]
+    # k = 12: short misaligned contraction (3-vector/equality-block class)
+    # -> exempt below MIN_ALIGNED_CONTRACT.
+    c = contracts.Contract(name="test:short", build=lambda: build(12))
+    assert not [f for f in contracts.check_entry(c) if f.rule == "TC104"]

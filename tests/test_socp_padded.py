@@ -1,0 +1,274 @@
+"""Padded-operator tier (ops/socp.py) + donation contracts.
+
+Parity: the tile-padded solve must agree with the unpadded reference path
+to f32 reduction-order rounding — including warm starts, SOC blocks that
+land directly adjacent to the padded box rows, batched (vmapped) solves,
+and full consensus-controller steps. Donation: the donated rollout
+entrypoints must actually alias their carries in the lowered program and
+delete the donated buffers at runtime.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.control import cadmm, centralized, dd
+from tpu_aerial_transport.harness import rollout as h_rollout
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.harness.bucketing import bucket_dim
+from tpu_aerial_transport.ops import socp
+
+
+def _problem(seed=0, nv=12, n_box=17, soc_dims=(4, 4), soc_shift=True):
+    rng = np.random.default_rng(seed)
+    m = n_box + sum(soc_dims)
+    L = rng.standard_normal((nv, nv))
+    P = jnp.asarray(L @ L.T + np.eye(nv), jnp.float32)
+    q = jnp.asarray(rng.standard_normal(nv), jnp.float32)
+    A = jnp.asarray(rng.standard_normal((m, nv)) * 0.5, jnp.float32)
+    lb = jnp.asarray(rng.uniform(-2.0, -0.5, n_box), jnp.float32)
+    ub = jnp.asarray(rng.uniform(0.5, 2.0, n_box), jnp.float32)
+    shift = None
+    if soc_shift:
+        shift = jnp.asarray(
+            np.r_[np.zeros(n_box), rng.standard_normal(sum(soc_dims)) * 0.1],
+            jnp.float32,
+        )
+    return P, q, A, lb, ub, shift, n_box, soc_dims
+
+
+def test_padded_dims_bucket():
+    assert socp.padded_dims(12, 17, (4, 4)) == (16, 24)  # m 25 -> 32.
+    assert socp.padded_dims(18, 23, (4, 4)) == (24, 24)  # m 31 -> 32.
+    assert socp.padded_dims(8, 8, ()) == (8, 8)  # already aligned: no-op.
+    assert bucket_dim(37, 8) == 40 and bucket_dim(48, 8) == 48
+
+
+def test_padded_solve_matches_unpadded():
+    """Cold solve: padded == unpadded to f32 rounding; residuals too. The
+    SOC blocks sit directly after the padded (free) box rows — the
+    adjacency the projection layout must keep exact."""
+    P, q, A, lb, ub, shift, n_box, soc = _problem()
+    ref = socp.solve_socp(P, q, A, lb, ub, n_box=n_box, soc_dims=soc,
+                          iters=200, shift=shift)
+    pad = socp.solve_socp_padded(P, q, A, lb, ub, n_box=n_box, soc_dims=soc,
+                                 iters=200, shift=shift)
+    np.testing.assert_allclose(np.asarray(pad.x), np.asarray(ref.x),
+                               rtol=0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pad.y), np.asarray(ref.y),
+                               rtol=0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pad.z), np.asarray(ref.z),
+                               rtol=0, atol=1e-5)
+    assert abs(float(pad.prim_res) - float(ref.prim_res)) < 1e-5
+    assert abs(float(pad.dual_res) - float(ref.dual_res)) < 1e-5
+    # Layout shape: solution comes back UNPADDED.
+    assert pad.x.shape == ref.x.shape and pad.y.shape == ref.y.shape
+
+
+def test_padded_solve_warm_start_parity():
+    """Warm-started re-solve (the consensus controllers' steady state):
+    an unpadded warm start lifts into the padded layout exactly."""
+    P, q, A, lb, ub, shift, n_box, soc = _problem(seed=3)
+    ref0 = socp.solve_socp(P, q, A, lb, ub, n_box=n_box, soc_dims=soc,
+                           iters=150, shift=shift)
+    pad0 = socp.solve_socp_padded(P, q, A, lb, ub, n_box=n_box,
+                                  soc_dims=soc, iters=150, shift=shift)
+    q2 = q + 0.02
+    ref = socp.solve_socp(P, q2, A, lb, ub, n_box=n_box, soc_dims=soc,
+                          iters=40, shift=shift, warm=ref0)
+    pad = socp.solve_socp_padded(P, q2, A, lb, ub, n_box=n_box,
+                                 soc_dims=soc, iters=40, shift=shift,
+                                 warm=pad0)
+    np.testing.assert_allclose(np.asarray(pad.x), np.asarray(ref.x),
+                               rtol=0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pad.y), np.asarray(ref.y),
+                               rtol=0, atol=1e-5)
+
+
+def test_padded_operator_reuse_and_vmap():
+    """PaddedKKTOp built once, reused across a vmapped batch of q's —
+    the controllers' per-step pattern (operator per step, q per iteration)."""
+    P, q, A, lb, ub, shift, n_box, soc = _problem(seed=5)
+    pqp = socp.padded_kkt_operator(P, A, lb, ub, shift, n_box=n_box,
+                                   soc_dims=soc)
+    # The padded operator's real block matches the unpadded operator.
+    rho_vec = socp.make_rho_vec(A.shape[0], n_box, lb, ub, 0.4, jnp.float32)
+    op_ref = socp.kkt_operator(P, A, rho_vec)
+    nv = P.shape[-1]
+    np.testing.assert_allclose(np.asarray(pqp.op.Minv[:nv, :nv]),
+                               np.asarray(op_ref.Minv), rtol=0, atol=2e-5)
+    qs = jnp.stack([q, q + 0.1, q - 0.1])
+    sols = jax.vmap(
+        lambda q_: socp.solve_socp_padded(
+            P, q_, A, lb, ub, n_box=n_box, soc_dims=soc, iters=120,
+            shift=shift, pqp=pqp,
+        )
+    )(qs)
+    refs = jax.vmap(
+        lambda q_: socp.solve_socp(
+            P, q_, A, lb, ub, n_box=n_box, soc_dims=soc, iters=120,
+            shift=shift,
+        )
+    )(qs)
+    np.testing.assert_allclose(np.asarray(sols.x), np.asarray(refs.x),
+                               rtol=0, atol=2e-5)
+
+
+def test_pad_qp_exactness_invariants():
+    """Structural invariants the exactness argument rests on: zero pad
+    rows/cols, free pad bounds, unit pad diagonal, zero pad shift."""
+    P, q, A, lb, ub, shift, n_box, soc = _problem()
+    nv, m = P.shape[-1], A.shape[0]
+    P_p, q_p, A_p, lb_p, ub_p, shift_p = socp.pad_qp(
+        P, q, A, lb, ub, shift, n_box=n_box, soc_dims=soc
+    )
+    nv_p, n_box_p = socp.padded_dims(nv, n_box, soc)
+    pad_b = n_box_p - n_box
+    assert P_p.shape == (nv_p, nv_p) and A_p.shape == (m + pad_b, nv_p)
+    assert np.all(np.asarray(A_p[n_box:n_box_p]) == 0)  # pad rows zero.
+    assert np.all(np.asarray(A_p[:, nv:]) == 0)  # pad cols zero.
+    assert np.all(np.asarray(lb_p[n_box:]) == -socp.INF)
+    assert np.all(np.asarray(ub_p[n_box:]) == socp.INF)
+    np.testing.assert_array_equal(np.asarray(P_p[nv:, nv:]),
+                                  np.eye(nv_p - nv, dtype=np.float32))
+    assert np.all(np.asarray(shift_p[n_box:n_box_p]) == 0)
+    # SOC rows land directly after the pad rows, unchanged.
+    np.testing.assert_array_equal(np.asarray(A_p[n_box_p:, :nv]),
+                                  np.asarray(A[n_box:]))
+
+
+@pytest.mark.parametrize("ctrl", ["cadmm", "dd"])
+def test_controller_padded_matches_unpadded(ctrl):
+    """Full consensus control steps, padded vs unpadded operators: same
+    forces to f32 rounding, same iteration counts (n = 4: the Schur path
+    for C-ADMM incl. the V-padded plan cores)."""
+    n = 4
+    params, col, state = setup.rqp_setup(n)
+    state = state.replace(vl=jnp.array([0.2, 0.1, 0.0], jnp.float32))
+    f_eq = centralized.equilibrium_forces(params)
+    acc = (jnp.array([0.3, 0.0, 0.1], jnp.float32),
+           jnp.zeros(3, jnp.float32))
+    mod = cadmm if ctrl == "cadmm" else dd
+    outs = {}
+    for padded in (True, False):
+        cfg = mod.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=6, inner_iters=12, pad_operators=padded,
+        )
+        if ctrl == "cadmm":
+            cs = cadmm.init_cadmm_state(params, cfg)
+            plan = cadmm.make_plan(params, cfg)
+        else:
+            cs = dd.init_dd_state(params, cfg)
+            plan = dd.make_dd_plan(params, cfg)
+        step = jax.jit(
+            lambda c, s, cfg=cfg, plan=plan: mod.control(
+                params, cfg, f_eq, c, s, acc, None, plan=plan
+            )
+        )
+        # Two chained steps: the second exercises warm starts carried in
+        # the padded layout.
+        f1, cs, st1 = step(cs, state)
+        f2, cs, st2 = step(cs, state)
+        outs[padded] = (np.asarray(f1), np.asarray(f2),
+                        int(st1.iters), int(st2.iters))
+    assert np.abs(outs[True][0] - outs[False][0]).max() < 5e-4
+    assert np.abs(outs[True][1] - outs[False][1]).max() < 5e-4
+    assert outs[True][2:] == outs[False][2:]
+
+
+# ----------------------------- donation --------------------------------
+
+def test_jit_rollout_donates_and_deletes():
+    """The donated rollout must (a) report input-output aliasing in its
+    lowered program (the TC105 contract) and (b) actually delete the
+    donated buffers at runtime, with chained calls working."""
+    params, col, state0 = setup.rqp_setup(4)
+    cfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=8
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    from tpu_aerial_transport.control import lowlevel
+
+    llc = lowlevel.make_lowlevel_controller("pd", params)
+
+    def hl(cs, s, a):
+        return centralized.control(params, cfg, f_eq, cs, s, a)
+
+    run = h_rollout.jit_rollout(
+        hl, llc.control, params, n_hl_steps=2, hl_rel_freq=2
+    )
+    args = jax.tree.map(
+        jnp.copy, (state0, centralized.init_ctrl_state(params, cfg))
+    )
+    n_leaves = len(jax.tree.leaves(args))
+    text = run.lower(*args).as_text()
+    n_aliased = text.count("tf.aliasing_output")
+    assert n_aliased >= 6, (
+        f"expected >= 6 aliased (donated) inputs, lowered program has "
+        f"{n_aliased} of {n_leaves} donated leaves"
+    )
+    state, cs, logs = run(*args)
+    assert args[0].xl.is_deleted(), "donated physics state not deleted"
+    # Chaining the returned carries works (the serving pattern).
+    state, cs, logs = run(state, cs)
+    assert np.isfinite(np.asarray(state.xl)).all()
+
+
+def test_jit_control_step_donates_ctrl_state():
+    params, col, state0 = setup.rqp_setup(4)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=3, inner_iters=6,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    step = cadmm.jit_control_step(params, cfg, f_eq)
+    acc = (jnp.zeros(3, jnp.float32), jnp.zeros(3, jnp.float32))
+    cs = jax.tree.map(jnp.copy, cadmm.init_cadmm_state(params, cfg))
+    f, cs2, _ = step(cs, state0, acc)
+    assert cs.f.is_deleted()
+    f, cs3, _ = step(cs2, state0, acc)  # chained.
+    assert not cs3.f.is_deleted()
+
+
+def test_tc105_contract_detects_missing_donation():
+    """The TC105 check must fire when a registered donated entrypoint stops
+    aliasing (here: an undonated twin of the rollout entry)."""
+    from tpu_aerial_transport.analysis import contracts, entrypoints
+
+    name = "harness.rollout:rollout_donated"
+    assert entrypoints.DONATION_CONTRACTS[name] >= 6
+    base = contracts.REGISTRY[name]
+
+    def build_undonated():
+        fn, make_args = base.build()
+        # Re-wrap WITHOUT donation: same program, no aliasing.
+        return (lambda *a: fn(*a)), make_args
+
+    c = contracts.Contract(name=name, build=build_undonated)
+    findings = [
+        f for f in contracts.check_entry(
+            c, disabled=frozenset({"TC101", "TC103", "TC104"})
+        ) if f.rule == "TC105"
+    ]
+    assert findings, "TC105 did not fire on an undonated rollout"
+
+
+def test_misaligned_contraction_detector():
+    from tpu_aerial_transport.analysis.contracts import (
+        misaligned_contractions,
+    )
+
+    def f(a, b):
+        return a @ b
+
+    # Long misaligned contraction (37): flagged on both operands' dims.
+    jx = jax.make_jaxpr(f)(jnp.ones((8, 37)), jnp.ones((37, 8)))
+    assert misaligned_contractions(jx.jaxpr)
+    # Padded twin (40): clean.
+    jx = jax.make_jaxpr(f)(jnp.ones((8, 40)), jnp.ones((40, 8)))
+    assert not misaligned_contractions(jx.jaxpr)
+    # Short misaligned contraction (12 < MIN_ALIGNED_CONTRACT): exempt.
+    jx = jax.make_jaxpr(f)(jnp.ones((8, 12)), jnp.ones((12, 8)))
+    assert not misaligned_contractions(jx.jaxpr)

@@ -88,7 +88,7 @@ def run_with_retries(
             if not timed_out:
                 # No chip to wait for — retrying cannot help.
                 record["classification"] = "absent"
-                return record
+                return _finalize(record)
             record["classification"] = "wedged"
         else:
             t0 = time.monotonic()
@@ -126,6 +126,26 @@ def run_with_retries(
         if k + 1 < attempts:
             time.sleep(delay)
             delay *= 2.0
+    return _finalize(record)
+
+
+def _finalize(record: dict) -> dict:
+    """Make infrastructure failures first-class records: a wedged/absent
+    chip gets a structured ``backend_unavailable`` RESULT (the same schema
+    slot a healthy run's bench JSON occupies) instead of ``result: null``,
+    so downstream tooling plotting the bench trajectory can file the round
+    as "chip was down" rather than a regression or a hole. Bench-side
+    failures (``failed``) keep ``result: null`` — those ARE code problems."""
+    if record["classification"] in ("wedged", "absent") \
+            and record["result"] is None:
+        record["backend_unavailable"] = True
+        record["result"] = {
+            "metric": "bench_unavailable",
+            "value": None,
+            "status": "backend_unavailable",
+            "classification": record["classification"],
+            "error": record["last_error"],
+        }
     return record
 
 

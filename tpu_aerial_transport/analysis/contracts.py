@@ -16,17 +16,34 @@ and checks four contracts:
 - **TC103 no-callback**: the lowered text must contain no host callback
   custom-calls (``pure_callback``/``io_callback``); a callback in a hot
   path serializes every step through the host.
-- **TC104 tile-shape** (warn): flags ``dot_general`` operands whose
-  trailing dims are not multiples of the f32 TPU tile (8, 128). Every
-  current entrypoint carries an explicit waiver in
-  ``entrypoints.TILE_WAIVERS`` (the physics is 3-vector shaped and the
-  KKT operators are deliberately sub-tile); the check exists so a NEW
-  heavy operand must either be tile-aligned or add a waiver with a
-  reason.
+- **TC104 tile-alignment** (ENFORCED unless waived): flags ``dot_general``
+  contractions that run over a misaligned long dim. The f32 TPU tile is
+  (8 sublanes, 128 lanes); in this codebase the 128-lane axis is supplied
+  by the FOLDED batch (agents x Monte-Carlo scenarios — the controllers'
+  nested vmaps / the Pallas kernel's lane folding), so the static
+  per-instance contract is SUBLANE alignment of every long contraction:
+  a contracting dim of length >= :data:`MIN_ALIGNED_CONTRACT` must be a
+  multiple of 8. Short contractions (3-vector physics, 6-row equality
+  blocks) are exempt — their alignment cannot pay for itself and padding
+  them would cascade through the rigid-body layer. The padded-operator
+  tier (ops/socp.py ``pad_qp`` / ``padded_dims``, the C-ADMM Schur-plan
+  V-padding) makes the consensus controllers pass this contract; entries
+  whose operators are genuinely tiny or deliberately unpadded carry a
+  waiver in ``entrypoints.TILE_WAIVERS`` with a reason. Promoted from
+  warn-only to a failing contract when the padded tier landed (the
+  ROADMAP "revisit when padding becomes a real perf item" item).
+- **TC105 donation**: for entries listed in
+  ``entrypoints.DONATION_CONTRACTS``, the lowered program must report at
+  least the expected number of donated (input-output aliased) arguments
+  — ``tf.aliasing_output`` attrs in the StableHLO. A drop here means a
+  rollout/step carry silently went copy-in/copy-out again (e.g. an
+  output's shape/dtype diverged from its donated input), re-paying HBM
+  round-trips on every control step.
 
 Builders use deliberately tiny problem sizes: the contracts are about
-program STRUCTURE (dtypes, callbacks, cache keys), which is size-
-independent, and tier-1 runs a subset of these on every commit.
+program STRUCTURE (dtypes, callbacks, cache keys, alignment of the
+static operator edges), which is size-independent, and tier-1 runs a
+subset of these on every commit.
 """
 
 from __future__ import annotations
@@ -51,6 +68,17 @@ _F64_RE = re.compile(r"f64>")
 # `pure_callback`/`io_callback`).
 _CALLBACK_PRIMS = frozenset({"pure_callback", "io_callback"})
 
+# TC104: contracting dims at least this long must be SUBLANE_TILE-aligned.
+# Below it a contraction is "short" (3-vector physics, 6-row equality
+# blocks, 12-var reduced QPs): the reduction is latency-bound regardless of
+# alignment and padding it would cascade through the rigid-body layer.
+MIN_ALIGNED_CONTRACT = 16
+SUBLANE = 8
+
+# Donation marker jax emits into StableHLO for donated-and-aliasable args
+# (jax 0.4.x; input-output aliasing attr on the main func).
+_ALIAS_ATTR = "tf.aliasing_output"
+
 # Fast subset exercised by tier-1 on every run (tests/test_jaxlint.py);
 # the full registry runs under -m slow and via `tools/jaxlint.py
 # --contracts`. Chosen to cover the solver core, one consensus
@@ -58,6 +86,7 @@ _CALLBACK_PRIMS = frozenset({"pure_callback", "io_callback"})
 # CPU compile time each.
 FAST_SUBSET = (
     "ops.socp:solve_socp",
+    "ops.socp:solve_socp_padded",
     "control.cadmm:control",
     "harness.rollout:rollout",
 )
@@ -128,9 +157,12 @@ def _cadmm_bits(forest=None):
     from tpu_aerial_transport.control import cadmm, centralized
 
     params, col, state = _rqp_bits(4)
+    # pad_operators pinned True: TC104 checks the tile-target (padded)
+    # program structure even when the lint host is CPU, where the
+    # make_config "auto" default resolves to the raw layout.
     cfg = cadmm.make_config(
         params, col.collision_radius, col.max_deceleration,
-        max_iter=2, inner_iters=4,
+        max_iter=2, inner_iters=4, pad_operators=True,
     )
     f_eq = centralized.equilibrium_forces(params)
     plan = cadmm.make_plan(params, cfg)
@@ -165,7 +197,7 @@ def _build_dd():
     params, col, state = _rqp_bits(4)
     cfg = dd.make_config(
         params, col.collision_radius, col.max_deceleration,
-        max_iter=2, inner_iters=4,
+        max_iter=2, inner_iters=4, pad_operators=True,
     )
     f_eq = centralized.equilibrium_forces(params)
     plan = dd.make_dd_plan(params, cfg)
@@ -281,6 +313,18 @@ def _build_socp_interpret():
     return fn, make_args
 
 
+@_register("ops.socp:solve_socp_padded")
+def _build_socp_padded():
+    from tpu_aerial_transport.ops import socp
+
+    def fn(P, q, A, lb, ub):
+        return socp.solve_socp_padded(
+            P, q, A, lb, ub, n_box=6, soc_dims=(4,), iters=20, fused="scan"
+        )
+
+    return fn, _socp_problem
+
+
 def _rollout_bits():
     from tpu_aerial_transport.control import centralized, lowlevel
 
@@ -314,6 +358,29 @@ def _build_rollout():
     return fn, make_args
 
 
+@_register("harness.rollout:rollout_donated")
+def _build_rollout_donated():
+    from tpu_aerial_transport.harness import rollout as h_rollout
+
+    params, cfg, centralized, llc, hl = _rollout_bits()
+    # Already jitted WITH donation — check_entry uses the real compiled
+    # object so the TC105 aliasing count sees the donated carries.
+    fn = h_rollout.jit_rollout(
+        hl, llc.control, params, n_hl_steps=2, hl_rel_freq=2
+    )
+
+    def make_args():
+        # Decouple leaves that share a constant buffer (identical zeros
+        # dedupe) — donating one buffer twice is a runtime error; see the
+        # jit_rollout docstring.
+        return jax.tree.map(
+            jnp.copy,
+            (_rqp_bits(4)[2], centralized.init_ctrl_state(params, cfg)),
+        )
+
+    return fn, make_args
+
+
 @_register("resilience.rollout:resilient_rollout")
 def _build_resilient():
     from tpu_aerial_transport.control import cadmm, lowlevel
@@ -321,9 +388,12 @@ def _build_resilient():
     from tpu_aerial_transport.resilience import rollout as r_rollout
 
     params, col, state = _rqp_bits(4)
+    # pad_operators pinned True: TC104 checks the tile-target (padded)
+    # program structure even when the lint host is CPU, where the
+    # make_config "auto" default resolves to the raw layout.
     cfg = cadmm.make_config(
         params, col.collision_radius, col.max_deceleration,
-        max_iter=2, inner_iters=4,
+        max_iter=2, inner_iters=4, pad_operators=True,
     )
     sched = faults_mod.make_schedule(4, t_fail={1: 1}, drop_rate=0.3)
     hl = r_rollout.make_cadmm_hl_step(params, cfg)
@@ -341,15 +411,49 @@ def _build_resilient():
     return fn, make_args
 
 
+@_register("resilience.rollout:resilient_rollout_donated")
+def _build_resilient_donated():
+    from tpu_aerial_transport.control import cadmm, lowlevel
+    from tpu_aerial_transport.resilience import faults as faults_mod
+    from tpu_aerial_transport.resilience import rollout as r_rollout
+
+    params, col, state = _rqp_bits(4)
+    # pad_operators pinned True: TC104 checks the tile-target (padded)
+    # program structure even when the lint host is CPU, where the
+    # make_config "auto" default resolves to the raw layout.
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=2, inner_iters=4, pad_operators=True,
+    )
+    sched = faults_mod.make_schedule(4, t_fail={1: 1}, drop_rate=0.3)
+    hl = r_rollout.make_cadmm_hl_step(params, cfg)
+    llc = lowlevel.make_lowlevel_controller("pd", params)
+    fn = r_rollout.jit_resilient_rollout(
+        hl, llc.control, params, n_hl_steps=2, hl_rel_freq=2, faults=sched,
+    )
+
+    def make_args():
+        # Shared-constant decoupling; see _build_rollout_donated.
+        return jax.tree.map(
+            jnp.copy,
+            (_rqp_bits(4)[2], cadmm.init_cadmm_state(params, cfg)),
+        )
+
+    return fn, make_args
+
+
 @_register("parallel.mesh:cadmm_control_sharded", min_devices=4)
 def _build_mesh_cadmm():
     from tpu_aerial_transport.control import cadmm, centralized
     from tpu_aerial_transport.parallel import mesh as mesh_mod
 
     params, col, state = _rqp_bits(4)
+    # pad_operators pinned True: TC104 checks the tile-target (padded)
+    # program structure even when the lint host is CPU, where the
+    # make_config "auto" default resolves to the raw layout.
     cfg = cadmm.make_config(
         params, col.collision_radius, col.max_deceleration,
-        max_iter=2, inner_iters=4,
+        max_iter=2, inner_iters=4, pad_operators=True,
     )
     f_eq = centralized.equilibrium_forces(params)
     m = mesh_mod.make_mesh({"agent": 4})
@@ -475,10 +579,29 @@ def check_entry(contract: Contract,
                 ),
             ))
 
-    # TC102: no f64 in the lowered StableHLO while x64 is off.
-    if "TC102" not in disabled and not jax.config.jax_enable_x64:
+    # TC102 (f64 scan) and TC105 (donation) both read the lowered text.
+    expected_donated = entry_data.DONATION_CONTRACTS.get(contract.name, 0)
+    check_donation = "TC105" not in disabled and expected_donated > 0
+    need_text = check_donation or (
+        "TC102" not in disabled and not jax.config.jax_enable_x64
+    )
+    if need_text:
         text = jitted.lower(*make_args()).as_text()
-        out.extend(scan_lowered_text(text, path))
+        if "TC102" not in disabled and not jax.config.jax_enable_x64:
+            out.extend(scan_lowered_text(text, path))
+        if check_donation:
+            n_aliased = text.count(_ALIAS_ATTR)
+            if n_aliased < expected_donated:
+                out.append(Finding(
+                    rule="TC105", path=path, line=0, col=0,
+                    message=(
+                        f"lowered program aliases {n_aliased} donated "
+                        f"input(s), expected >= {expected_donated}: a "
+                        "rollout/step carry went copy-in/copy-out (an "
+                        "output's shape/dtype no longer matches its "
+                        "donated input?)"
+                    ),
+                ))
 
     # TC103 needs the jaxpr (see _CALLBACK_PRIMS); TC104 walks it too.
     check_callbacks = ("TC103" not in disabled
@@ -500,31 +623,45 @@ def check_entry(contract: Contract,
                 "(pure_callback/io_callback round-trip every step)",
             ))
 
-    # TC104: TPU tile alignment of dot operands (warn; waivable).
+    # TC104: sublane alignment of long dot_general contractions (ENFORCED;
+    # waivable per entry). See misaligned_contractions for the rule.
     if not tile_waived:
-        bad: list[str] = []
-        for eqn in _iter_eqns(jaxpr.jaxpr):
-            if eqn.primitive.name != "dot_general":
-                continue
-            for v in eqn.invars:
-                shape = getattr(v.aval, "shape", ())
-                if len(shape) >= 2 and (
-                    shape[-1] % 128 or shape[-2] % 8
-                ):
-                    bad.append(str(tuple(shape)))
+        bad = misaligned_contractions(jaxpr.jaxpr)
         if bad:
             uniq = sorted(set(bad))[:6]
             out.append(Finding(
                 rule="TC104", path=path, line=0, col=0,
                 message=(
-                    f"{len(bad)} dot_general operand(s) not (8, 128) "
-                    f"tile-aligned, e.g. {', '.join(uniq)}; align or "
-                    "add an entrypoints.TILE_WAIVERS entry with a "
-                    "reason"
+                    f"{len(bad)} dot_general contraction(s) over a long "
+                    f"misaligned dim (>= {MIN_ALIGNED_CONTRACT}, not a "
+                    f"multiple of {SUBLANE}), e.g. {', '.join(uniq)}; pad "
+                    "the operator edge (ops/socp.py pad_qp tier) or add "
+                    "an entrypoints.TILE_WAIVERS entry with a reason"
                 ),
-                severity="warn",
             ))
     return out
+
+
+def misaligned_contractions(jaxpr) -> list[str]:
+    """TC104 core, factored out for unit tests: every ``dot_general``
+    contracting dim of length >= :data:`MIN_ALIGNED_CONTRACT` that is not a
+    :data:`SUBLANE` multiple, rendered as ``"shape@dim"`` strings. Batch
+    and free dims are NOT checked: leading batch dims are the folded
+    lane axis (the 128-lane tile comes from batching at the operating
+    point), and short free dims ride along for free in a lane-parallel
+    contraction."""
+    bad: list[str] = []
+    for eqn in _iter_eqns(jaxpr):
+        if eqn.primitive.name != "dot_general":
+            continue
+        (lhs_c, rhs_c), _ = eqn.params["dimension_numbers"]
+        for v, cdims in zip(eqn.invars, (lhs_c, rhs_c)):
+            shape = getattr(v.aval, "shape", ())
+            for cd in cdims:
+                size = shape[cd]
+                if size >= MIN_ALIGNED_CONTRACT and size % SUBLANE:
+                    bad.append(f"{tuple(shape)}@{cd}")
+    return bad
 
 
 def run_contracts(names=None,

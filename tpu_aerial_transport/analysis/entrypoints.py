@@ -39,7 +39,10 @@ TRACED_FUNCTIONS: dict[str, tuple[str, ...]] = {
     "tpu_aerial_transport/control/so3_tracking.py": (
         "so3_pd_tracking_control", "so3_sm_tracking_control",
     ),
-    "tpu_aerial_transport/ops/socp.py": ("solve_socp",),
+    "tpu_aerial_transport/ops/socp.py": (
+        "solve_socp", "solve_socp_padded", "pad_qp", "pad_warm",
+        "unpad_solution", "padded_kkt_operator",
+    ),
     "tpu_aerial_transport/ops/lie.py": (
         "hat", "hat_square", "expm_so3", "log_so3", "polar_project",
         "polar_project_svd", "rotation_from_z", "rotation_a_to_b",
@@ -85,11 +88,17 @@ CONTRACT_ENTRYPOINTS: dict[str, str] = {
     "control.rp_centralized:control": "RP centralized QP control step",
     "control.pmrl_centralized:control": "PMRL centralized control step",
     "ops.socp:solve_socp": "batched conic-QP solve (scan path)",
+    "ops.socp:solve_socp_padded":
+        "tile-aligned conic-QP solve (padded-operator tier)",
     "ops.admm_kernel:solve_socp_interpret":
         "fused ADMM chunk kernel (Pallas, interpret mode)",
     "harness.rollout:rollout": "nominal two-rate receding-horizon rollout",
+    "harness.rollout:rollout_donated":
+        "donation-clean jitted rollout (carries updated in place)",
     "resilience.rollout:resilient_rollout":
         "fault-injected rollout with fallback ladder + quarantine",
+    "resilience.rollout:resilient_rollout_donated":
+        "donation-clean jitted fault-injected rollout",
     "parallel.mesh:cadmm_control_sharded":
         "agent-sharded C-ADMM step (shard_map + psum/pmax)",
     "parallel.mesh:scenario_rollout":
@@ -121,27 +130,42 @@ HOT_NON_ENTRYPOINTS: dict[str, str] = {
         "host-side Adam loop around a jitted loss, not itself traced",
 }
 
-# Tier-B tile-shape waivers: entrypoint name -> reason the (8, 128) TPU
-# tile-alignment warning is accepted. The physics is n-agent-by-3-vector
-# shaped; the MXU-relevant operands are the solver's KKT operators, whose
-# padding strategy is tracked in ROADMAP open items rather than forced
-# onto every 3-vector op.
+# Tier-B tile waivers: entrypoint name -> reason TC104 (sublane alignment
+# of long dot contractions; analysis/contracts.py) is NOT enforced there.
+# TC104 is a FAILING contract since the padded-operator tier landed
+# (ops/socp.py pad_qp; the consensus controllers and the padded solve run
+# tile-aligned and are enforced — they carry NO waiver). Waivers remain
+# only for the genuinely tiny/deliberately-unpadded programs below; a new
+# heavy entrypoint must either run on padded operators or add a row here
+# with a reason.
 TILE_WAIVERS: dict[str, str] = {
     "control.centralized:control":
-        "QP dims (9+3n, m) are problem-defined; padding tracked in ROADMAP",
-    "control.cadmm:control": "per-agent 12-var Schur QPs; sub-tile by design",
-    "control.cadmm:control_forest": "same operands as control.cadmm:control",
-    "control.dd:control": "per-agent QPs + 6n dual system; sub-tile by design",
-    "control.rp_cadmm:control": "per-agent (6+3n)-var QPs; sub-tile",
+        "single (9+3n)-var QP, one solve per step: padding the one-off "
+        "operator buys nothing measurable; the consensus hot paths are "
+        "the enforced ones",
+    "control.rp_cadmm:control": "per-agent (6+3n)-var QPs; one consensus "
+        "family, unpadded until it becomes a bench workload",
     "control.rp_centralized:control": "single (6+3n)-var QP; sub-tile",
     "control.pmrl_centralized:control": "single QP; sub-tile",
-    "ops.socp:solve_socp": "KKT operator (nv+m)^2 < 128; fused via MXU matmul",
-    "ops.admm_kernel:solve_socp_interpret":
-        "kernel pads lanes to the sublane tile internally (_pad_lanes)",
-    "harness.rollout:rollout": "3-vector rigid-body physics; no MXU operands",
-    "resilience.rollout:resilient_rollout": "same as harness.rollout",
-    "parallel.mesh:cadmm_control_sharded":
-        "per-shard agent blocks; sub-tile by design",
+    "ops.socp:solve_socp": "the UNPADDED reference tier, kept for ad-hoc "
+        "problems and the padded-vs-unpadded parity tests; hot callers go "
+        "through pad_qp/solve_socp_padded (enforced)",
+    "harness.rollout:rollout": "drives the centralized controller (waived "
+        "above); 3-vector rigid-body physics otherwise",
+    "harness.rollout:rollout_donated": "same program as harness.rollout",
     "parallel.mesh:scenario_rollout":
-        "scenario axis is data-parallel; per-lane ops are 3-vectors",
+        "scenario axis is data-parallel over the centralized-controller "
+        "rollout; per-lane ops are 3-vectors",
+}
+
+# TC105 donation contracts: entrypoint -> MINIMUM number of donated
+# (input-output aliased) arguments the lowered program must report. The
+# counts are the physics-state leaf count (6: xl, vl, Rl, wl, R, w) — the
+# floor every rollout carry must alias; controller-state leaves alias on
+# top of it. analysis/contracts.py counts `tf.aliasing_output` attrs in
+# the lowered StableHLO.
+DONATION_CONTRACTS: dict[str, int] = {
+    "harness.rollout:rollout_donated": 6,
+    "resilience.rollout:resilient_rollout_donated": 6,
+    "parallel.mesh:scenario_rollout": 6,
 }

@@ -43,6 +43,7 @@ from jax import lax
 
 from tpu_aerial_transport.control.types import EnvCBF, SolverStats, inactive_env_cbf
 from tpu_aerial_transport.envs import forest as forest_mod
+from tpu_aerial_transport.harness.bucketing import bucket_dim as _bucket_dim
 from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
 from tpu_aerial_transport.ops import lie, socp
 from tpu_aerial_transport.control.centralized import (
@@ -146,6 +147,17 @@ class RQPCADMMConfig:
     # (fixed-iteration solves, bit-identical to the historical path).
     inner_tol: float = struct.field(pytree_node=False, default=0.0)
     inner_check_every: int = struct.field(pytree_node=False, default=10)
+    # Tile-aligned operator layout (ops/socp.py padded tier): pad every
+    # per-agent QP edge — variables and constraint rows — to the next
+    # SUBLANE_TILE (8) multiple and run the inner ADMM on the padded
+    # operators (the 128-lane axis comes from the folded agent x scenario
+    # batch). Exact: pad rows are free, pad variables rest at exactly 0
+    # (socp.pad_qp docstring). The make_config default is backend-resolved
+    # ("auto" -> False on CPU, True elsewhere — tile padding is layout
+    # prep for the TPU (8, 128) tile; see socp.resolve_pad_operators);
+    # this field always holds the RESOLVED bool. False is also the
+    # bench's padded-vs-unpadded A/B switch.
+    pad_operators: bool = struct.field(pytree_node=False, default=True)
 
 
 def make_config(
@@ -167,6 +179,7 @@ def make_config(
     inner_tol: float = 0.0,
     inner_check_every: int = 10,
     solve_retry_iters: int = 4,
+    pad_operators: bool | None = None,
 ) -> RQPCADMMConfig:
     """Defaults are reference-conservative (max_iter mirrors the reference's
     100-iteration cap). For warm-started receding-horizon use, the measured
@@ -226,6 +239,9 @@ def make_config(
         inner_tol=inner_tol,
         inner_check_every=inner_check_every,
         solve_retry_iters=solve_retry_iters,
+        # None = "auto", resolved here (config build time, outside jit)
+        # like socp_fused above: tile-padded on tiled backends, raw on CPU.
+        pad_operators=socp.resolve_pad_operators(pad_operators),
     )
 
 
@@ -311,28 +327,43 @@ class CADMMState:
     held: jnp.ndarray | None = None
 
 
+def _qp_dims(cfg: RQPCADMMConfig, n: int):
+    """Static per-agent QP dims for this (cfg, n): ``(nv, n_box, nv_p,
+    n_box_p, m_p)``. The ``_p`` values are the tile bucket the solve runs in
+    (ops/socp.py ``padded_dims``); with ``pad_operators=False`` they equal
+    the raw dims. The cone layout is always [box | 2 x SOC(4)]."""
+    reduced = _use_reduced(cfg, n)  # static (trace-time) formulation choice.
+    if reduced:
+        nv, n_box = 12, 7 + cfg.n_env_cbfs
+    else:
+        nv, n_box = 9 + 3 * n, 13 + cfg.n_env_cbfs
+    if cfg.pad_operators:
+        nv_p, n_box_p = socp.padded_dims(nv, n_box, (4, 4))
+    else:
+        nv_p, n_box_p = nv, n_box
+    return nv, n_box, nv_p, n_box_p, n_box_p + 8
+
+
 def init_cadmm_state(params: RQPParams, cfg: RQPCADMMConfig) -> CADMMState:
     n = params.n
     f_eq = equilibrium_forces(params)
     dtype = f_eq.dtype
+    nv, _, nv_p, _, m_p = _qp_dims(cfg, n)
     if _use_reduced(cfg, n):
         # Reduced per-agent QP: [dv_com | dvl | dwl | own force] (12 vars).
-        n_box = 7 + cfg.n_env_cbfs
-        m = n_box + 8
         x0 = jnp.concatenate(
             [jnp.tile(jnp.zeros(9, dtype), (n, 1)), f_eq], axis=1
         )
     else:
-        nv = 9 + 3 * n
-        n_box = 13 + cfg.n_env_cbfs
-        m = n_box + 8
         x0 = jnp.tile(
             jnp.concatenate([jnp.zeros(9, dtype), f_eq.reshape(-1)]), (n, 1)
         )
+    # Warm starts live in the (possibly padded) solve layout; pad entries
+    # start — and stay — at exactly 0 (socp.pad_qp docstring).
     warm = socp.SOCPSolution(
-        x=x0,
-        y=jnp.zeros((n, m), dtype),
-        z=jnp.zeros((n, m), dtype),
+        x=jnp.pad(x0, ((0, 0), (0, nv_p - nv))),
+        y=jnp.zeros((n, m_p), dtype),
+        z=jnp.zeros((n, m_p), dtype),
         prim_res=jnp.zeros((n,), dtype),
         dual_res=jnp.zeros((n,), dtype),
     )
@@ -528,6 +559,9 @@ class SchurPlan(NamedTuple):
     #                                  + 2 k_m hat(r_u)^T hat(r_u).
     CUcore: jnp.ndarray  # (.., 6, 3)  Yinv Eu - J^T C^T.
     perm: jnp.ndarray   # (.., n) int32: [own agent, others...] column order.
+    inv_perm: jnp.ndarray  # (.., n) int32 argsort of perm — precomputed so
+    #                        the consensus loop body carries no per-iteration
+    #                        sort of a plan-static permutation.
     scale: jnp.ndarray  # (.., 6) equality-row equilibration (state-free).
 
 
@@ -632,13 +666,31 @@ def make_schur_plan(
         return SchurPlan(
             J=J, N=N, Yinv=Yinv, Eu=Eu, Mu=Mu, NCt=NCt, Nsum=Nsum,
             Jsum=Jsum, Musum=Musum, CJ=CJ, YinvEu=YinvEu, UUcore=UUcore,
-            CUcore=CUcore, perm=perm, scale=scale,
+            CUcore=CUcore, perm=perm, inv_perm=jnp.argsort(perm),
+            scale=scale,
         )
 
     rhos = jnp.asarray(_rho_schedule(cfg), dtype)
     plan = jax.vmap(
         lambda rho: jax.vmap(lambda aid: one_agent(aid, rho))(agent_ids)
     )(rhos)
+    if cfg.pad_operators:
+        # Tile-pad the eliminated-block axis V = 3(n-1) on every core that
+        # participates in a long per-iteration contraction (zero pad rows/
+        # cols — exact; the consensus loop pads d_v and slices vt to match).
+        V = 3 * (n - 1)
+        V_p = _bucket_dim(V, socp.SUBLANE_TILE)
+        pv = V_p - V
+
+        def padv(x, axes):
+            cfgpad = [(0, pv if a in axes else 0) for a in range(x.ndim)]
+            return jnp.pad(x, cfgpad)
+
+        plan = plan._replace(
+            J=padv(plan.J, (2,)), N=padv(plan.N, (2, 3)),
+            Mu=padv(plan.Mu, (3,)), NCt=padv(plan.NCt, (2,)),
+            Nsum=padv(plan.Nsum, (2,)),
+        )
     if not isinstance(plan.scale, jax.core.Tracer):
         # Guard the cross-agent row-norm invariance documented at the scale
         # construction above (skipped under tracing, where values are
@@ -952,6 +1004,18 @@ def control(
         ) * alive_cols[None, :, None]
 
     use_reduced = _use_reduced(cfg, n)
+    nv, n_box_raw, nv_p, n_box, m = _qp_dims(cfg, n)
+
+    def _pad_batch(P, q0, A, lb, ub, shift):
+        """Lift a vmapped QP batch into its tile bucket (no-op when
+        pad_operators is off) — see ops/socp.py pad_qp."""
+        if not cfg.pad_operators:
+            return P, q0, A, lb, ub, shift
+        return jax.vmap(
+            lambda P_, q_, A_, lb_, ub_, s_: socp.pad_qp(
+                P_, q_, A_, lb_, ub_, s_, n_box=n_box_raw, soc_dims=(4, 4)
+            )
+        )(P, q0, A, lb, ub, shift)
 
     if use_reduced:
         # Constant-size (12-var) Schur-reduced per-agent QPs: the eliminated
@@ -959,8 +1023,6 @@ def control(
         # mean/residual/dual updates see the same full local copies as the
         # reference (rqp_cadmm.py:569-574). All expensive elimination cores
         # come from the state-independent plan (see SchurPlan docstring).
-        n_box = 7 + cfg.n_env_cbfs
-        m = n_box + 8
         if plan is None:
             plan = make_schur_plan(params, cfg, agent_ids)
         elif plan.J.shape[1] != n_local:
@@ -969,14 +1031,15 @@ def control(
             plan = jax.tree.map(lambda x: jnp.take(x, agent_ids, axis=1), plan)
         Rl = state.Rl
         Ecc, e0s, xq = _schur_state_pieces(params, cfg, state, plan.scale[0, 0])
+        V = 3 * (n - 1)  # plan cores may be V-padded; see make_schur_plan.
 
         def build_qp(rho_k, pk):
-            P, q0, A, lb, ub, shift = jax.vmap(
+            P, q0, A, lb, ub, shift = _pad_batch(*jax.vmap(
                 lambda p, aid, ld, cbf: _schur_step_qp(
                     params, cfg, p, f_eq, state, acc_des, cbf, aid, ld,
                     rho_k, Ecc, e0s, xq,
                 )
-            )(pk, agent_ids, leaders, env_cbfs)
+            )(pk, agent_ids, leaders, env_cbfs))
             rho_vec = jax.vmap(
                 lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
             )(lb, ub)
@@ -985,20 +1048,22 @@ def control(
 
         def primal_solve(solve_one, data, rho_k, lam, f_mean, warm):
             pk, (P, q0, A, lb, ub, shift), op = data
-            inv_perm = jnp.argsort(pk.perm, axis=1)
             delta = lam - rho_k * f_mean[None, :, :]  # (n_local, n, 3)
             dperm = jnp.take_along_axis(delta, pk.perm[:, :, None], axis=1)
             d_u = dperm[:, 0, :]
-            # Other columns, rotated into the payload frame (ft = Rl^T f).
+            # Other columns, rotated into the payload frame (ft = Rl^T f),
+            # zero-extended to the plan cores' (possibly V-padded) edge.
             d_v = jnp.einsum("ij,anj->ani", Rl.T, dperm[:, 1:, :]).reshape(
-                n_local, 3 * (n - 1)
+                n_local, V
             )
+            d_v = jnp.pad(d_v, ((0, 0), (0, pk.N.shape[-1] - V)))
             jv = jnp.einsum("avk,av->ak", pk.J, d_v)  # (a, 6)
-            q = q0 + jnp.concatenate([
+            q_delta = jnp.concatenate([
                 -jnp.einsum("kc,ak->ac", Ecc, jv),
                 d_u - jnp.einsum("ij,aj->ai", Rl,
                                  jnp.einsum("ajv,av->aj", pk.Mu, d_v)),
             ], axis=1)
+            q = q0.at[:, :nv].add(q_delta)
             sols = solve_one(P, q, A, lb, ub, shift, op, warm)
             c, u = sols.x[:, :9], sols.x[:, 9:12]
             ut = jnp.einsum("ij,aj->ai", Rl.T, u)
@@ -1010,21 +1075,21 @@ def control(
                 - jnp.einsum("avj,aj->av", pk.NCt, ut)
                 + jnp.einsum("avk,ak->av", pk.J, d6)
             )
-            v = jnp.einsum("ij,anj->ani", Rl, vt.reshape(n_local, n - 1, 3))
+            v = jnp.einsum(
+                "ij,anj->ani", Rl, vt[:, :V].reshape(n_local, n - 1, 3)
+            )
             f_perm = jnp.concatenate([u[:, None, :], v], axis=1)
-            f_new = jnp.take_along_axis(f_perm, inv_perm[:, :, None], axis=1)
+            f_new = jnp.take_along_axis(f_perm, pk.inv_perm[:, :, None], axis=1)
             return f_new, sols
     else:
         onehots = jax.nn.one_hot(agent_ids, n, dtype=dtype)
-        n_box = 13 + cfg.n_env_cbfs
-        m = n_box + 8
 
         def build_qp(rho_k):
-            P, q0, A, lb, ub, shift = jax.vmap(
+            P, q0, A, lb, ub, shift = _pad_batch(*jax.vmap(
                 lambda oh, ld, cbf: _build_agent_qp(
                     params, cfg, f_eq, state, acc_des, cbf, oh, ld, rho_k
                 )
-            )(onehots, leaders, env_cbfs)
+            )(onehots, leaders, env_cbfs))
             rho_vec = jax.vmap(
                 lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
             )(lb, ub)
@@ -1034,9 +1099,9 @@ def control(
             (P, q0, A, lb, ub, shift), op = data
             # Augmented linear term <lam_i, f> - rho <f_mean, f>.
             q_extra = (lam - rho_k * f_mean[None, :, :]).reshape(n_local, 3 * n)
-            q = q0.at[:, 9:].add(q_extra)
+            q = q0.at[:, 9:nv].add(q_extra)
             sols = solve_one(P, q, A, lb, ub, shift, op, warm)
-            f_new = sols.x[:, 9:].reshape(n_local, n, 3)
+            f_new = sols.x[:, 9:nv].reshape(n_local, n, 3)
             return f_new, sols
 
     # rho schedule (reference :565-567, :657): precompute the per-agent QP
@@ -1233,3 +1298,24 @@ def control(
         ok_frac=ok_frac,
     )
     return f_app, new_state, stats
+
+
+def jit_control_step(params, cfg, f_eq, forest=None, plan=None,
+                     donate: bool = True):
+    """Jitted single control step ``(admm_state, state, acc_des) ->
+    (f_app, admm_state, stats)`` with the ADMM-state carry DONATED: the
+    warm starts, local copies, and duals are updated in place instead of
+    round-tripping fresh HBM buffers on every control step — the serving
+    pattern for step-at-a-time MPC callers (rollout scans get the same
+    effect from the scan carry; see harness.rollout.jit_rollout). The
+    caller must thread the returned state forward and not reuse the
+    donated argument (jax deletes its buffers)."""
+    if plan is None:
+        plan = make_plan(params, cfg)
+
+    def step(admm_state, state, acc_des):
+        return control(
+            params, cfg, f_eq, admm_state, state, acc_des, forest, plan=plan
+        )
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -68,6 +68,7 @@ def make_config(
     inner_tol: float = 0.0,
     inner_check_every: int = 10,
     solve_retry_iters: int = 4,
+    pad_operators: bool | None = None,
 ) -> RQPDDConfig:
     """Defaults are reference-conservative. For warm-started receding-horizon
     use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
@@ -90,7 +91,7 @@ def make_config(
         n_env_cbfs=n_env_cbfs, max_iter=max_iter, inner_iters=inner_iters,
         k_smooth=k_smooth, dt=dt, socp_fused=socp_fused,
         inner_tol=inner_tol, inner_check_every=inner_check_every,
-        solve_retry_iters=solve_retry_iters,
+        solve_retry_iters=solve_retry_iters, pad_operators=pad_operators,
     )
     return RQPDDConfig(base=base, prim_inf_tol=prim_inf_tol)
 
@@ -115,6 +116,18 @@ class DDState:
     held_lam_M: jnp.ndarray | None = None
 
 
+def _qp_dims(cfg: RQPDDConfig):
+    """Static DD per-agent QP dims ``(nv, n_box, nv_p, n_box_p, m_p)`` —
+    the ``_p`` values are the tile bucket (ops/socp.py padded tier), equal
+    to the raw dims when ``pad_operators`` is off."""
+    nv, n_box = 18, 13 + cfg.base.n_env_cbfs
+    if cfg.base.pad_operators:
+        nv_p, n_box_p = socp.padded_dims(nv, n_box, (4, 4))
+    else:
+        nv_p, n_box_p = nv, n_box
+    return nv, n_box, nv_p, n_box_p, n_box_p + 8
+
+
 def init_dd_state(params: RQPParams, cfg: RQPDDConfig) -> DDState:
     n = params.n
     f_eq = equilibrium_forces(params)
@@ -125,16 +138,16 @@ def init_dd_state(params: RQPParams, cfg: RQPDDConfig) -> DDState:
         "ij,njk,nk->ni", params.JT_inv,
         jax.vmap(lie.hat)(params.r_com), f_eq,
     )
-    nv = 18
-    n_box = 13 + cfg.base.n_env_cbfs
-    m = n_box + 8
+    nv, _, nv_p, _, m_p = _qp_dims(cfg)
     x0 = jnp.concatenate(
         [jnp.zeros((n, 9), dtype), f_eq, F0, M0], axis=1
     )
+    # Warm starts live in the (possibly padded) solve layout; pad entries
+    # start — and stay — at exactly 0 (socp.pad_qp docstring).
     warm = socp.SOCPSolution(
-        x=x0,
-        y=jnp.zeros((n, m), dtype),
-        z=jnp.zeros((n, m), dtype),
+        x=jnp.pad(x0, ((0, 0), (0, nv_p - nv))),
+        y=jnp.zeros((n, m_p), dtype),
+        z=jnp.zeros((n, m_p), dtype),
         prim_res=jnp.zeros((n,), dtype),
         dual_res=jnp.zeros((n,), dtype),
     )
@@ -540,8 +553,15 @@ def control(
         )
     )(f_eq_local, r_com_local, R_local, w_local, leaders, env_cbfs)
 
-    n_box = 13 + base.n_env_cbfs
-    m = n_box + 8
+    _, n_box_raw, _, n_box, m = _qp_dims(cfg)
+    if base.pad_operators:
+        # Tile-aligned operator layout (ops/socp.py padded tier; exact —
+        # pad rows are free, pad variables rest at 0).
+        P, q0, A, lb, ub, shift = jax.vmap(
+            lambda P_, q_, A_, lb_, ub_, s_: socp.pad_qp(
+                P_, q_, A_, lb_, ub_, s_, n_box=n_box_raw, soc_dims=(4, 4)
+            )
+        )(P, q0, A, lb, ub, shift)
     rho_vec = jax.vmap(
         lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
     )(lb, ub)
@@ -746,3 +766,20 @@ def control(
         ok_frac=ok_frac,
     )
     return f, new_state, stats
+
+
+def jit_control_step(params, cfg, f_eq, forest=None, plan=None,
+                     donate: bool = True):
+    """Jitted single DD control step with the solver-state carry DONATED
+    (primal optima, duals, warm starts updated in place) — the DD twin of
+    :func:`control.cadmm.jit_control_step`; same contract: thread the
+    returned state forward, never reuse the donated argument."""
+    if plan is None:
+        plan = make_dd_plan(params, cfg)
+
+    def step(dd_state, state, acc_des):
+        return control(
+            params, cfg, f_eq, dd_state, state, acc_des, forest, plan=plan
+        )
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

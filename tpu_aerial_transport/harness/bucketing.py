@@ -30,6 +30,20 @@ import jax
 import jax.numpy as jnp
 
 
+def bucket_dim(d: int, tile: int) -> int:
+    """Round a static dim up to its shape bucket (the next ``tile``
+    multiple). This is the SHAPE-bucketing twin of the congestion bucketing
+    below: ops/socp.py's padded-operator tier (``padded_dims``) routes every
+    QP family's operator edges through this rounding, so heterogeneous
+    per-agent dims (C-ADMM reduced d = 37, DD d = 49, ...) land on a coarse
+    grid of tile multiples and families whose padded shapes coincide share
+    one compiled solver program (the jit cache keys on the bucket, not the
+    raw dim)."""
+    if d < 0 or tile <= 0:
+        raise ValueError((d, tile))
+    return ((d + tile - 1) // tile) * tile
+
+
 def _take(tree, idx):
     return jax.tree.map(lambda x: jnp.take(x, idx, axis=0), tree)
 

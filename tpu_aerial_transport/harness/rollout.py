@@ -141,6 +141,43 @@ def rollout(
     return state, cs, logs
 
 
+def jit_rollout(
+    hl_step: Callable,
+    ll_control: Callable,
+    params: rqp.RQPParams,
+    *,
+    n_hl_steps: int,
+    hl_rel_freq: int = 10,
+    dt: float = 1e-3,
+    acc_des_fn: Callable | None = None,
+    donate: bool = True,
+):
+    """Donation-clean jitted rollout entrypoint: returns ``run(state0,
+    ctrl_state0) -> (final_state, final_ctrl_state, logs)`` with BOTH
+    carries donated, so a receding-horizon caller that chains rollouts
+    (``state, cs, _ = run(state, cs)``) updates the physics state and the
+    controller's warm starts/duals in place instead of allocating fresh
+    buffers per call. The donated arguments are deleted by jax — always
+    thread the returned values forward (tests/test_socp_padded.py asserts
+    both the lowered input-output aliasing and the runtime deletion).
+    ``donate=False`` compiles the same program without aliasing for
+    callers that must replay the same initial state.
+
+    Shared-buffer caveat: jax deduplicates identical small constants, so a
+    freshly built initial state can hold several leaves backed by ONE
+    buffer (e.g. the zero ``vl``/``wl``/``w`` of a rest state) — donating
+    that pytree raises "Attempt to donate the same buffer twice". Decouple
+    first: ``state0 = jax.tree.map(jnp.copy, state0)``. Carries returned
+    by a previous donated call are always decoupled."""
+    def run(state0, ctrl_state0):
+        return rollout(
+            hl_step, ll_control, params, state0, ctrl_state0,
+            n_hl_steps, hl_rel_freq, dt, acc_des_fn,
+        )
+
+    return jax.jit(run, donate_argnums=(0, 1) if donate else ())
+
+
 def logs_to_dict(logs: RQPLogStep, n: int, dt: float, hl_rel_freq: int,
                  forest: forest_mod.Forest | None = None) -> dict:
     """Flatten a log pytree into the reference's pickle-dict schema

@@ -46,13 +46,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANE_TILE = 128
+SUBLANE_TILE = 8  # f32 sublane tile; see ops/socp.py's padded-operator tier.
 # Above this operator edge the per-lane K2 tile no longer fits VMEM
-# residency (block bytes = 4 d^2 LANE_TILE, double-buffered by the pipeline:
-# d = 96 -> ~4.7 MB/block, x2 in flight ~9.4 MB of the ~16 MB VMEM; d = 450
-# for centralized n = 64 would need ~100 MB): callers fall back to scan.
-# Covers the consensus controllers' solves (reduced C-ADMM d = 37, DD d = 49
-# at the default 10 env-CBF rows).
-MAX_FUSED_DIM = 96
+# residency (block bytes = 4 d^2 LANE_TILE, double-buffered by the pipeline;
+# d = 450 for centralized n = 64 would need ~100 MB): callers fall back to
+# scan. Recomputed for the PADDED operator tier (ops/socp.py pad_qp rounds
+# every edge to SUBLANE_TILE, so the hot dims are now d = 48 for the
+# reduced C-ADMM QPs and d = 56 for DD at the default 10 env-CBF rows, and
+# every block is exact-tile (d % 8 == 0 sublanes x LANE_TILE lanes) —
+# no Mosaic-side row padding): the budget is ~14 MB of the ~16 MB VMEM for
+# the double-buffered K2 blocks, 2 x 4 d^2 x 128 <= 14 MB -> d <= 116,
+# rounded DOWN to the sublane tile.
+MAX_FUSED_DIM = 112
 
 
 def _admm_chunk_kernel(
@@ -141,6 +146,13 @@ def admm_chunk_lanes(
     Padded lanes (B rounded up to LANE_TILE) run the iteration on zero
     operators with rho = 1 — every intermediate stays finite — and are
     sliced off before returning.
+
+    Tile alignment: the lane axis is padded to LANE_TILE here, so with
+    operators from the padded tier (ops/socp.py pad_qp: every row dim a
+    SUBLANE_TILE multiple) each block spec below is EXACT-tile — (8k, 128)
+    f32 blocks with no Mosaic-side padding. Sub-tile row dims from legacy
+    unpadded callers still lower correctly; they just pay Mosaic's internal
+    padding.
     """
     B = x.shape[0]
     m = rho.shape[-1]

@@ -49,6 +49,7 @@ Design notes vs the reference:
 from __future__ import annotations
 
 import functools
+import os
 from functools import partial
 from typing import NamedTuple, Sequence
 
@@ -59,9 +60,19 @@ from jax import lax
 EQ_RHO_SCALE = 1e3  # OSQP's rho boost for equality rows.
 INF = 1e20  # "infinity" bound; keeps arithmetic finite in f32... used via clipping.
 
-# What ``fused="auto"`` resolves to on a non-CPU backend. Stays "scan" until
-# the Pallas chunk kernel is validated on the real chip; flip to "pallas"
-# after on-TPU A/B (see ops/admm_kernel.py and bench.py --fused).
+# f32 TPU tile: 8 sublanes x 128 lanes. The padded-operator tier
+# (:func:`pad_qp` / :class:`PaddedKKTOp`) rounds every operator edge
+# (variables, constraint rows) up to the SUBLANE tile; the 128-lane axis is
+# supplied by the FOLDED batch (agents x Monte-Carlo scenarios — the
+# controllers' nested vmaps and ops/admm_kernel.py's lane folding), not by
+# per-instance padding, so a lone solve never pays 128x blow-up on its
+# operator edges.
+SUBLANE_TILE = 8
+
+# What ``fused="auto"`` resolves to on a non-CPU backend when the
+# TPU_AERIAL_FUSED env var does not say otherwise. Stays "scan" until the
+# Pallas chunk kernel is validated on the real chip; the A/B criterion for
+# flipping the default is in :func:`resolve_fused`'s docstring.
 _AUTO_FUSED_NONCPU = "scan"
 
 
@@ -75,6 +86,15 @@ class KKTOp(NamedTuple):
     # solve_socp's own argument cannot silently mix the two (which would
     # converge to a slightly wrong fixed point).
     sigma: jnp.ndarray = 1e-6
+    # Prebuilt fused iteration operator [[sigma Minv, MinvAT],
+    # [A sigma Minv, A MinvAT]] ((nv+m, nv+m) — see solve_socp's K2). Built
+    # by :func:`kkt_operator` so the concatenates/matmuls run ONCE where the
+    # operator is built (the controllers build it outside their consensus
+    # loops) instead of relying on XLA hoisting them out of the enclosing
+    # while_loop — measured ~0.5 ms/consensus-iteration at n = 64 on CPU
+    # when the hoist does not happen. None on operators built by older
+    # callers; solve_socp falls back to building it inline.
+    K2: jnp.ndarray | None = None
 
 
 class SOCPSolution(NamedTuple):
@@ -83,6 +103,209 @@ class SOCPSolution(NamedTuple):
     z: jnp.ndarray  # (m,) projected constraint values (A x at optimum).
     prim_res: jnp.ndarray  # () inf-norm of A x - z.
     dual_res: jnp.ndarray  # () inf-norm of P x + q + A^T y.
+
+
+class PaddedKKTOp(NamedTuple):
+    """Tile-aligned solve bundle: the padded problem data plus the KKT
+    operator built on the padded layout (see :func:`padded_kkt_operator`).
+
+    This is the hot-path tier: every edge of every iterated operator
+    (``Minv``/``MinvAT``/``A``/``K2``, and the bounds/shift rows) is padded
+    to a :data:`SUBLANE_TILE` multiple via :func:`padded_dims`, so the inner
+    ADMM matvec contracts over lane-aligned dims and the 128-lane axis comes
+    from the folded agent x scenario batch. Build once per (P, A) — e.g.
+    once per control step in the consensus controllers — and solve many
+    times with only ``q``/``warm`` moving.
+    """
+
+    P: jnp.ndarray  # (nv_p, nv_p) padded cost (identity on the pad block).
+    A: jnp.ndarray  # (m_p, nv_p) padded constraints (zero pad rows/cols).
+    lb: jnp.ndarray  # (n_box_p,) padded box bounds (pad rows are free).
+    ub: jnp.ndarray  # (n_box_p,)
+    shift: jnp.ndarray  # (m_p,) padded cone shift (zero on pad rows).
+    op: KKTOp  # operator built FROM the padded data (block-exact).
+
+
+def padded_dims(nv: int, n_box: int, soc_dims: Sequence[int] = ()):
+    """Shape bucket for a padded QP: ``(nv_p, n_box_p)`` with ``nv_p`` and
+    ``m_p = n_box_p + sum(soc_dims)`` the next :data:`SUBLANE_TILE`
+    multiples of ``nv`` / ``m``. Padding goes into the BOX region (free
+    rows), never into SOC blocks, so the static cone layout
+    ``(n_box_p, soc_dims)`` stays exact.
+
+    Bucketing: because every QP family rounds into the same coarse grid of
+    tile multiples (harness/bucketing.py's :func:`~tpu_aerial_transport.
+    harness.bucketing.bucket_dim`), heterogeneous per-agent dims that land
+    in the same bucket — e.g. two controllers whose padded ``(nv_p, m_p,
+    soc_dims)`` coincide — share one compiled ``solve_socp`` program (the
+    jit cache keys on the padded shapes)."""
+    from tpu_aerial_transport.harness.bucketing import bucket_dim
+
+    m = n_box + sum(soc_dims)
+    nv_p = bucket_dim(nv, SUBLANE_TILE)
+    m_p = bucket_dim(m, SUBLANE_TILE)
+    return nv_p, n_box_p_from(m, m_p, n_box)
+
+
+def n_box_p_from(m: int, m_p: int, n_box: int) -> int:
+    """Padded box-row count: all row padding lands in the box region."""
+    return n_box + (m_p - m)
+
+
+def pad_qp(P, q, A, lb, ub, shift=None, *, n_box: int,
+           soc_dims: Sequence[int] = ()):
+    """Pad one QP to its tile bucket — EXACT in exact arithmetic, and the
+    real entries' arithmetic is unchanged in f32 too (the pad entries are
+    zeros; ``x + 0`` is exact), so padded and unpadded solves agree to the
+    reduction-order rounding of the underlying matmuls.
+
+    Layout: variables ``[real nv | pad]``; rows ``[box n_box | pad box |
+    SOC blocks]`` (SOC blocks keep their exact dims, adjacent to the padded
+    rows). Pad semantics:
+
+    - pad variables: unit diagonal in ``P``, zero ``q``/columns — their
+      x-update is ``x+ = sigma/(1+sigma) x`` from a zero start: exactly 0;
+    - pad rows: zero ``A`` rows with FREE bounds (``+-INF``) and zero
+      shift — the box projection is the identity there, ``y`` stays exactly
+      0 and ``z`` tracks ``A x = 0``, so residuals are untouched.
+
+    Single-instance; ``vmap`` for batches. Returns ``(P_p, q_p, A_p, lb_p,
+    ub_p, shift_p)``; statics come from :func:`padded_dims`.
+    """
+    dtype = P.dtype
+    nv = P.shape[-1]
+    m = A.shape[-2]
+    nv_p, n_box_p = padded_dims(nv, n_box, soc_dims)
+    pad_v = nv_p - nv
+    pad_b = n_box_p - n_box
+    P_p = jnp.pad(P, ((0, pad_v), (0, pad_v)))
+    if pad_v:
+        P_p = P_p.at[nv:, nv:].add(jnp.eye(pad_v, dtype=dtype))
+    q_p = jnp.pad(q, (0, pad_v))
+    A_rows = jnp.concatenate(
+        [A[:n_box], jnp.zeros((pad_b, nv), dtype), A[n_box:]], axis=0
+    )
+    A_p = jnp.pad(A_rows, ((0, 0), (0, pad_v)))
+    lb_p = jnp.concatenate([lb, jnp.full((pad_b,), -INF, dtype)])
+    ub_p = jnp.concatenate([ub, jnp.full((pad_b,), INF, dtype)])
+    if shift is None:
+        shift_p = jnp.zeros((m + pad_b,), dtype)
+    else:
+        shift_p = jnp.concatenate(
+            [shift[:n_box], jnp.zeros((pad_b,), dtype), shift[n_box:]]
+        )
+    return P_p, q_p, A_p, lb_p, ub_p, shift_p
+
+
+def pad_warm(warm: "SOCPSolution", *, n_box: int,
+             soc_dims: Sequence[int] = ()) -> "SOCPSolution":
+    """Lift an unpadded warm start into the padded layout (zero pad entries
+    — the exact fixed point of the pad rows/variables)."""
+    nv = warm.x.shape[-1]
+    m = warm.y.shape[-1]
+    nv_p, n_box_p = padded_dims(nv, n_box, soc_dims)
+    pad_b = n_box_p - n_box
+
+    def pad_rows(v):
+        zeros = jnp.zeros(v.shape[:-1] + (pad_b,), v.dtype)
+        return jnp.concatenate(
+            [v[..., :n_box], zeros, v[..., n_box:]], axis=-1
+        )
+
+    return SOCPSolution(
+        x=jnp.pad(warm.x, [(0, 0)] * (warm.x.ndim - 1) + [(0, nv_p - nv)]),
+        y=pad_rows(warm.y), z=pad_rows(warm.z),
+        prim_res=warm.prim_res, dual_res=warm.dual_res,
+    )
+
+
+def unpad_solution(sol: "SOCPSolution", nv: int, n_box: int,
+                   n_box_p: int) -> "SOCPSolution":
+    """Project a padded-layout solution back to the unpadded layout (drop
+    pad variables and pad rows; residual scalars are already exact — the
+    pad rows contribute exactly 0 to both inf-norms)."""
+
+    def drop_rows(v):
+        return jnp.concatenate([v[..., :n_box], v[..., n_box_p:]], axis=-1)
+
+    return SOCPSolution(
+        x=sol.x[..., :nv], y=drop_rows(sol.y), z=drop_rows(sol.z),
+        prim_res=sol.prim_res, dual_res=sol.dual_res,
+    )
+
+
+def padded_kkt_operator(P, A, lb, ub, shift=None, *, n_box: int,
+                        soc_dims: Sequence[int] = (), rho: float = 0.4,
+                        sigma: float = 1e-6) -> PaddedKKTOp:
+    """Build the tile-aligned solve bundle for one QP: pad to the bucket
+    (:func:`pad_qp` with a zero linear term — ``q`` moves per solve) and
+    build the KKT operator ON the padded data. The padded system matrix is
+    block-diagonal (``[[M, 0], [0, (1+sigma) I]]``), so the real block of
+    ``Minv`` matches the unpadded operator to LU rounding and the pad block
+    is exactly diagonal. Single-instance; ``vmap`` for batches."""
+    dtype = P.dtype
+    nv = P.shape[-1]
+    m = A.shape[-2]
+    nv_p, n_box_p = padded_dims(nv, n_box, soc_dims)
+    P_p, _, A_p, lb_p, ub_p, shift_p = pad_qp(
+        P, jnp.zeros((nv,), dtype), A, lb, ub, shift,
+        n_box=n_box, soc_dims=soc_dims,
+    )
+    m_p = m + (n_box_p - n_box)
+    rho_vec = make_rho_vec(m_p, n_box_p, lb_p, ub_p, rho, dtype)
+    op = kkt_operator(P_p, A_p, rho_vec, sigma)
+    return PaddedKKTOp(P=P_p, A=A_p, lb=lb_p, ub=ub_p, shift=shift_p, op=op)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_box", "soc_dims", "iters", "check_every", "tol",
+                     "fused", "alpha", "rho", "sigma"),
+)
+def solve_socp_padded(
+    P: jnp.ndarray,
+    q: jnp.ndarray,
+    A: jnp.ndarray,
+    lb: jnp.ndarray,
+    ub: jnp.ndarray,
+    *,
+    n_box: int,
+    soc_dims: Sequence[int] = (),
+    iters: int = 200,
+    rho: float = 0.4,
+    sigma: float = 1e-6,
+    alpha: float = 1.6,
+    warm: SOCPSolution | None = None,
+    check_every: int = 0,
+    tol: float = 0.0,
+    shift: jnp.ndarray | None = None,
+    pqp: PaddedKKTOp | None = None,
+    fused: str = "auto",
+) -> SOCPSolution:
+    """Tile-aligned :func:`solve_socp`: pads the problem to its bucket
+    (:func:`padded_dims`), solves on the padded layout, and returns the
+    solution in the UNPADDED layout (pad variables/rows sliced off). Accepts
+    a prebuilt :class:`PaddedKKTOp` via ``pqp`` for operator reuse across
+    solves; ``warm`` is an UNPADDED warm start. Agreement with the unpadded
+    path is to f32 reduction-order rounding (tests/test_socp_padded.py)."""
+    nv = P.shape[-1]
+    n_box_p = padded_dims(nv, n_box, soc_dims)[1]
+    if pqp is None:
+        pqp = padded_kkt_operator(
+            P, A, lb, ub, shift, n_box=n_box, soc_dims=soc_dims,
+            rho=rho, sigma=sigma,
+        )
+    q_p = jnp.pad(q, (0, pqp.P.shape[-1] - nv))
+    warm_p = None if warm is None else pad_warm(
+        warm, n_box=n_box, soc_dims=soc_dims
+    )
+    sol = solve_socp(
+        pqp.P, q_p, pqp.A, pqp.lb, pqp.ub,
+        n_box=n_box_p, soc_dims=tuple(soc_dims), iters=iters, rho=rho,
+        sigma=sigma, alpha=alpha, warm=warm_p, check_every=check_every,
+        tol=tol, shift=pqp.shift, op=pqp.op, fused=fused,
+    )
+    return unpad_solution(sol, nv, n_box, n_box_p)
 
 
 def project_soc(z: jnp.ndarray) -> jnp.ndarray:
@@ -206,12 +429,49 @@ def _fused_chunk_runner(nv: int, n_box: int, soc_dims: tuple, iters: int,
 
 
 def resolve_fused(fused: str) -> str:
-    """Resolve ``"auto"`` to the backend default ("scan" on CPU,
-    ``_AUTO_FUSED_NONCPU`` elsewhere). Controllers call this at CONFIG BUILD
+    """Resolve ``"auto"`` to the backend default: "scan" on CPU (the Pallas
+    kernel has no useful CPU lowering); elsewhere the ``TPU_AERIAL_FUSED``
+    env var (``pallas`` | ``scan`` | ``auto``/unset) and then the in-code
+    default ``_AUTO_FUSED_NONCPU``. Controllers call this at CONFIG BUILD
     time (outside jit) so the chosen mode is an explicit static config field
     — resolving inside a jitted function would bake the first backend seen
     into a trace cache keyed only on the "auto" string (stale if the
-    process later switches platforms)."""
+    process later switches platforms).
+
+    **A/B criterion for flipping the non-CPU default to "pallas"** (kept
+    here so the ops A/B and the flip live together): on a live chip,
+    (1) ``python bench.py --smoke`` passes (Mosaic compiles the kernel and
+    scan/pallas solutions agree < 5e-4), and (2) the checkpointed sweep's
+    fused A/B cells (``headline_fused_pallas_*``,
+    ``{cadmm,dd}_n64_batch64_fused_pallas``) beat their scan twins by >=
+    10% on the batched configs. Until both hold on-chip, deployments can
+    opt in per-process with ``TPU_AERIAL_FUSED=pallas`` (or per-config via
+    ``socp_fused="pallas"``) without a code change.
+
+    The env var is consulted HERE only — i.e. at config-build time, the
+    documented resolution point. ``solve_socp`` called directly with
+    ``fused="auto"`` resolves backend-only (:func:`_resolve_fused`): an
+    env read inside its jitted body would execute at trace time and be
+    cached under the static key "auto", so a later env change would be
+    silently ignored — the exact staleness this function exists to avoid.
+    Direct callers who want the env gate call ``resolve_fused`` themselves
+    (or pass an explicit mode)."""
+    if fused == "auto" and jax.default_backend() != "cpu":
+        env = os.environ.get("TPU_AERIAL_FUSED", "").strip().lower()
+        if env in ("pallas", "scan"):
+            return env
+        if env not in ("", "auto"):
+            raise ValueError(
+                f"TPU_AERIAL_FUSED={env!r}: expected 'pallas', 'scan' or "
+                "'auto'"
+            )
+    return _resolve_fused(fused)
+
+
+def _resolve_fused(fused: str) -> str:
+    """solve_socp-internal "auto" resolution: backend-only, NO env read
+    (see resolve_fused — env reads under trace go stale in the jit cache).
+    """
     if fused == "auto":
         return (
             "scan" if jax.default_backend() == "cpu" else _AUTO_FUSED_NONCPU
@@ -219,7 +479,17 @@ def resolve_fused(fused: str) -> str:
     return fused
 
 
-_resolve_fused = resolve_fused  # solve_socp-internal alias (direct callers).
+def resolve_pad_operators(pad: bool | None) -> bool:
+    """Resolve the controllers' ``pad_operators="auto"`` (None) to the
+    backend default, at CONFIG BUILD time (the :func:`resolve_fused`
+    idiom). Tile padding is layout prep for the f32 (8, 128) TPU tile;
+    XLA-CPU has no tile to hit and only sees the extra pad FLOPs —
+    measured 0.84-1.00x on the CPU scaling A/B (BENCH_SCALING.json) — so
+    the default is False on CPU and True elsewhere. Pass an explicit bool
+    to force either layout (the bench A/B and the parity tests do)."""
+    if pad is None:
+        return jax.default_backend() != "cpu"
+    return pad
 
 
 @partial(
@@ -292,8 +562,16 @@ def solve_socp(
     # linear-algebra step of an ADMM iteration as a single MXU op.
     # op.sigma (not this function's sigma argument) keeps the x-update
     # consistent with whatever sigma the operator was actually built with.
-    K = jnp.concatenate([op.sigma * op.Minv, op.MinvAT], axis=-1)  # (nv, nv+m)
-    K2 = jnp.concatenate([K, A @ K], axis=0)  # (nv + m, nv + m)
+    # kkt_operator prebuilds K2 (donation-/hoist-clean: the concatenates run
+    # where the operator is built, outside any enclosing consensus loop);
+    # operators from older builders fall back to the inline build.
+    if op.K2 is not None:
+        K2 = op.K2
+    else:
+        K = jnp.concatenate(
+            [op.sigma * op.Minv, op.MinvAT], axis=-1
+        )  # (nv, nv+m)
+        K2 = jnp.concatenate([K, A @ K], axis=0)  # (nv + m, nv + m)
     wq = op.Minv @ q
     w2 = jnp.concatenate([wq, A @ wq])  # (nv + m,)
 
@@ -444,17 +722,22 @@ def make_rho_vec(m: int, n_box: int, lb, ub, rho: float, dtype=jnp.float32):
 def kkt_operator(P, A, rho_vec, sigma: float = 1e-6) -> KKTOp:
     """Invert the ADMM KKT matrix once for reuse across many ``solve_socp``
     calls with identical (P, A) (pass the result as ``op=``). Batched: all args
-    may carry leading axes (``jnp.linalg.inv`` batches natively)."""
+    may carry leading axes (``jnp.linalg.inv`` batches natively). The fused
+    iteration operator ``K2`` is prebuilt here (see :class:`KKTOp`)."""
     nv = P.shape[-1]
     AT = jnp.swapaxes(A, -1, -2)
     M = P + sigma * jnp.eye(nv, dtype=P.dtype) + (AT * rho_vec[..., None, :]) @ A
     Minv = jnp.linalg.inv(M)
     Minv = 0.5 * (Minv + jnp.swapaxes(Minv, -1, -2))  # M is symmetric.
+    MinvAT = Minv @ AT
+    K = jnp.concatenate([sigma * Minv, MinvAT], axis=-1)  # (.., nv, nv+m)
+    K2 = jnp.concatenate([K, A @ K], axis=-2)  # (.., nv+m, nv+m)
     # sigma broadcast to the batch shape so a natively-batched operator stays
     # a uniform pytree (every leaf with the same leading axes) for vmap.
     return KKTOp(
-        Minv=Minv, MinvAT=Minv @ AT,
+        Minv=Minv, MinvAT=MinvAT,
         sigma=jnp.broadcast_to(jnp.asarray(sigma, P.dtype), P.shape[:-2]),
+        K2=K2,
     )
 
 

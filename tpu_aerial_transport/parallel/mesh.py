@@ -178,12 +178,23 @@ def shard_scenarios(mesh: Mesh, batch, axis: str = "scenario"):
     )
 
 
-def scenario_rollout(rollout_fn: Callable, mesh: Mesh, axis: str = "scenario"):
+def scenario_rollout(rollout_fn: Callable, mesh: Mesh, axis: str = "scenario",
+                     donate: bool = True):
     """Wrap a single-scenario rollout into a sharded Monte-Carlo batch rollout:
     ``vmap`` over the leading scenario axis, jit with shardings so XLA keeps each
-    scenario on its device (BASELINE.json config "256 scenarios x 8 agents")."""
-    batched_jit = jax.jit(jax.vmap(rollout_fn))  # jit once: repeated runs hit
-    # the compile cache (a fresh wrapper per call would retrace every time).
+    scenario on its device (BASELINE.json config "256 scenarios x 8 agents").
+
+    ``donate=True`` (default) donates the batched (states, ctrl_states)
+    carries: a Monte-Carlo driver that chains batches (``batch = run(batch)
+    [:2]``) updates every scenario's physics state and warm starts in place
+    instead of re-allocating the whole sharded batch per call (TC105
+    donation contract, analysis/contracts.py). Donated inputs are deleted —
+    thread the returned batch forward, or pass ``donate=False`` to replay
+    one initial batch repeatedly."""
+    batched_jit = jax.jit(  # jit once: repeated runs hit the compile cache
+        jax.vmap(rollout_fn),  # (a fresh wrapper per call would retrace).
+        donate_argnums=(0, 1) if donate else (),
+    )
 
     def run(batch_args):
         batch_args = shard_scenarios(mesh, batch_args, axis)
@@ -193,3 +204,14 @@ def scenario_rollout(rollout_fn: Callable, mesh: Mesh, axis: str = "scenario"):
     # count cache misses and lower through the REAL compiled object.
     run.batched_jit = batched_jit
     return run
+
+
+def jit_sharded_step(step: Callable, donate: bool = True):
+    """Jit an agent-sharded control step (:func:`cadmm_control_sharded` /
+    :func:`dd_control_sharded` / :func:`rp_cadmm_control_sharded`) with the
+    controller-state carry (argument 0) DONATED, so each device's shard of
+    warm starts/duals is updated in place across control steps instead of
+    round-tripping fresh HBM buffers — the single-step serving twin of
+    ``scenario_rollout``'s donated batch. Thread the returned state
+    forward; the donated argument's buffers are deleted."""
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

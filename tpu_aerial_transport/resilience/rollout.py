@@ -35,6 +35,7 @@ from __future__ import annotations
 
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -239,3 +240,30 @@ def resilient_rollout(
         hl_body, init, jnp.arange(n_hl_steps)
     )
     return state, cs, logs
+
+
+def jit_resilient_rollout(
+    hl_step: Callable,
+    ll_control: Callable,
+    params: rqp.RQPParams,
+    *,
+    n_hl_steps: int,
+    hl_rel_freq: int = 10,
+    dt: float = 1e-3,
+    acc_des_fn: Callable | None = None,
+    faults: faults_mod.FaultSchedule | None = None,
+    donate: bool = True,
+):
+    """Donation-clean jitted :func:`resilient_rollout` (the fault-aware twin
+    of ``harness.rollout.jit_rollout``): ``run(state0, ctrl_state0)`` with
+    both carries donated. Note the ``prepare_ctrl_state`` seeding happens
+    INSIDE the jitted program, so the ctrl-state argument is always the
+    nominal pytree — callers chain ``state, cs, logs = run(state, cs)``
+    without tracking the resilience-only carry fields."""
+    def run(state0, ctrl_state0):
+        return resilient_rollout(
+            hl_step, ll_control, params, state0, ctrl_state0,
+            n_hl_steps, hl_rel_freq, dt, acc_des_fn, faults,
+        )
+
+    return jax.jit(run, donate_argnums=(0, 1) if donate else ())
