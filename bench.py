@@ -748,6 +748,7 @@ def _measured_iter_ms(controller, n, k_lo=4, k_hi=24, n_steps=30):
 
 
 SWEEP_PARTIAL_PATH = "BENCH_SWEEP_PARTIAL.json"
+SWEEP_JOURNAL_PATH = "BENCH_SWEEP_JOURNAL.jsonl"
 
 
 def _git_head() -> str:
@@ -784,14 +785,24 @@ def _write_json_atomic(path: str, payload) -> None:
 
 
 def sweep(resume: bool = False):
-    """Full BASELINE.json matrix. Each config's result is checkpointed to
-    ``BENCH_SWEEP_PARTIAL.json`` as soon as it is measured, and ``--resume``
-    skips already-measured configs — the axon tunnel has died mid-sweep
-    (~1.5-2 h of compiles) more than once, and without checkpointing every
-    completed config was lost with it. The checkpoint is stamped with the
-    git HEAD it was measured at; resuming across code changes is refused so
-    stale numbers cannot silently mix into BENCH_SWEEP.json."""
+    """Full BASELINE.json matrix. Each measured config ("chunk" of the
+    sweep) is journaled to ``BENCH_SWEEP_JOURNAL.jsonl`` (the same
+    append-only fsync'd jsonl ``resilience.recovery`` uses for rollout
+    chunks — truncation-tolerant, so a crash mid-append costs one cell,
+    not the file) and checkpointed to ``BENCH_SWEEP_PARTIAL.json``;
+    ``--resume`` restores completed cells from the journal instead of
+    restarting — the axon tunnel has died mid-sweep (~1.5-2 h of compiles)
+    more than once, and without checkpointing every completed config was
+    lost with it. Both records are stamped with the git HEAD they were
+    measured at; resuming across code changes is refused so stale numbers
+    cannot silently mix into BENCH_SWEEP.json. A resumed sweep reports
+    ``resumed_from_chunk`` (restored-cell count) in its ``_meta`` and in
+    the final JSON line (tools/bench_retry.py passes ``--resume`` on
+    retry attempts and forwards the field)."""
+    from tpu_aerial_transport.resilience.recovery import RunJournal
+
     head = _git_head()
+    journal = RunJournal(".", filename=SWEEP_JOURNAL_PATH)
     results = {"_meta": {"git_head": head}}
     if os.path.exists(SWEEP_PARTIAL_PATH) and not resume:
         raise SystemExit(
@@ -799,26 +810,50 @@ def sweep(resume: bool = False):
             "possibly hours of measurements). Pass --resume to continue it, "
             "or delete the file to start fresh — refusing to overwrite."
         )
-    if resume and os.path.exists(SWEEP_PARTIAL_PATH):
-        with open(SWEEP_PARTIAL_PATH) as fh:
-            cached = json.load(fh)
-        cached_head = cached.get("_meta", {}).get("git_head", "missing")
+    resumed_from_chunk = 0
+    if resume and (journal.exists() or os.path.exists(SWEEP_PARTIAL_PATH)):
+        cached_head, cached_cells = "missing", {}
+        if journal.exists():
+            # The journal is the source of truth (latest event per cell
+            # wins, so a retried error cell shows its newest outcome).
+            for e in journal.read():
+                if e.get("event") == "run_start":
+                    cached_head = e.get("git_head", "missing")
+                elif e.get("event") == "cell":
+                    cached_cells[e["cell"]] = e["value"]
+        else:  # pre-journal partial checkpoint (older crashed sweep).
+            with open(SWEEP_PARTIAL_PATH) as fh:
+                cached = json.load(fh)
+            cached_head = cached.get("_meta", {}).get("git_head", "missing")
+            cached_cells = {k: v for k, v in cached.items() if k != "_meta"}
         # 'unknown'/'-dirty' states never match safely: dirty trees can
         # differ between the two runs even at the same SHA.
         if cached_head != head or "unknown" in (cached_head, head) \
                 or head.endswith("-dirty"):
             raise SystemExit(
-                f"refusing --resume: {SWEEP_PARTIAL_PATH} was measured at "
+                f"refusing --resume: the sweep journal was measured at "
                 f"git {cached_head[:19]} but HEAD is {head[:19]} — the cached "
                 "numbers could silently mix with post-change ones. Delete "
-                "the partial file to start fresh."
+                f"{SWEEP_JOURNAL_PATH} and {SWEEP_PARTIAL_PATH} to start "
+                "fresh."
             )
-        results = cached
-        print(f"# resuming sweep: {len(results) - 1} configs cached "
-              f"({sorted(k for k in results if k != '_meta')})", flush=True)
+        results.update(cached_cells)
+        resumed_from_chunk = len(cached_cells)
+        results["_meta"]["resumed_from_chunk"] = resumed_from_chunk
+        print(f"# resuming sweep from journal: {resumed_from_chunk} cells "
+              f"cached ({sorted(k for k in results if k != '_meta')})",
+              flush=True)
+    elif journal.exists():
+        # Fresh start over a stale journal (its partial twin is gone, so
+        # the old sweep either completed or was deliberately reset).
+        os.remove(journal.path)
+    if not any(e.get("event") == "run_start" for e in journal.read()):
+        journal.append({"event": "run_start", "mode": "sweep",
+                        "git_head": head})
 
     def record(key, value):
         results[key] = value
+        journal.append({"event": "cell", "cell": key, "value": value})
         _write_json_atomic(SWEEP_PARTIAL_PATH, results)
         print(f"# {key}: {value}", flush=True)
 
@@ -951,6 +986,8 @@ def sweep(resume: bool = False):
     _write_json_atomic("BENCH_SWEEP.json", results)
     if os.path.exists(SWEEP_PARTIAL_PATH):
         os.remove(SWEEP_PARTIAL_PATH)
+    if journal.exists():
+        os.remove(journal.path)
 
     # Markdown table for BASELINE.md.
     print("\n| Config | MPC steps/s | mean step ms | ms/consensus-iter "
@@ -976,6 +1013,15 @@ def sweep(resume: bool = False):
                    if "agent_mpc_steps_per_sec" in r else "")
         print(f"| {key} | {r['scenario_mpc_steps_per_sec']:.1f} "
               f"scenario-steps/s{agent_s} | — | — |")
+    # Final machine-readable row (tools/bench_retry.py forwards it as the
+    # attempt's ``result``): how many cells this sweep holds and how many
+    # were restored from the journal rather than re-measured.
+    print(json.dumps({
+        "metric": "bench_sweep",
+        "value": len(results) - 1,
+        "unit": "cells",
+        "resumed_from_chunk": resumed_from_chunk,
+    }), flush=True)
 
 
 def multichip(n_steps: int = 10, n_swarm: int = 128, reps: int = 3,

@@ -7,9 +7,19 @@ and prints a side-by-side summary (tracking error, fallback-ladder rung
 counts, quarantine). CPU-friendly:
 
     JAX_PLATFORMS=cpu python examples/fault_injection.py
+
+Preemption-safe mode (resilience.recovery): run the killed-agent scenario
+as checkpointed chunks — the FULL resilient carry (fallback hold force and
+sticky quarantine flag included) is snapshotted at every boundary — then
+kill the process and resume it bit-exactly:
+
+    python examples/fault_injection.py --ckpt-dir /tmp/fi1 --chunks 4
+    python examples/fault_injection.py --resume /tmp/fi1
 """
 
 from __future__ import annotations
+
+import argparse
 
 import numpy as np
 
@@ -27,7 +37,111 @@ N = 4
 N_HL_STEPS = 200  # 2 s at 100 Hz.
 
 
+def _summarize(name, logs, mTg):
+    rungs = np.bincount(
+        np.asarray(logs.fallback_rung).reshape(-1), minlength=4
+    )
+    fz_end = np.asarray(logs.f_des[-1, :, 2])
+    print(f"\n== {name} ==")
+    print(f"  max |x_err|      : {float(jnp.max(logs.x_err)):.3f} m")
+    print(f"  final |x_err|    : {float(logs.x_err[-1]):.3f} m")
+    print(f"  final fz per agent [N]: {np.round(fz_end, 2)}")
+    print(f"  sum fz / mT g    : {fz_end.sum() / mTg:.3f}")
+    print(f"  ladder rungs     : clean={rungs[0]} retry={rungs[1]} "
+          f"hold={rungs[2]} equilibrium={rungs[3]}")
+    print(f"  quarantined      : {bool(logs.quarantined[-1])}")
+
+
+def run_checkpointed(ckpt_dir: str, n_chunks: int, resume: bool) -> None:
+    """The killed-agent scenario as a chunk-checkpointed resilient rollout:
+    `--resume` restores the journaled run (settings come from the journal)
+    and continues to the identical final summary."""
+    from tpu_aerial_transport.harness import checkpoint
+    from tpu_aerial_transport.resilience import recovery
+    from tpu_aerial_transport.resilience.rollout import (
+        make_chunked_resilient_rollout,
+    )
+
+    if resume:
+        plan = recovery.read_plan(ckpt_dir)
+        n_chunks = plan.n_chunks
+        n_hl_steps = plan.n_hl_steps
+        t_fail = plan.meta["t_fail"]
+        print(f"resuming from {ckpt_dir}: {plan.meta} "
+              f"({n_chunks} chunks of {plan.chunk_len} MPC steps)")
+    else:
+        n_hl_steps = N_HL_STEPS
+        t_fail = 100  # agent 0 killed at t = 1 s.
+
+    params, col, state0 = setup.rqp_setup(N)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=15, inner_iters=20,
+    )
+    hl = resilience.make_cadmm_hl_step(params, cfg)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+    cs0 = cadmm.init_cadmm_state(params, cfg)
+    sched = faults_mod.make_schedule(N, t_fail={0: t_fail})
+    # The hover default of resilient_rollout anchors at the rollout's
+    # initial state — under chunking that would re-anchor per chunk, so
+    # the reference is pinned to the TRUE initial state explicitly (it is
+    # deterministic from setup, hence identical on resume).
+    x0 = state0.xl
+
+    def acc_des_fn(state, t):
+        del t
+        dvl_des = -1.0 * state.vl - 1.0 * (state.xl - x0)
+        return (dvl_des, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
+
+    config_hash = checkpoint.config_fingerprint(
+        n=N, t_fail=t_fail, cfg=cfg, n_hl_steps=n_hl_steps
+    )
+    runner = make_chunked_resilient_rollout(
+        hl, ll.control, params, n_hl_steps=n_hl_steps, n_chunks=n_chunks,
+        acc_des_fn=acc_des_fn, faults=sched,
+    )
+    carry0 = runner.init_carry(*jax.tree.map(jnp.copy, (state0, cs0)))
+    with recovery.GracefulInterrupt() as interrupt:
+        if resume:
+            res = recovery.resume_run(
+                ckpt_dir, runner.chunk_jit, carry0,
+                config_hash=config_hash, interrupt=interrupt,
+            )
+            print(f"resumed from chunk {res.resumed_from_chunk}")
+        else:
+            plan = recovery.RunPlan(
+                run_dir=ckpt_dir, n_hl_steps=n_hl_steps, n_chunks=n_chunks,
+                seed=None, config_hash=config_hash,
+                meta={"scenario": "agent 0 killed @ t=1s", "n": N,
+                      "t_fail": t_fail},
+            )
+            res = recovery.run_chunks(
+                plan, runner.chunk_jit, carry0, interrupt=interrupt
+            )
+    if res.status == "preempted":
+        raise SystemExit(
+            f"preempted at chunk {res.chunks_done}/{n_chunks} — resume "
+            f"with: python examples/fault_injection.py --resume {ckpt_dir}"
+        )
+    mTg = float(params.mT) * rqp.GRAVITY
+    _summarize("agent 0 killed @ t=1s (checkpointed)", res.logs, mTg)
+
+
 def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--chunks", type=int, default=4, metavar="C",
+                   help="chunk count for --ckpt-dir mode")
+    p.add_argument("--ckpt-dir", default=None, metavar="DIR",
+                   help="run the killed-agent scenario as a checkpointed "
+                        "chunked rollout under DIR")
+    p.add_argument("--resume", default=None, metavar="DIR",
+                   help="resume a --ckpt-dir run from its journal")
+    args = p.parse_args()
+    if args.resume or args.ckpt_dir:
+        run_checkpointed(args.resume or args.ckpt_dir, args.chunks,
+                         resume=args.resume is not None)
+        return
+
     params, col, state0 = setup.rqp_setup(N)
     cfg = cadmm.make_config(
         params, col.collision_radius, col.max_deceleration,
@@ -54,16 +168,7 @@ def main():
             hl, ll.control, params, s, c, n_hl_steps=N_HL_STEPS, faults=f
         ))
         final, _, logs = run(state0, cs0)
-        rungs = np.bincount(np.asarray(logs.fallback_rung), minlength=4)
-        fz_end = np.asarray(logs.f_des[-1, :, 2])
-        print(f"\n== {name} ==")
-        print(f"  max |x_err|      : {float(jnp.max(logs.x_err)):.3f} m")
-        print(f"  final |x_err|    : {float(logs.x_err[-1]):.3f} m")
-        print(f"  final fz per agent [N]: {np.round(fz_end, 2)}")
-        print(f"  sum fz / mT g    : {fz_end.sum() / mTg:.3f}")
-        print(f"  ladder rungs     : clean={rungs[0]} retry={rungs[1]} "
-              f"hold={rungs[2]} equilibrium={rungs[3]}")
-        print(f"  quarantined      : {bool(logs.quarantined[-1])}")
+        _summarize(name, logs, mTg)
 
 
 if __name__ == "__main__":

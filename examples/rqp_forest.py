@@ -10,6 +10,15 @@ editing the source (the reference's config story, SURVEY.md §5.6).
 Usage:
   python examples/rqp_forest.py --controller centralized -T 10
   python examples/rqp_forest.py --controller cadmm -n 8 -T 5 --plots
+
+Preemption-safe runs (harness.checkpoint + resilience.recovery): split the
+rollout into checkpointed chunks, survive SIGTERM/SIGINT at any boundary,
+and resume bit-exactly from the journal:
+
+  python examples/rqp_forest.py --controller cadmm -T 10 \
+      --chunks 10 --ckpt-dir /tmp/run1
+  # ... kill it mid-run, then:
+  python examples/rqp_forest.py --resume /tmp/run1
 """
 
 from __future__ import annotations
@@ -40,6 +49,18 @@ def main() -> None:
     p.add_argument("--time-chunk", type=int, default=10, metavar="C",
                    help="MPC steps per timed scan chunk for the wall-clock "
                         "statistics (0 disables the timing pass)")
+    p.add_argument("--chunks", type=int, default=0, metavar="C",
+                   help="run as C checkpointed chunks (one compiled chunk, "
+                        "snapshot + journal at every boundary; needs "
+                        "--ckpt-dir; SIGTERM/SIGINT stop gracefully)")
+    p.add_argument("--ckpt-dir", default=None, metavar="DIR",
+                   help="run directory for --chunks (journal.jsonl + "
+                        "carry/logs snapshots)")
+    p.add_argument("--resume", default=None, metavar="DIR",
+                   help="resume a --chunks run from DIR's journal; the "
+                        "run's settings (controller/n/T/seed/...) are "
+                        "restored from the journal and the matching CLI "
+                        "flags are ignored")
     args = p.parse_args()
 
     from tpu_aerial_transport.control import cadmm, centralized, dd, lowlevel
@@ -47,6 +68,23 @@ def main() -> None:
     from tpu_aerial_transport.harness import rollout as ro
     from tpu_aerial_transport.harness import setup
     from tpu_aerial_transport.utils.stats import compute_aggregate_statistics
+
+    if args.resume:
+        from tpu_aerial_transport.resilience import recovery
+
+        plan = recovery.read_plan(args.resume)
+        meta = plan.meta
+        print(f"resuming from {args.resume}: {meta} "
+              f"({plan.n_chunks} chunks of {plan.chunk_len} MPC steps)")
+        # Deterministic regen: everything the run depends on is journaled.
+        args.controller = meta["controller"]
+        args.n = meta["n"]
+        args.T = meta["T"]
+        args.dt = meta["dt"]
+        args.hl_rel_freq = meta["hl_rel_freq"]
+        args.seed = plan.seed
+        args.chunks = plan.n_chunks
+        args.ckpt_dir = args.resume
 
     params, col, state0 = setup.rqp_setup(args.n)
     forest = forest_mod.make_forest(seed=args.seed)
@@ -90,20 +128,79 @@ def main() -> None:
         dist_eps = cfg.base.dist_eps
 
     n_hl_steps = int(args.T / (args.dt * args.hl_rel_freq))
-    run = jax.jit(
-        lambda s0, c0: ro.rollout(
-            hl, ll.control, params, s0, c0, n_hl_steps=n_hl_steps,
-            hl_rel_freq=args.hl_rel_freq, dt=args.dt, acc_des_fn=acc_des_fn,
+    checkpointed = args.chunks >= 2 or args.resume
+    if checkpointed:
+        from tpu_aerial_transport.harness import checkpoint
+        from tpu_aerial_transport.resilience import recovery
+
+        if not args.ckpt_dir:
+            raise SystemExit("--chunks needs --ckpt-dir")
+        if n_hl_steps % args.chunks:
+            raise SystemExit(
+                f"T gives {n_hl_steps} MPC steps, not divisible by "
+                f"--chunks {args.chunks}"
+            )
+        config_hash = checkpoint.config_fingerprint(
+            controller=args.controller, n=args.n, seed=args.seed,
+            dt=args.dt, hl_rel_freq=args.hl_rel_freq, cfg=cfg,
         )
-    )
-    print(f"compiling + running {args.controller}, n={args.n}, "
-          f"{n_hl_steps} MPC steps ...")
-    t0 = time.perf_counter()
-    final, _, logs = run(state0, cs0)
-    jax.block_until_ready(final.xl)
-    dt_wall = time.perf_counter() - t0
-    print(f"done in {dt_wall:.1f} s ({n_hl_steps / dt_wall:.1f} MPC steps/s "
-          f"incl. compile)")
+        runner = ro.make_chunked_rollout(
+            hl, ll.control, params, n_hl_steps=n_hl_steps,
+            n_chunks=args.chunks, hl_rel_freq=args.hl_rel_freq, dt=args.dt,
+            acc_des_fn=acc_des_fn,
+        )
+        # Decouple constant-deduped zero leaves before the chunk donates
+        # the carry (see harness.rollout.jit_rollout's caveat).
+        carry0 = runner.init_carry(*jax.tree.map(jnp.copy, (state0, cs0)))
+        print(f"compiling + running {args.controller}, n={args.n}, "
+              f"{n_hl_steps} MPC steps in {args.chunks} checkpointed "
+              f"chunks -> {args.ckpt_dir} ...")
+        t0 = time.perf_counter()
+        with recovery.GracefulInterrupt() as interrupt:
+            if args.resume:
+                res = recovery.resume_run(
+                    args.resume, runner.chunk_jit, carry0,
+                    config_hash=config_hash, interrupt=interrupt,
+                )
+                print(f"resumed from chunk {res.resumed_from_chunk}")
+            else:
+                plan = recovery.RunPlan(
+                    run_dir=args.ckpt_dir, n_hl_steps=n_hl_steps,
+                    n_chunks=args.chunks, seed=args.seed,
+                    config_hash=config_hash,
+                    meta={"controller": args.controller, "n": args.n,
+                          "T": args.T, "dt": args.dt,
+                          "hl_rel_freq": args.hl_rel_freq},
+                )
+                res = recovery.run_chunks(
+                    plan, runner.chunk_jit, carry0, interrupt=interrupt,
+                )
+        dt_wall = time.perf_counter() - t0
+        if res.status == "preempted":
+            raise SystemExit(
+                f"preempted at chunk {res.chunks_done}/{args.chunks} after "
+                f"{dt_wall:.1f} s — state is snapshotted; continue with: "
+                f"python examples/rqp_forest.py --resume {args.ckpt_dir}"
+            )
+        final, logs = res.carry[0], res.logs
+        print(f"done in {dt_wall:.1f} s ({n_hl_steps / dt_wall:.1f} MPC "
+              f"steps/s incl. compile)")
+    else:
+        run = jax.jit(
+            lambda s0, c0: ro.rollout(
+                hl, ll.control, params, s0, c0, n_hl_steps=n_hl_steps,
+                hl_rel_freq=args.hl_rel_freq, dt=args.dt,
+                acc_des_fn=acc_des_fn,
+            )
+        )
+        print(f"compiling + running {args.controller}, n={args.n}, "
+              f"{n_hl_steps} MPC steps ...")
+        t0 = time.perf_counter()
+        final, _, logs = run(state0, cs0)
+        jax.block_until_ready(final.xl)
+        dt_wall = time.perf_counter() - t0
+        print(f"done in {dt_wall:.1f} s ({n_hl_steps / dt_wall:.1f} MPC "
+              f"steps/s incl. compile)")
 
     # Aggregate stats (reference _print_stats, rqp_example.py:62-80).
     iters = np.asarray(logs.iters)

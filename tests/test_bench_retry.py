@@ -119,3 +119,49 @@ def test_failed_bench_keeps_null_result():
     assert rec["classification"] == "failed"
     assert rec["result"] is None
     assert "backend_unavailable" not in rec
+
+
+def test_sweep_retry_resumes_from_journal(tmp_path):
+    """A --sweep attempt that dies after journaling cells is retried WITH
+    --resume (continue from the journal, not from zero); the record carries
+    resumed_from_chunk and forwards the bench's final JSON line."""
+    journal = tmp_path / "BENCH_SWEEP_JOURNAL.jsonl"
+    journal.write_text(
+        json.dumps({"event": "run_start", "git_head": "abc"}) + "\n"
+        + json.dumps({"event": "cell", "cell": "a", "value": 1}) + "\n"
+        + json.dumps({"event": "cell", "cell": "b", "value": 2}) + "\n"
+        + '{"event": "cell", "cel'  # torn tail from the crash.
+    )
+    script = (
+        "import json, sys\n"
+        "if '--resume' not in sys.argv: sys.exit(1)\n"
+        "print(json.dumps({'metric': 'bench_sweep',"
+        " 'resumed_from_chunk': 2}))\n"
+    )
+    cmd = [sys.executable, "-c", script, "--sweep"]
+    rec = run_with_retries(cmd, attempts=2, timeout_s=30, backoff_s=0.0,
+                           probe_fn=_probe_ok, cwd=str(tmp_path))
+    assert rec["classification"] == "ok"
+    assert rec["resumed_from_chunk"] == 2  # torn third cell not counted.
+    assert rec["attempts"][1]["resumed"] is True
+    assert rec["result"]["resumed_from_chunk"] == 2
+    json.dumps(rec)
+
+
+def test_non_sweep_retry_never_appends_resume(tmp_path):
+    """--resume is a sweep-journal contract; headline runs must retry with
+    the original command even when a journal file happens to exist."""
+    (tmp_path / "BENCH_SWEEP_JOURNAL.jsonl").write_text(
+        json.dumps({"event": "cell", "cell": "a", "value": 1}) + "\n"
+    )
+    script = (
+        "import json, sys\n"
+        "if '--resume' in sys.argv: sys.exit(3)\n"
+        "sys.exit(1) if len(sys.argv) < 99 else None\n"
+    )
+    rec = run_with_retries([sys.executable, "-c", script], attempts=2,
+                           timeout_s=30, backoff_s=0.0, probe_fn=_probe_ok,
+                           cwd=str(tmp_path))
+    assert rec["classification"] == "failed"
+    assert "resumed_from_chunk" not in rec
+    assert all("resumed" not in a for a in rec["attempts"])

@@ -1,12 +1,20 @@
-"""Checkpoint/resume tests: log-artifact round-trip and mid-run scan-carry
-resume producing the identical trajectory."""
+"""Checkpoint/resume tests: log-artifact round-trip, mid-run scan-carry
+resume producing the identical trajectory, and the crash-recovery snapshot
+tier — atomic versioned writes, per-leaf digests, treedef/config
+verification, structured rejection of corrupt/truncated/mismatched
+snapshots with fallback to the previous valid one, keep-last-K retention,
+and the orbax/npz backend shim."""
+
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpu_aerial_transport.harness import checkpoint, setup
 from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.utils import compat
 
 
 def test_run_dict_roundtrip(tmp_path):
@@ -22,6 +30,26 @@ def test_run_dict_roundtrip(tmp_path):
     assert back["n"] == 3
     assert np.allclose(back["state_seq"]["xl"], logs["state_seq"]["xl"])
     assert np.allclose(back["x_err_seq"], logs["x_err_seq"])
+
+
+def test_load_run_preserves_scalar_dtype(tmp_path):
+    """Regression: 0-d restore used ``v.item()``, silently widening a
+    saved np.float32 scalar to a Python float (and np.int32 to int) — a
+    save/load/save cycle changed dtypes. ``v[()]`` keeps them."""
+    p = str(tmp_path / "run.npz")
+    checkpoint.save_run(p, {
+        "f32_scalar": np.float32(1.5),
+        "i32_scalar": np.int32(7),
+        "nested": {"b": np.bool_(True)},
+    })
+    back = checkpoint.load_run(p)
+    assert np.asarray(back["f32_scalar"]).dtype == np.float32
+    assert np.asarray(back["i32_scalar"]).dtype == np.int32
+    assert np.asarray(back["nested"]["b"]).dtype == np.bool_
+    # Round-trip again: dtypes must be stable under re-save.
+    checkpoint.save_run(p, back)
+    again = checkpoint.load_run(p)
+    assert np.asarray(again["f32_scalar"]).dtype == np.float32
 
 
 def test_midrun_resume_bitwise(tmp_path):
@@ -46,3 +74,146 @@ def test_midrun_resume_bitwise(tmp_path):
 
     for leaf_a, leaf_b in zip(jax.tree.leaves(full), jax.tree.leaves(resumed)):
         assert jnp.array_equal(leaf_a, leaf_b), "resume diverged from straight run"
+
+
+def test_save_state_npz_fallback_roundtrip(tmp_path, monkeypatch):
+    """With orbax absent the shim must fall back to npz (save_state used to
+    hard-ImportError), and the round-trip must stay exact."""
+    monkeypatch.setattr(compat, "_import_orbax", lambda: None)
+    assert compat.pytree_io()[2] == "npz"
+    state = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+             "b": jnp.zeros((), jnp.int32)}
+    p = str(tmp_path / "st")
+    checkpoint.save_state(p, state)
+    back = checkpoint.load_state(p, state)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(back)):
+        assert jnp.array_equal(a, b)
+        assert a.dtype == b.dtype
+
+
+# ----------------------------------------------------------------------
+# Crash-recovery snapshot tier.
+# ----------------------------------------------------------------------
+
+def _state():
+    return {
+        "a": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+        "quar": jnp.ones((), bool),
+        "step": jnp.int32(41),
+    }
+
+
+def _tamper_leaf(path):
+    """Rewrite a snapshot with one leaf's payload modified but the stale
+    manifest kept — the per-leaf digest check must catch what the zip
+    container cannot."""
+    raw = dict(np.load(path, allow_pickle=False))
+    raw["leaf_000000"] = raw["leaf_000000"] + 1
+    with open(path, "wb") as fh:
+        np.savez(fh, **raw)
+
+
+def test_snapshot_roundtrip_bit_exact(tmp_path):
+    d = str(tmp_path)
+    state = _state()
+    checkpoint.save_snapshot(d, 0, state, config_hash="h", meta={"chunk": 0})
+    back, manifest, skipped = checkpoint.load_latest_valid(
+        d, jax.eval_shape(lambda: state), config_hash="h"
+    )
+    assert skipped == []
+    assert manifest["step"] == 0 and manifest["meta"]["chunk"] == 0
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(back)):
+        assert jnp.array_equal(a, b)
+        assert a.dtype == b.dtype  # bool/int/float all restored exactly.
+
+
+def test_snapshot_keep_last_k_retention(tmp_path):
+    d = str(tmp_path)
+    for step in range(6):
+        checkpoint.save_snapshot(d, step, _state(), keep_last=3)
+    assert [s for s, _ in checkpoint.list_snapshots(d)] == [3, 4, 5]
+    # keep_last=0 disables pruning (the per-chunk log snapshots need all).
+    for step in range(6, 9):
+        checkpoint.save_snapshot(d, step, _state(), prefix="logs",
+                                 keep_last=0)
+    assert len(checkpoint.list_snapshots(d, "logs")) == 3
+
+
+def test_corrupt_snapshot_rejected_with_fallback(tmp_path):
+    d = str(tmp_path)
+    state = _state()
+    checkpoint.save_snapshot(d, 0, state, keep_last=0)
+    checkpoint.save_snapshot(d, 1, state, keep_last=0)
+    newest = checkpoint.snapshot_path(d, 1)
+    _tamper_leaf(newest)
+    with pytest.raises(checkpoint.SnapshotError) as ei:
+        checkpoint.load_snapshot(newest, state)
+    assert ei.value.kind == "corrupt"
+    # load_latest_valid falls back to the previous valid snapshot and
+    # reports the structured error of the one it skipped.
+    back, manifest, skipped = checkpoint.load_latest_valid(d, state)
+    assert manifest["step"] == 0
+    assert [e.kind for e in skipped] == ["corrupt"]
+    assert jnp.array_equal(back["a"], state["a"])
+
+
+def test_truncated_snapshot_rejected(tmp_path):
+    d = str(tmp_path)
+    checkpoint.save_snapshot(d, 0, _state())
+    p = checkpoint.snapshot_path(d, 0)
+    with open(p, "r+b") as fh:
+        fh.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(checkpoint.SnapshotError) as ei:
+        checkpoint.load_snapshot(p, _state())
+    assert ei.value.kind == "unreadable"
+    with pytest.raises(checkpoint.SnapshotError) as ei:
+        checkpoint.load_latest_valid(d, _state())
+    assert ei.value.kind == "no_valid_snapshot"
+    assert ei.value.errors  # carries the per-file reasons.
+
+
+def test_config_mismatch_refused(tmp_path):
+    d = str(tmp_path)
+    checkpoint.save_snapshot(d, 0, _state(), config_hash="cfg-A")
+    p = checkpoint.snapshot_path(d, 0)
+    with pytest.raises(checkpoint.SnapshotError) as ei:
+        checkpoint.load_snapshot(p, _state(), config_hash="cfg-B")
+    assert ei.value.kind == "config_mismatch"
+    # Hash-less loads (either side) skip the check by design.
+    checkpoint.load_snapshot(p, _state())
+    checkpoint.save_snapshot(d, 1, _state())
+    checkpoint.load_snapshot(
+        checkpoint.snapshot_path(d, 1), _state(), config_hash="cfg-B"
+    )
+
+
+def test_structure_mismatch_refused(tmp_path):
+    d = str(tmp_path)
+    state = _state()
+    checkpoint.save_snapshot(d, 0, state)
+    p = checkpoint.snapshot_path(d, 0)
+    with pytest.raises(checkpoint.SnapshotError) as ei:
+        checkpoint.load_snapshot(p, {"a": state["a"]})
+    assert ei.value.kind == "structure_mismatch"
+    # Same structure, different leaf dtype: also a mismatch.
+    drifted = dict(state, step=jnp.float32(41))
+    with pytest.raises(checkpoint.SnapshotError) as ei:
+        checkpoint.load_snapshot(p, drifted)
+    assert ei.value.kind == "structure_mismatch"
+
+
+def test_atomic_write_leaves_no_temp_debris(tmp_path):
+    d = str(tmp_path)
+    checkpoint.save_snapshot(d, 0, _state())
+    names = os.listdir(d)
+    assert names == ["snap-00000000.ckpt"]
+    # Published files are complete by construction: loading right after a
+    # save must never hit a partial write.
+    checkpoint.load_snapshot(checkpoint.snapshot_path(d, 0), _state())
+
+
+def test_config_fingerprint_sensitivity():
+    a = checkpoint.config_fingerprint(n=4, cfg="config-repr")
+    assert a == checkpoint.config_fingerprint(n=4, cfg="config-repr")
+    assert a != checkpoint.config_fingerprint(n=5, cfg="config-repr")
+    assert a != checkpoint.config_fingerprint(n=4, cfg="other")

@@ -1,0 +1,434 @@
+"""Crash-recovery tests: chunked rollouts bitwise-identical to the fused
+scan through ONE compiled chunk; kill-at-any-chunk-boundary + resume
+reproducing the uninterrupted trajectory exactly (corrupted snapshots
+falling back to the previous valid one); SIGTERM-graceful preemption;
+host-level retry requeuing after a device error; and the sharded
+Monte-Carlo batch path resuming with a quarantined lane bit-exactly."""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.control import cadmm, centralized, lowlevel
+from tpu_aerial_transport.harness import checkpoint, setup
+from tpu_aerial_transport.harness import rollout as ro
+from tpu_aerial_transport.parallel import mesh as mesh_mod
+from tpu_aerial_transport.resilience import faults as faults_mod
+from tpu_aerial_transport.resilience import recovery
+from tpu_aerial_transport.resilience.rollout import (
+    init_resilient_carry,
+    make_cadmm_hl_step,
+    make_chunked_resilient_rollout,
+    resilient_rollout,
+)
+
+N_HL = 6
+CHUNKS = 3
+HL_REL = 2
+
+
+def _problem(n=3):
+    params, col, state0 = setup.rqp_setup(n)
+    cfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=10
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+    cs0 = centralized.init_ctrl_state(params, cfg)
+    x0 = state0.xl
+
+    def acc_des_fn(state, t):
+        del t
+        dvl = -1.0 * state.vl - 1.0 * (state.xl - x0)
+        return (dvl, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
+
+    def hl(cs, s, a):
+        return centralized.control(params, cfg, f_eq, cs, s, a)
+
+    return params, cfg, state0, cs0, ll, hl, acc_des_fn
+
+
+def _reference(params, state0, cs0, ll, hl, acc_des_fn):
+    full = ro.jit_rollout(
+        hl, ll.control, params, n_hl_steps=N_HL, hl_rel_freq=HL_REL,
+        acc_des_fn=acc_des_fn, donate=False,
+    )
+    return full(state0, cs0)
+
+
+def _assert_trees_equal(a, b, what=""):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), \
+            f"bitwise mismatch {what}"
+
+
+def _runner(params, ll, hl, acc_des_fn, n_chunks=CHUNKS):
+    return ro.make_chunked_rollout(
+        hl, ll.control, params, n_hl_steps=N_HL, n_chunks=n_chunks,
+        hl_rel_freq=HL_REL, acc_des_fn=acc_des_fn,
+    )
+
+
+def _fresh_carry(runner, state0, cs0):
+    # Decoupled copies: the chunk donates its carry and a freshly built
+    # rest state shares constant zero buffers.
+    return runner.init_carry(*jax.tree.map(jnp.copy, (state0, cs0)))
+
+
+def test_chunked_rollout_bitwise_identical_single_compile():
+    """The acceptance gate: chunked == fused scan, all chunks through ONE
+    jit-cache entry, boundaries surfaced to the hook in order."""
+    params, cfg, state0, cs0, ll, hl, acc_des_fn = _problem()
+    fs, fc, flog = _reference(params, state0, cs0, ll, hl, acc_des_fn)
+
+    runner = _runner(params, ll, hl, acc_des_fn)
+    boundaries = []
+    s2, c2, log2 = runner(
+        *jax.tree.map(jnp.copy, (state0, cs0)),
+        on_boundary=lambda c, carry, logs: boundaries.append(c),
+    )
+    assert boundaries == list(range(CHUNKS))
+    assert runner.chunk_jit._cache_size() == 1, \
+        "C chunks must compile exactly once"
+    _assert_trees_equal((fs, fc, flog), (s2, c2, log2), "chunked vs fused")
+
+
+def test_chunked_rollout_validates_args():
+    params, cfg, state0, cs0, ll, hl, acc_des_fn = _problem()
+    with pytest.raises(ValueError, match="divisible"):
+        ro.make_chunked_rollout(
+            hl, ll.control, params, n_hl_steps=7, n_chunks=3,
+            acc_des_fn=acc_des_fn,
+        )
+    with pytest.raises(ValueError, match="acc_des_fn"):
+        ro.make_chunked_rollout(
+            hl, ll.control, params, n_hl_steps=6, n_chunks=3,
+            acc_des_fn=None,
+        )
+
+
+@pytest.mark.parametrize("kill_after", [1, 2])
+def test_kill_at_chunk_boundary_then_resume_bit_identical(
+        tmp_path, kill_after):
+    """A run killed at an arbitrary chunk boundary resumes (fresh process:
+    deterministic setup regen + journal + snapshots only) to the
+    bitwise-identical final state and log of the uninterrupted run."""
+    params, cfg, state0, cs0, ll, hl, acc_des_fn = _problem()
+    fs, fc, flog = _reference(params, state0, cs0, ll, hl, acc_des_fn)
+    runner = _runner(params, ll, hl, acc_des_fn)
+    ch = checkpoint.config_fingerprint(cfg=cfg, n=3)
+    d = str(tmp_path)
+    plan = recovery.RunPlan(run_dir=d, n_hl_steps=N_HL, n_chunks=CHUNKS,
+                            seed=0, config_hash=ch)
+
+    interrupt = recovery.GracefulInterrupt()
+    calls = {"n": 0}
+
+    def killing_chunk(carry, i0):
+        out = runner.chunk_jit(carry, i0)
+        calls["n"] += 1
+        if calls["n"] == kill_after:
+            interrupt.triggered = "SIGTERM"  # "process killed here".
+        return out
+
+    res = recovery.run_chunks(
+        plan, killing_chunk, _fresh_carry(runner, state0, cs0),
+        interrupt=interrupt,
+    )
+    assert res.status == "preempted"
+    assert res.chunks_done == kill_after
+    events = [e["event"] for e in recovery.RunJournal(d).read()]
+    assert events == ["run_start"] + ["chunk"] * kill_after + ["preempted"]
+
+    # "New process": only the run dir + deterministic regen survive.
+    res2 = recovery.resume_run(
+        d, runner.chunk_jit, _fresh_carry(runner, state0, cs0),
+        config_hash=ch,
+    )
+    assert res2.status == "done"
+    assert res2.resumed_from_chunk == kill_after
+    s2, c2 = res2.carry
+    _assert_trees_equal((fs, fc, flog), (s2, c2, res2.logs),
+                        f"resume after kill@{kill_after}")
+
+
+def test_resume_falls_back_past_corrupt_snapshot(tmp_path):
+    """Corrupting the newest carry snapshot must not poison the resume:
+    the walk falls back to the previous valid boundary, recomputes the
+    tail, and still reproduces the uninterrupted run bit-exactly."""
+    params, cfg, state0, cs0, ll, hl, acc_des_fn = _problem()
+    fs, fc, flog = _reference(params, state0, cs0, ll, hl, acc_des_fn)
+    runner = _runner(params, ll, hl, acc_des_fn)
+    ch = checkpoint.config_fingerprint(cfg=cfg, n=3)
+    d = str(tmp_path)
+    plan = recovery.RunPlan(run_dir=d, n_hl_steps=N_HL, n_chunks=CHUNKS,
+                            config_hash=ch)
+    res = recovery.run_chunks(
+        plan, runner.chunk_jit, _fresh_carry(runner, state0, cs0)
+    )
+    assert res.status == "done"
+
+    newest = checkpoint.snapshot_path(d, CHUNKS - 1, recovery.CARRY_PREFIX)
+    raw = dict(np.load(newest, allow_pickle=False))
+    raw["leaf_000000"] = raw["leaf_000000"] + 1  # stale manifest digests.
+    with open(newest, "wb") as fh:
+        np.savez(fh, **raw)
+
+    res2 = recovery.resume_run(
+        d, runner.chunk_jit, _fresh_carry(runner, state0, cs0),
+        config_hash=ch,
+    )
+    assert res2.status == "done"
+    assert res2.resumed_from_chunk == CHUNKS - 1  # fell back one boundary.
+    s2, c2 = res2.carry
+    _assert_trees_equal((fs, fc, flog), (s2, c2, res2.logs),
+                        "resume past corrupt snapshot")
+    resume_events = [e for e in recovery.RunJournal(d).read()
+                     if e.get("event") == "resume"]
+    assert resume_events[-1]["skipped"], "skipped snapshot must be journaled"
+
+
+def test_resume_refuses_config_mismatch(tmp_path):
+    params, cfg, state0, cs0, ll, hl, acc_des_fn = _problem()
+    runner = _runner(params, ll, hl, acc_des_fn)
+    d = str(tmp_path)
+    plan = recovery.RunPlan(run_dir=d, n_hl_steps=N_HL, n_chunks=CHUNKS,
+                            config_hash="cfg-A")
+    recovery.run_chunks(plan, runner.chunk_jit,
+                        _fresh_carry(runner, state0, cs0))
+    with pytest.raises(checkpoint.SnapshotError) as ei:
+        recovery.resume_run(
+            d, runner.chunk_jit, _fresh_carry(runner, state0, cs0),
+            config_hash="cfg-B",
+        )
+    assert ei.value.kind == "config_mismatch"
+
+
+def test_sigterm_graceful_interrupt_real_signal(tmp_path):
+    """A real SIGTERM mid-run stops at the next chunk boundary with the
+    snapshot flushed and the preemption journaled; a later resume finishes
+    the run bit-identically."""
+    params, cfg, state0, cs0, ll, hl, acc_des_fn = _problem()
+    fs, fc, flog = _reference(params, state0, cs0, ll, hl, acc_des_fn)
+    runner = _runner(params, ll, hl, acc_des_fn)
+    ch = checkpoint.config_fingerprint(cfg=cfg, n=3)
+    d = str(tmp_path)
+    plan = recovery.RunPlan(run_dir=d, n_hl_steps=N_HL, n_chunks=CHUNKS,
+                            config_hash=ch)
+    calls = {"n": 0}
+
+    def chunk_sending_sigterm(carry, i0):
+        out = runner.chunk_jit(carry, i0)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return out
+
+    with recovery.GracefulInterrupt() as interrupt:
+        res = recovery.run_chunks(
+            plan, chunk_sending_sigterm,
+            _fresh_carry(runner, state0, cs0), interrupt=interrupt,
+        )
+    assert res.status == "preempted"
+    assert interrupt.triggered == "SIGTERM"
+    assert [e for e in recovery.RunJournal(d).read()
+            if e.get("event") == "preempted"][0]["signal"] == "SIGTERM"
+    # The flushed boundary snapshot is loadable.
+    checkpoint.load_latest_valid(
+        d, _fresh_carry(runner, state0, cs0),
+        prefix=recovery.CARRY_PREFIX, config_hash=ch,
+    )
+    res2 = recovery.resume_run(
+        d, runner.chunk_jit, _fresh_carry(runner, state0, cs0),
+        config_hash=ch,
+    )
+    s2, c2 = res2.carry
+    _assert_trees_equal((fs, fc, flog), (s2, c2, res2.logs),
+                        "resume after real SIGTERM")
+
+
+def test_host_level_retry_requeues_after_device_error(tmp_path):
+    """A chunk raising mid-run (device error) is requeued from the last
+    boundary's host carry copy; the completed run is bit-identical and the
+    retry is journaled."""
+    params, cfg, state0, cs0, ll, hl, acc_des_fn = _problem()
+    fs, fc, flog = _reference(params, state0, cs0, ll, hl, acc_des_fn)
+    runner = _runner(params, ll, hl, acc_des_fn)
+    d = str(tmp_path)
+    plan = recovery.RunPlan(run_dir=d, n_hl_steps=N_HL, n_chunks=CHUNKS)
+    calls = {"n": 0}
+
+    def dying_chunk(carry, i0):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("simulated device error")
+        return runner.chunk_jit(carry, i0)
+
+    res = recovery.run_chunks(
+        plan, dying_chunk, _fresh_carry(runner, state0, cs0), max_retries=1
+    )
+    assert res.status == "done" and res.retries == 1
+    s2, c2 = res.carry
+    _assert_trees_equal((fs, fc, flog), (s2, c2, res.logs), "after retry")
+    assert [e for e in recovery.RunJournal(d).read()
+            if e.get("event") == "retry"]
+    # Retry budget exhausted -> the error propagates (no silent loop).
+    plan2 = recovery.RunPlan(run_dir=str(tmp_path / "b"), n_hl_steps=N_HL,
+                             n_chunks=CHUNKS)
+
+    def always_dying(carry, i0):
+        raise RuntimeError("dead device")
+
+    with pytest.raises(RuntimeError, match="dead device"):
+        recovery.run_chunks(
+            plan2, always_dying, _fresh_carry(runner, state0, cs0),
+            max_retries=2,
+        )
+
+
+def test_snapshot_io_failure_retry_does_not_double_apply(
+        tmp_path, monkeypatch):
+    """Regression: a transient snapshot-write failure (plain OSError, e.g.
+    ENOSPC) after the chunk computed must retry from the LAST boundary,
+    not from the failed chunk's own output — the retry anchor advances
+    only once the boundary is fully published."""
+    params, cfg, state0, cs0, ll, hl, acc_des_fn = _problem()
+    fs, fc, flog = _reference(params, state0, cs0, ll, hl, acc_des_fn)
+    runner = _runner(params, ll, hl, acc_des_fn)
+    d = str(tmp_path)
+    plan = recovery.RunPlan(run_dir=d, n_hl_steps=N_HL, n_chunks=CHUNKS)
+    real_save = checkpoint.save_snapshot
+    fails = {"n": 0}
+
+    def flaky_save(directory, step, state, **kw):
+        if (kw.get("prefix") == recovery.LOGS_PREFIX and step == 1
+                and fails["n"] == 0):
+            fails["n"] += 1
+            raise OSError("simulated disk hiccup")
+        return real_save(directory, step, state, **kw)
+
+    monkeypatch.setattr(checkpoint, "save_snapshot", flaky_save)
+    res = recovery.run_chunks(
+        plan, runner.chunk_jit, _fresh_carry(runner, state0, cs0),
+        max_retries=1,
+    )
+    assert res.status == "done" and res.retries == 1
+    s2, c2 = res.carry
+    _assert_trees_equal((fs, fc, flog), (s2, c2, res.logs),
+                        "after snapshot IO retry")
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    j = recovery.RunJournal(str(tmp_path))
+    j.append({"event": "run_start", "n_hl_steps": 4, "n_chunks": 2})
+    j.append({"event": "chunk", "chunk": 0})
+    with open(j.path, "a") as fh:
+        fh.write('{"event": "chunk", "chu')  # power cut mid-append.
+    events = j.read()
+    assert [e["event"] for e in events] == ["run_start", "chunk"]
+    assert j.completed_chunks() == {0}
+    assert recovery.read_plan(str(tmp_path)).n_chunks == 2
+
+
+def test_resilient_vmapped_batch_resume_with_quarantined_lane(tmp_path):
+    """The sharded serving path end to end: a vmapped batch (one lane
+    driven to NaN and quarantined) runs through
+    ``mesh.scenario_rollout_resumable`` — checkpoint at every chunk
+    boundary — is preempted mid-run, and resumes to logs bit-identical to
+    the uninterrupted vmapped run, sticky quarantine flag included."""
+    n, B, n_steps, n_chunks = 4, 4, 8, 2
+    params, col, state0 = setup.rqp_setup(n)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=6, inner_iters=15,
+    )
+    hl = make_cadmm_hl_step(params, cfg)
+    ll = lowlevel.make_lowlevel_controller("pd", params)
+    cs0 = cadmm.init_cadmm_state(params, cfg)
+    x0 = state0.xl
+
+    def acc_des_fn(state, t):
+        del t
+        dvl = -1.0 * state.vl - 1.0 * (state.xl - x0)
+        return (dvl, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
+
+    scheds = [faults_mod.make_schedule(n, key=jax.random.PRNGKey(k))
+              for k in range(B)]
+    # Lane 1 blows up mid-run (inf actuator gain) and must quarantine.
+    scheds[1] = faults_mod.make_schedule(
+        n, t_degrade={0: 3}, thrust_scale=jnp.inf,
+        key=jax.random.PRNGKey(1),
+    )
+    batch_scheds = jax.tree.map(lambda *xs: jnp.stack(xs), *scheds)
+
+    m = mesh_mod.make_mesh({"scenario": 2})
+    batch_states = jax.vmap(lambda _: state0)(jnp.arange(B))
+    batch_cs = jax.vmap(lambda _: cs0)(jnp.arange(B))
+
+    # Uninterrupted reference (the test_quarantine pattern), with the
+    # initial carries as ARGUMENTS (not baked constants) and the batch
+    # sharded over the same mesh, so the reference and the resumable path
+    # run the identical program shape on identical placements.
+    ref_fn = jax.jit(jax.vmap(
+        lambda f, s, c: resilient_rollout(
+            hl, ll.control, params, s, c, n_hl_steps=n_steps,
+            hl_rel_freq=HL_REL, acc_des_fn=acc_des_fn, faults=f,
+        )
+    ))
+    _, _, ref_logs = ref_fn(*mesh_mod.shard_scenarios(
+        m, (batch_scheds, batch_states, batch_cs)
+    ))
+    assert bool(jnp.any(ref_logs.quarantined[1])), "lane 1 must quarantine"
+
+    # Chunked: the per-lane fault schedule rides INSIDE the carry so one
+    # chunk function serves heterogeneous lanes under vmap.
+    chunk_len = n_steps // n_chunks
+
+    def chunk_fn(carry, i0):
+        rc, sched = carry
+        rc, logs = resilient_rollout(
+            hl, ll.control, params, None, None, chunk_len,
+            hl_rel_freq=HL_REL, acc_des_fn=acc_des_fn, faults=sched,
+            carry0=rc, step_offset=i0, return_carry=True,
+        )
+        return (rc, sched), logs
+
+    def batch_carry0():
+        return jax.vmap(
+            lambda f, s, c: (init_resilient_carry(hl, params, s, c, f), f)
+        )(jax.tree.map(jnp.copy, batch_scheds),
+          jax.tree.map(jnp.copy, batch_states),
+          jax.tree.map(jnp.copy, batch_cs))
+
+    ch = checkpoint.config_fingerprint(cfg=cfg, n=n, B=B)
+    run = mesh_mod.scenario_rollout_resumable(
+        chunk_fn, m, n_hl_steps=n_steps, n_chunks=n_chunks,
+        run_dir=str(tmp_path), config_hash=ch,
+    )
+    interrupt = recovery.GracefulInterrupt()
+    interrupt.triggered = None
+    orig_jit = run.batched_jit
+
+    def preempting(carry, i0):
+        out = orig_jit(carry, i0)
+        interrupt.triggered = "SIGTERM"  # killed after the first chunk.
+        return out
+
+    plan = run.plan
+    res = recovery.run_chunks(
+        plan, preempting, batch_carry0(), interrupt=interrupt,
+        place=lambda c: mesh_mod.shard_scenarios(m, c),
+    )
+    assert res.status == "preempted" and res.chunks_done == 1
+
+    res2 = run(batch_carry0(), resume=True)
+    assert res2.status == "done" and res2.resumed_from_chunk == 1
+    _assert_trees_equal(ref_logs, res2.logs, "vmapped resume")
+    final_rc, _ = res2.carry
+    quar = np.asarray(final_rc[3])
+    assert quar[1] and not quar[[0, 2, 3]].any(), \
+        "sticky quarantine flag must survive the resume bit-exactly"

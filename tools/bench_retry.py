@@ -49,6 +49,28 @@ def _utc() -> str:
     )
 
 
+SWEEP_JOURNAL = "BENCH_SWEEP_JOURNAL.jsonl"
+
+
+def _journal_cells(cwd: str) -> int | None:
+    """Completed-cell count from a crashed sweep's journal, ``None`` when
+    no journal exists (nothing to resume). Tolerates a torn final line —
+    the same contract as ``resilience.recovery.RunJournal.read``."""
+    path = os.path.join(cwd, SWEEP_JOURNAL)
+    if not os.path.exists(path):
+        return None
+    cells = set()
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("event") == "cell":
+                cells.add(e.get("cell"))
+    return len(cells)
+
+
 def run_with_retries(
     cmd: list[str],
     attempts: int = 3,
@@ -56,10 +78,19 @@ def run_with_retries(
     backoff_s: float = 30.0,
     probe_timeout_s: int = 60,
     probe_fn=probe,
+    cwd: str = REPO,
 ) -> dict:
     """Run ``cmd`` with per-attempt chip probes, timeouts, and exponential
     backoff. Returns the structured record described in the module
-    docstring (pure data — the CLI wrapper handles printing/exit)."""
+    docstring (pure data — the CLI wrapper handles printing/exit).
+
+    Sweep resume: when ``cmd`` is a ``--sweep`` run and a failed/wedged
+    attempt left a sweep journal behind (``BENCH_SWEEP_JOURNAL.jsonl``),
+    subsequent attempts get ``--resume`` appended so the sweep continues
+    from the journaled cells instead of restarting from zero — the record
+    carries ``resumed_from_chunk`` (restored-cell count at the time the
+    resume was queued) and bench's own final JSON line reports the same
+    field."""
     record = {
         "cmd": cmd,
         "started": _utc(),
@@ -70,8 +101,23 @@ def run_with_retries(
         "result": None,
     }
     delay = backoff_s
+    use_resume = False
+    is_sweep = "--sweep" in cmd
+
+    def _queue_resume():
+        """After a failed sweep attempt: resume from the journal next time."""
+        nonlocal use_resume
+        if not is_sweep or "--resume" in cmd:
+            return
+        n = _journal_cells(cwd)
+        if n:
+            use_resume = True
+            record["resumed_from_chunk"] = n
+
     for k in range(attempts):
         att = {"attempt": k + 1, "ts": _utc()}
+        if use_resume:
+            att["resumed"] = True
         ok, detail = probe_fn(timeout_s=probe_timeout_s)
         record["probe_count"] += 1
         att["probe_ok"] = ok
@@ -92,10 +138,11 @@ def run_with_retries(
             record["classification"] = "wedged"
         else:
             t0 = time.monotonic()
+            cmd_k = cmd + ["--resume"] if use_resume else cmd
             try:
                 proc = subprocess.run(
-                    cmd, capture_output=True, text=True, timeout=timeout_s,
-                    env=dict(os.environ), cwd=REPO,
+                    cmd_k, capture_output=True, text=True, timeout=timeout_s,
+                    env=dict(os.environ), cwd=cwd,
                 )
                 att["duration_s"] = round(time.monotonic() - t0, 1)
                 att["rc"] = proc.returncode
@@ -123,6 +170,7 @@ def run_with_retries(
                 record["classification"] = "wedged"
             record["attempts"].append(att)
             record["last_error"] = att["error"]
+            _queue_resume()
         if k + 1 < attempts:
             time.sleep(delay)
             delay *= 2.0

@@ -381,6 +381,49 @@ def _build_rollout_donated():
     return fn, make_args
 
 
+@_register("harness.rollout:chunked_rollout")
+def _build_chunked_rollout():
+    import itertools
+
+    from tpu_aerial_transport.harness import rollout as h_rollout
+
+    params, cfg, centralized, llc, hl = _rollout_bits()
+    x0 = _rqp_bits(4)[2].xl
+
+    def acc_des_fn(state, t):
+        del t
+        dvl = -1.0 * state.vl - 1.0 * (state.xl - x0)
+        return (dvl, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
+
+    # donate pinned True: the recovery drivers default it OFF for
+    # bit-reproducibility under the persistent compilation cache, but the
+    # donated configuration must STAY donation-clean (TC105 aliasing) and
+    # single-compile (TC101) for serving callers that opt back in.
+    run = h_rollout.make_chunked_rollout(
+        hl, llc.control, params, n_hl_steps=4, n_chunks=2, hl_rel_freq=2,
+        acc_des_fn=acc_des_fn, donate=True,
+    )
+    # The real jitted chunk (donated carry, traced step offset): TC105
+    # sees the aliasing, TC101 sees the jit cache.
+    fn = run.chunk_jit
+    chunk_idx = itertools.count()
+
+    def make_args():
+        # Successive calls pass SUCCESSIVE chunk offsets, so the TC101
+        # no-retrace check asserts the crash-recovery tier's core
+        # property: all C chunks hit one compiled program (an offset
+        # leaking into the trace key would retrace per chunk). Carries are
+        # fresh + decoupled (donation; see _build_rollout_donated).
+        c = next(chunk_idx) % run.n_chunks
+        carry = jax.tree.map(
+            jnp.copy,
+            (_rqp_bits(4)[2], centralized.init_ctrl_state(params, cfg)),
+        )
+        return (carry, h_rollout.chunk_index_offset(c, run.chunk_len))
+
+    return fn, make_args
+
+
 @_register("resilience.rollout:resilient_rollout")
 def _build_resilient():
     from tpu_aerial_transport.control import cadmm, lowlevel

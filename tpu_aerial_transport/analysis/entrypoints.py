@@ -95,6 +95,9 @@ CONTRACT_ENTRYPOINTS: dict[str, str] = {
     "harness.rollout:rollout": "nominal two-rate receding-horizon rollout",
     "harness.rollout:rollout_donated":
         "donation-clean jitted rollout (carries updated in place)",
+    "harness.rollout:chunked_rollout":
+        "chunk-resumable rollout: ONE compiled chunk reused for all C "
+        "chunks (crash-recovery tier)",
     "resilience.rollout:resilient_rollout":
         "fault-injected rollout with fallback ladder + quarantine",
     "resilience.rollout:resilient_rollout_donated":
@@ -153,6 +156,8 @@ TILE_WAIVERS: dict[str, str] = {
     "harness.rollout:rollout": "drives the centralized controller (waived "
         "above); 3-vector rigid-body physics otherwise",
     "harness.rollout:rollout_donated": "same program as harness.rollout",
+    "harness.rollout:chunked_rollout":
+        "same per-step program as harness.rollout, split into chunks",
     "parallel.mesh:scenario_rollout":
         "scenario axis is data-parallel over the centralized-controller "
         "rollout; per-lane ops are 3-vectors",
@@ -166,6 +171,7 @@ TILE_WAIVERS: dict[str, str] = {
 # the lowered StableHLO.
 DONATION_CONTRACTS: dict[str, int] = {
     "harness.rollout:rollout_donated": 6,
+    "harness.rollout:chunked_rollout": 6,
     "resilience.rollout:resilient_rollout_donated": 6,
     "parallel.mesh:scenario_rollout": 6,
 }

@@ -1,24 +1,54 @@
 """Checkpoint / resume for rollouts and solver state.
 
-The reference's persistence story is trajectory-level only: the finished run is
-pickled (example/rqp_example.py:141-165) and later replayed, with the forest
-reconstructed from logged tree positions (rqp_plots.py:503-505); there is no
-mid-run resume (SURVEY.md §5.4). Here both levels exist:
+The reference's persistence story is trajectory-level only: the finished run
+is pickled (example/rqp_example.py:141-165) and later replayed, with the
+forest reconstructed from logged tree positions (rqp_plots.py:503-505);
+there is no mid-run resume (SURVEY.md §5.4). Here three levels exist:
 
-- :func:`save_run` / :func:`load_run` — the reference's artifact: the log dict
-  (npz) including tree positions, so plotting/replay tools work unchanged.
-- :func:`save_state` / :func:`load_state` — mid-run resume: any pytree
-  (``(RQPState, CtrlState/CADMMState/DDState)`` scan carry included) via orbax,
-  so a 100 s rollout can be split into segments or recovered after preemption.
-  Forest regeneration stays deterministic through ``make_forest(seed)``.
+- :func:`save_run` / :func:`load_run` — the reference's artifact: the log
+  dict (npz) including tree positions, so plotting/replay tools work
+  unchanged.
+- :func:`save_state` / :func:`load_state` — loose mid-run pytree persistence
+  via the installed backend (orbax when present, npz otherwise —
+  ``utils.compat.pytree_io``). No integrity metadata; kept for ad-hoc use.
+- :func:`save_snapshot` / :func:`load_snapshot` / :func:`load_latest_valid`
+  — the crash-recovery tier (``resilience.recovery`` drives it): atomic
+  versioned snapshots with a schema version, a pytree treedef fingerprint,
+  per-leaf payload digests, and a caller-supplied config hash. Writes are
+  temp-file + ``os.replace`` (a crash mid-write can never truncate a
+  published snapshot), retention is keep-last-K, and ``load`` classifies
+  truncation / corruption / structure drift / config mismatch into a
+  structured :class:`SnapshotError` instead of returning garbage —
+  :func:`load_latest_valid` then falls back to the newest snapshot that
+  passes every check.
+
+Snapshot container: one uncompressed ``.ckpt`` file in npz layout —
+``__manifest__`` (UTF-8 JSON as a uint8 array) plus ``leaf_NNNNNN`` arrays
+in ``jax.tree.flatten`` order. Exact bytes in, exact bytes out: leaves are
+stored at their on-device dtype and restored with it, so resume is
+bit-exact (no pickled objects anywhere; ``allow_pickle=False`` on read).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
 import os
+import re
 
 import jax
 import numpy as np
+
+SCHEMA_VERSION = 1
+
+_MANIFEST_KEY = "__manifest__"
+# The prefix grammar is shared by snapshot_path (write side) and
+# list_snapshots (read side): a prefix the filename pattern cannot parse
+# back would produce snapshots that are published but invisible to
+# retention and recovery, so snapshot_path validates against the same rule.
+_PREFIX_RE = re.compile(r"^[A-Za-z0-9_.]+$")
+_SNAP_RE = re.compile(r"^(?P<prefix>[A-Za-z0-9_.]+)-(?P<step>\d{8})\.ckpt$")
 
 
 def save_run(path: str, log_dict: dict) -> None:
@@ -34,13 +64,17 @@ def save_run(path: str, log_dict: dict) -> None:
 
 
 def load_run(path: str) -> dict:
-    """Inverse of :func:`save_run`; nested keys are restored."""
+    """Inverse of :func:`save_run`; nested keys are restored. 0-d arrays
+    come back as numpy SCALARS of the saved dtype (``v[()]``) — the
+    previous ``v.item()`` silently widened e.g. a saved ``np.float32``
+    scalar to a Python float, so a save/load/save cycle changed dtypes
+    (regression-tested in tests/test_checkpoint.py)."""
     raw = np.load(path, allow_pickle=False)
     out: dict = {}
     for k in raw.files:
         v = raw[k]
         if v.ndim == 0:
-            v = v.item()
+            v = v[()]
         if "." in k:
             outer, inner = k.split(".", 1)
             out.setdefault(outer, {})[inner] = v
@@ -50,21 +84,271 @@ def load_run(path: str) -> dict:
 
 
 def save_state(path: str, state) -> None:
-    """Checkpoint an arbitrary pytree (scan carry, solver state) with orbax."""
-    import orbax.checkpoint as ocp
+    """Checkpoint an arbitrary pytree (scan carry, solver state) with the
+    installed backend — orbax when present, the npz fallback otherwise
+    (``utils.compat.pytree_io``; before the shim this hard-ImportError'd
+    without orbax)."""
+    from tpu_aerial_transport.utils import compat
 
-    path = os.path.abspath(path)
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, state, force=True)
+    save, _, _ = compat.pytree_io()
+    save(os.path.abspath(path), state)
 
 
 def load_state(path: str, template):
     """Restore a pytree checkpoint; ``template`` supplies structure/dtypes
     (pass the same pytree shape you saved, e.g. a freshly-initialized state)."""
-    import orbax.checkpoint as ocp
+    from tpu_aerial_transport.utils import compat
 
-    path = os.path.abspath(path)
-    ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(path, item=template)
+    _, restore, _ = compat.pytree_io()
+    restored = restore(os.path.abspath(path), template)
     return jax.tree.map(lambda t, r: jax.numpy.asarray(r, t.dtype)
                         if hasattr(t, "dtype") else r, template, restored)
+
+
+# ----------------------------------------------------------------------
+# Crash-recovery snapshot tier.
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotError(Exception):
+    """Structured load failure — the machine-readable record
+    ``resilience.recovery`` journals when it skips a snapshot.
+
+    kind: ``unreadable`` (truncated/not-a-zip/missing manifest),
+    ``corrupt`` (a leaf's payload digest mismatches its manifest entry),
+    ``schema`` (written by a newer format), ``structure_mismatch`` (treedef
+    fingerprint differs from the template's), ``config_mismatch`` (the
+    run's params/config hash changed — resuming would silently mix
+    configurations), ``no_valid_snapshot`` (every candidate failed;
+    ``errors`` holds the per-file reasons).
+    """
+
+    kind: str
+    path: str
+    detail: str = ""
+    errors: tuple = ()
+
+    def __str__(self) -> str:
+        msg = f"[{self.kind}] {self.path}: {self.detail}"
+        if self.errors:
+            msg += "".join(f"\n  - {e}" for e in self.errors)
+        return msg
+
+
+def tree_fingerprint(tree) -> str:
+    """Stable fingerprint of a pytree's STRUCTURE: treedef string plus
+    per-leaf shape/dtype, hashed. Works on concrete arrays and on
+    ``jax.eval_shape`` outputs (ShapeDtypeStructs) alike, so a resume
+    driver can fingerprint the expected carry without running a chunk."""
+    leaves, treedef = jax.tree.flatten(tree)
+    spec = [str(treedef)] + [
+        f"{tuple(getattr(l, 'shape', ()))}:{np.dtype(getattr(l, 'dtype', type(l))).str}"
+        for l in leaves
+    ]
+    return hashlib.sha256("\n".join(spec).encode()).hexdigest()[:32]
+
+
+def config_fingerprint(**named) -> str:
+    """Hash of named configuration objects (params, controller config,
+    fault schedule, CLI args...). Uses ``repr`` — the configs here are
+    flax struct / frozen dataclasses whose reprs are deterministic and
+    value-complete — so any config drift between save and resume flips the
+    hash and :func:`load_snapshot` refuses the mix."""
+    blob = json.dumps({k: repr(v) for k, v in sorted(named.items())})
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def snapshot_path(directory: str, step: int, prefix: str = "snap") -> str:
+    if not _PREFIX_RE.match(prefix):
+        raise ValueError(
+            f"snapshot prefix {prefix!r} must match {_PREFIX_RE.pattern} "
+            "(list_snapshots could not parse the filename back, making the "
+            "snapshot invisible to retention and recovery)"
+        )
+    return os.path.join(directory, f"{prefix}-{step:08d}.ckpt")
+
+
+def list_snapshots(directory: str, prefix: str = "snap") -> list[tuple[int, str]]:
+    """``(step, path)`` pairs for every published snapshot, step-ascending.
+    In-flight temp files (``*.tmp.*``) are invisible by construction."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = _SNAP_RE.match(name)
+        if m and m.group("prefix") == prefix:
+            out.append((int(m.group("step")), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def save_snapshot(
+    directory: str,
+    step: int,
+    state,
+    *,
+    prefix: str = "snap",
+    config_hash: str | None = None,
+    meta: dict | None = None,
+    keep_last: int = 3,
+) -> str:
+    """Atomically publish snapshot ``step`` of ``state`` under
+    ``directory`` and prune to the newest ``keep_last`` (0 disables
+    pruning). The file appears under its final name only after a complete,
+    fsync'd write (temp file + ``os.replace``), so a crash at ANY byte
+    leaves either the previous snapshot set or the new one — never a
+    half-written file under a valid name. Returns the published path."""
+    os.makedirs(directory, exist_ok=True)
+    leaves = [np.asarray(l) for l in jax.tree.leaves(state)]
+    manifest = {
+        "schema": SCHEMA_VERSION,
+        "step": int(step),
+        "treedef": tree_fingerprint(state),
+        "config_hash": config_hash,
+        "leaves": [
+            {
+                "shape": list(l.shape),
+                "dtype": l.dtype.str,
+                "sha256": hashlib.sha256(
+                    np.ascontiguousarray(l).tobytes()
+                ).hexdigest(),
+            }
+            for l in leaves
+        ],
+        "meta": meta or {},
+    }
+    arrs = {f"leaf_{i:06d}": l for i, l in enumerate(leaves)}
+    arrs[_MANIFEST_KEY] = np.frombuffer(
+        json.dumps(manifest).encode(), np.uint8
+    )
+    path = snapshot_path(directory, step, prefix)
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        # Uncompressed: snapshots are hot-path IO and the payload is
+        # mostly incompressible f32 state; digests protect integrity.
+        np.savez(fh, **arrs)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    if keep_last > 0:
+        for _, old in list_snapshots(directory, prefix)[:-keep_last]:
+            os.remove(old)
+    return path
+
+
+def _parse_manifest(raw, path: str) -> dict:
+    """Manifest from an open npz handle (schema-checked); raises
+    :class:`SnapshotError` (kind ``unreadable``/``schema``)."""
+    if _MANIFEST_KEY not in raw.files:
+        raise SnapshotError("unreadable", path, "manifest missing")
+    manifest = json.loads(bytes(raw[_MANIFEST_KEY]).decode())
+    if manifest.get("schema", -1) > SCHEMA_VERSION:
+        raise SnapshotError(
+            "schema", path,
+            f"written by schema {manifest.get('schema')} > supported "
+            f"{SCHEMA_VERSION}",
+        )
+    return manifest
+
+
+def read_manifest(path: str) -> dict:
+    """Manifest of a snapshot file, or raise :class:`SnapshotError`
+    (kind ``unreadable``/``schema``)."""
+    try:
+        with np.load(path, allow_pickle=False) as raw:
+            return _parse_manifest(raw, path)
+    except SnapshotError:
+        raise
+    except Exception as e:  # truncated zip, bad CRC, bad JSON, missing file
+        raise SnapshotError(
+            "unreadable", path, f"{type(e).__name__}: {e}"
+        ) from e
+
+
+def load_snapshot(
+    path: str,
+    template,
+    *,
+    config_hash: str | None = None,
+):
+    """Verify and restore one snapshot into ``template``'s structure.
+
+    Every check runs BEFORE any data is trusted: container readability and
+    schema (:func:`read_manifest`), per-leaf payload digests (bit-rot /
+    torn writes that survived the zip CRC), treedef fingerprint against
+    ``template`` (a ShapeDtypeStruct tree from ``jax.eval_shape`` works),
+    and — when both sides supply one — the config hash. Failure raises a
+    structured :class:`SnapshotError`; success returns
+    ``(state, manifest)`` with every leaf restored at its SAVED dtype
+    (bit-exact, independent of the template's concrete values). The file
+    is opened ONCE — manifest checks run before any leaf payload is read,
+    so a refused snapshot costs one zip-directory parse, not a full read
+    (resume validates whole log prefixes through this path)."""
+    try:
+        with np.load(path, allow_pickle=False) as raw:
+            manifest = _parse_manifest(raw, path)
+            if (config_hash is not None
+                    and manifest.get("config_hash") is not None
+                    and manifest["config_hash"] != config_hash):
+                raise SnapshotError(
+                    "config_mismatch", path,
+                    f"snapshot config {manifest['config_hash']} != current "
+                    f"{config_hash}: resuming would mix configurations",
+                )
+            if manifest.get("treedef") != tree_fingerprint(template):
+                raise SnapshotError(
+                    "structure_mismatch", path,
+                    "snapshot pytree structure differs from the template "
+                    "(carry schema drifted since the run was started)",
+                )
+            leaves = [raw[f"leaf_{i:06d}"]
+                      for i in range(len(manifest["leaves"]))]
+    except SnapshotError:
+        raise
+    except Exception as e:
+        raise SnapshotError(
+            "unreadable", path, f"{type(e).__name__}: {e}"
+        ) from e
+    for i, (leaf, spec) in enumerate(zip(leaves, manifest["leaves"])):
+        digest = hashlib.sha256(
+            np.ascontiguousarray(leaf).tobytes()
+        ).hexdigest()
+        if digest != spec["sha256"]:
+            raise SnapshotError(
+                "corrupt", path,
+                f"leaf {i} payload digest mismatch (stored "
+                f"{spec['sha256'][:12]}, read {digest[:12]})",
+            )
+    treedef = jax.tree.structure(template)
+    state = jax.tree.unflatten(
+        treedef, [jax.numpy.asarray(l) for l in leaves]
+    )
+    return state, manifest
+
+
+def load_latest_valid(
+    directory: str,
+    template,
+    *,
+    prefix: str = "snap",
+    config_hash: str | None = None,
+):
+    """Newest snapshot that passes EVERY integrity check, walking backwards
+    over older snapshots on failure (the keep-last-K retention exists
+    exactly so there is something to fall back to). Returns
+    ``(state, manifest, skipped)`` where ``skipped`` lists the structured
+    errors of every newer snapshot that was rejected; raises
+    :class:`SnapshotError` (kind ``no_valid_snapshot``) when none survive."""
+    skipped: list[SnapshotError] = []
+    for _, path in reversed(list_snapshots(directory, prefix)):
+        try:
+            state, manifest = load_snapshot(
+                path, template, config_hash=config_hash
+            )
+            return state, manifest, skipped
+        except SnapshotError as e:
+            skipped.append(e)
+    raise SnapshotError(
+        "no_valid_snapshot", directory,
+        f"no loadable '{prefix}' snapshot",
+        errors=tuple(str(e) for e in skipped),
+    )

@@ -85,6 +85,7 @@ def rollout(
     hl_rel_freq: int = 10,
     dt: float = 1e-3,
     acc_des_fn: Callable | None = None,
+    step_offset=0,
 ):
     """Run ``n_hl_steps`` high-level control periods.
 
@@ -95,6 +96,11 @@ def rollout(
       ll_control: ``(state, f_des) -> (f (n,), M (n,3))``.
       acc_des_fn: ``(state, t) -> (acc_des, x_ref, v_ref)``; default hover at the
         initial position.
+      step_offset: global index of the first HL step (a traced int32 scalar
+        under :func:`make_chunked_rollout`, so every chunk reuses ONE
+        compiled program). The scan runs over ``step_offset + arange``;
+        int32 addition is exact, so the per-step times — and therefore the
+        whole trajectory — are bitwise-identical to an unchunked run.
 
     Returns ``(final_state, final_ctrl_state, logs: RQPLogStep)`` with a leading
     time axis of length ``n_hl_steps`` on every log leaf.
@@ -135,9 +141,10 @@ def rollout(
         )
         return (state, cs), log
 
-    (state, cs), logs = lax.scan(
-        hl_body, (state0, ctrl_state0), jnp.arange(n_hl_steps)
-    )
+    steps = jnp.arange(n_hl_steps)
+    if not (isinstance(step_offset, int) and step_offset == 0):
+        steps = steps + step_offset
+    (state, cs), logs = lax.scan(hl_body, (state0, ctrl_state0), steps)
     return state, cs, logs
 
 
@@ -176,6 +183,176 @@ def jit_rollout(
         )
 
     return jax.jit(run, donate_argnums=(0, 1) if donate else ())
+
+
+def make_chunked_rollout(
+    hl_step: Callable,
+    ll_control: Callable,
+    params: rqp.RQPParams,
+    *,
+    n_hl_steps: int,
+    n_chunks: int,
+    hl_rel_freq: int = 10,
+    dt: float = 1e-3,
+    acc_des_fn: Callable,
+    donate: bool = False,
+):
+    """Preemption-safe twin of :func:`jit_rollout`: the T-step scan split
+    into ``n_chunks`` chunks of ``T / n_chunks`` HL steps each, reusing ONE
+    compiled chunk function, with the scan carry surfaced (and snapshot-able)
+    at every chunk boundary.
+
+    The chunk program is ``chunk(carry, i0) -> (carry, logs)`` with
+    ``carry = (state, ctrl_state)`` and the global step offset ``i0`` a
+    traced int32 scalar — all C chunks hit one jit-cache entry (asserted by
+    the ``harness.rollout:chunked_rollout`` trace contract). Because int32
+    offset addition is exact, the concatenated logs and final carry are
+    BITWISE-identical to an unchunked :func:`jit_rollout`
+    (tests/test_recovery.py asserts this).
+
+    ``donate=True`` donates the carry (the TC105 aliasing the
+    ``harness.rollout:chunked_rollout`` contract checks — its builder pins
+    ``donate=True``) but is OFF by default in this recovery tier: measured
+    on XLA-CPU with the persistent compilation cache, in-place buffer reuse
+    interacts with cache-loaded executables' buffer assignment and can flip
+    low-order result bits depending on allocation history — breaking the
+    bit-exact resume guarantee this tier exists for. The saving donation
+    buys here (one carry copy per chunk boundary, where a host-side
+    snapshot is being written anyway) is noise next to that guarantee;
+    chained high-rate serving without snapshots should keep using
+    :func:`jit_rollout` with its donated carries.
+
+    ``acc_des_fn`` is REQUIRED (no default): the hover default of
+    :func:`rollout` closes over the rollout's *initial* state, which under
+    chunking would silently re-anchor the reference at every chunk boundary
+    and break the bitwise-identity guarantee.
+
+    Returns ``run(state0, ctrl_state0, on_boundary=None) -> (final_state,
+    final_ctrl_state, logs)``; ``on_boundary(chunk_idx, carry, logs_chunk)``
+    fires after each chunk (the hook may read/copy the carry — it is not
+    consumed until the next chunk call). Attributes: ``run.chunk_jit`` (the
+    one jitted chunk, ``(carry, i0) -> (carry, logs)``), ``run.n_chunks``,
+    ``run.chunk_len``, ``run.init_carry`` — the uniform chunk contract
+    ``resilience.recovery`` drives for snapshot/resume.
+    """
+    chunk_len = validate_chunking(n_hl_steps, n_chunks, acc_des_fn)
+
+    def chunk(carry, i0):
+        state, cs = carry
+        state, cs, logs = rollout(
+            hl_step, ll_control, params, state, cs, chunk_len,
+            hl_rel_freq, dt, acc_des_fn, step_offset=i0,
+        )
+        return (state, cs), logs
+
+    return make_chunk_driver(
+        chunk, n_chunks=n_chunks, chunk_len=chunk_len,
+        init_carry=lambda state0, ctrl_state0: (state0, ctrl_state0),
+        unpack=lambda carry: carry, donate=donate,
+    )
+
+
+def chunked_rollout(
+    hl_step: Callable,
+    ll_control: Callable,
+    params: rqp.RQPParams,
+    state0: rqp.RQPState,
+    ctrl_state0,
+    *,
+    n_hl_steps: int,
+    n_chunks: int,
+    hl_rel_freq: int = 10,
+    dt: float = 1e-3,
+    acc_des_fn: Callable,
+    donate: bool = False,
+    on_boundary: Callable | None = None,
+):
+    """Build-and-run convenience over :func:`make_chunked_rollout` (same
+    return contract as :func:`rollout`). With ``donate=True`` the passed
+    ``(state0, ctrl_state0)`` are consumed — the shared-constant-buffer
+    caveat of :func:`jit_rollout` applies (``jax.tree.map(jnp.copy, ...)``
+    a freshly built rest state before donating it)."""
+    run = make_chunked_rollout(
+        hl_step, ll_control, params, n_hl_steps=n_hl_steps,
+        n_chunks=n_chunks, hl_rel_freq=hl_rel_freq, dt=dt,
+        acc_des_fn=acc_des_fn, donate=donate,
+    )
+    return run(state0, ctrl_state0, on_boundary=on_boundary)
+
+
+def validate_chunking(n_hl_steps: int, n_chunks: int,
+                      acc_des_fn: Callable | None) -> int:
+    """Shared argument validation for the chunked-rollout factories;
+    returns the static chunk length."""
+    if n_hl_steps % n_chunks:
+        raise ValueError(
+            f"n_hl_steps={n_hl_steps} not divisible by n_chunks={n_chunks}: "
+            "chunks must share one static chunk length (one compiled "
+            "program) or the jit cache fragments"
+        )
+    if acc_des_fn is None:
+        raise ValueError(
+            "chunked rollouts need an explicit acc_des_fn: the hover "
+            "default anchors at each chunk's initial state and would "
+            "diverge from the unchunked trajectory"
+        )
+    return n_hl_steps // n_chunks
+
+
+def make_chunk_driver(
+    chunk: Callable,
+    *,
+    n_chunks: int,
+    chunk_len: int,
+    init_carry: Callable,
+    unpack: Callable,
+    donate: bool,
+):
+    """The one chunk-loop driver both chunked-rollout factories share:
+    jits ``chunk(carry, i0) -> (carry, logs)`` once (optionally donating
+    the carry) and returns ``run(state0, ctrl_state0, on_boundary=None) ->
+    (final_state, final_ctrl_state, logs)`` with the uniform attributes
+    ``resilience.recovery`` drives (``chunk_jit``/``chunk_fn``/
+    ``n_chunks``/``chunk_len``/``init_carry``). ``unpack`` maps the final
+    carry back to ``(state, ctrl_state)``."""
+    chunk_jit = jax.jit(chunk, donate_argnums=(0,) if donate else ())
+
+    def run(state0, ctrl_state0, on_boundary: Callable | None = None):
+        carry = init_carry(state0, ctrl_state0)
+        chunk_logs = []
+        for c in range(n_chunks):
+            carry, logs = chunk_jit(carry, chunk_index_offset(c, chunk_len))
+            chunk_logs.append(logs)
+            if on_boundary is not None:
+                on_boundary(c, carry, logs)
+        state, cs = unpack(carry)
+        return state, cs, concat_chunk_logs(chunk_logs)
+
+    run.chunk_jit = chunk_jit
+    run.chunk_fn = chunk  # unjitted, for vmap/shard wrappers (parallel.mesh).
+    run.n_chunks = n_chunks
+    run.chunk_len = chunk_len
+    run.init_carry = init_carry
+    return run
+
+
+def chunk_index_offset(chunk_idx: int, chunk_len: int) -> jnp.ndarray:
+    """Global step offset of a chunk as the traced int32 scalar every chunk
+    call must pass (a Python int would be a fresh weak-typed constant —
+    still one cache entry, but an explicit dtype keeps the contract
+    obvious and the key stable)."""
+    return jnp.asarray(chunk_idx * chunk_len, jnp.int32)
+
+
+def concat_chunk_logs(chunk_logs: list, time_axis: int = 0):
+    """Concatenate per-chunk log pytrees along the time axis (axis 0 for a
+    single-scenario rollout; axis 1 when the chunk was vmapped over a
+    leading Monte-Carlo batch axis — ``parallel.mesh`` passes 1)."""
+    if len(chunk_logs) == 1:
+        return chunk_logs[0]
+    return jax.tree.map(
+        lambda *xs: jnp.concatenate(xs, axis=time_axis), *chunk_logs
+    )
 
 
 def logs_to_dict(logs: RQPLogStep, n: int, dt: float, hl_rel_freq: int,

@@ -206,6 +206,79 @@ def scenario_rollout(rollout_fn: Callable, mesh: Mesh, axis: str = "scenario",
     return run
 
 
+def scenario_rollout_resumable(
+    chunk_fn: Callable,
+    mesh: Mesh,
+    *,
+    n_hl_steps: int,
+    n_chunks: int,
+    run_dir: str,
+    axis: str = "scenario",
+    donate: bool = False,
+    config_hash: str | None = None,
+    seed: int | None = None,
+    keep_last: int = 3,
+    max_retries: int = 1,
+    meta: dict | None = None,
+):
+    """Preemption-safe serving twin of :func:`scenario_rollout`: the sharded
+    Monte-Carlo batch rollout split into chunks, with the BATCHED carry
+    snapshotted at every chunk boundary (``resilience.recovery`` +
+    ``harness.checkpoint`` — atomic versioned snapshots, chunk journal) and
+    a host-level retry that requeues the surviving work after a device
+    error: the last boundary's host copy of the batch carry is re-placed
+    onto the (possibly recovered) mesh via :func:`shard_scenarios` and the
+    remaining chunks re-run — a wedged sweep loses at most one chunk of
+    work instead of the whole batch (BENCH_r05.json's null row).
+
+    ``chunk_fn`` is the UNJITTED single-scenario chunk ``(carry, i0) ->
+    (carry, logs)`` — e.g. ``make_chunked_rollout(...).chunk_fn`` — vmapped
+    over the leading scenario axis and jitted ONCE here. ``donate``
+    defaults OFF in this recovery tier (bit-reproducibility under the
+    persistent compilation cache; see
+    ``harness.rollout.make_chunked_rollout``) — the snapshot-less
+    throughput path with donated carries remains :func:`scenario_rollout`.
+
+    Returns ``run(batch_carry, resume=False, interrupt=None) ->
+    recovery.RunResult``; ``resume=True`` restores the newest fully-valid
+    boundary from ``run_dir`` (``batch_carry`` then being the
+    deterministically regenerated chunk-0 batch carry / template). The
+    jitted batched chunk is exposed as ``run.batched_jit``.
+    """
+    from tpu_aerial_transport.resilience import recovery
+
+    batched_jit = jax.jit(
+        jax.vmap(chunk_fn, in_axes=(0, None)),
+        donate_argnums=(0,) if donate else (),
+    )
+    plan = recovery.RunPlan(
+        run_dir=run_dir, n_hl_steps=n_hl_steps, n_chunks=n_chunks,
+        seed=seed, config_hash=config_hash, keep_last=keep_last,
+        # The vmapped chunk's logs lead with the batch axis; time is axis 1.
+        logs_time_axis=1,
+        meta=meta or {},
+    )
+
+    def place(batch_carry):
+        return shard_scenarios(mesh, batch_carry, axis)
+
+    def run(batch_carry, resume: bool = False, interrupt=None):
+        if resume:
+            return recovery.resume_run(
+                run_dir, batched_jit, batch_carry,
+                config_hash=config_hash, interrupt=interrupt, place=place,
+                max_retries=max_retries,
+            )
+        return recovery.run_chunks(
+            plan, batched_jit, batch_carry, interrupt=interrupt,
+            place=place, max_retries=max_retries,
+        )
+
+    run.batched_jit = batched_jit
+    run.plan = plan
+    return run
+
+
 def jit_sharded_step(step: Callable, donate: bool = True):
     """Jit an agent-sharded control step (:func:`cadmm_control_sharded` /
     :func:`dd_control_sharded` / :func:`rp_cadmm_control_sharded`) with the

@@ -18,6 +18,13 @@ a claim that survives stress:
   ``make_cadmm_hl_step`` / ``make_dd_hl_step`` controller adapters that
   recompute the equilibrium force distribution from the healthy-agent mask
   each step.
+- :mod:`recovery` — preemption-safe checkpointing and crash recovery:
+  chunk-completion journal, :func:`recovery.run_chunks` /
+  :func:`recovery.resume_run` over the one-compiled-chunk contract of
+  ``harness.rollout.make_chunked_rollout`` /
+  :func:`rollout.make_chunked_resilient_rollout`, atomic versioned
+  snapshots (``harness.checkpoint``), and :class:`recovery.GracefulInterrupt`
+  for SIGTERM/SIGINT-graceful shutdown.
 """
 
 from tpu_aerial_transport.resilience.faults import (  # noqa: F401
@@ -33,12 +40,23 @@ from tpu_aerial_transport.resilience.quarantine import (  # noqa: F401
     tree_all_finite,
     tree_where,
 )
+from tpu_aerial_transport.resilience.recovery import (  # noqa: F401
+    GracefulInterrupt,
+    RunJournal,
+    RunPlan,
+    RunResult,
+    read_plan,
+    resume_run,
+    run_chunks,
+)
 from tpu_aerial_transport.resilience.rollout import (  # noqa: F401
     RUNG_CLEAN,
     RUNG_EQUILIBRIUM,
     RUNG_HOLD,
     RUNG_RETRY,
+    init_resilient_carry,
     make_cadmm_hl_step,
+    make_chunked_resilient_rollout,
     make_dd_hl_step,
     resilient_rollout,
 )

@@ -1,0 +1,412 @@
+"""Crash recovery for chunked rollouts: chunk-completion journal, resumable
+runs, and preemption-graceful shutdown.
+
+The paper's receding-horizon structure makes exact mid-run snapshots cheap —
+one carry per control step — and ``harness.rollout.make_chunked_rollout`` /
+``resilience.rollout.make_chunked_resilient_rollout`` surface that carry at
+every chunk boundary through ONE compiled chunk function
+``chunk(carry, i0) -> (carry, logs)``. This module is the host-side driver
+around that contract:
+
+- :class:`RunJournal` — an append-only, fsync'd, truncation-tolerant jsonl
+  record of run metadata and per-chunk completion (the journal a wedged
+  bench sweep or a killed rollout is resumed FROM);
+- :func:`run_chunks` — drive the chunk function boundary to boundary,
+  publishing an atomic versioned carry snapshot (``harness.checkpoint``)
+  and a per-chunk log snapshot after every chunk, with an optional
+  host-level retry that restores the last boundary carry and requeues the
+  surviving work after a device error;
+- :func:`resume_run` — pick the newest carry snapshot that passes every
+  integrity check (digests, treedef fingerprint, config hash) WITH a
+  complete valid log prefix, journal what was skipped and why, and continue
+  the run to completion — kill-at-any-chunk followed by ``resume_run``
+  reproduces the uninterrupted trajectory bit-exactly
+  (tests/test_recovery.py), sticky quarantine flags included (they live in
+  the resilient carry);
+- :class:`GracefulInterrupt` — a SIGTERM/SIGINT context manager: the first
+  signal requests a stop at the next chunk boundary (where
+  :func:`run_chunks` flushes a final snapshot and journals ``preempted``),
+  a second signal escalates to an immediate ``KeyboardInterrupt``.
+
+Determinism contract: the initial carry must be regenerable from the
+journal's recorded seed/meta (``envs.forest.make_forest(seed)`` and the
+setup factories are deterministic), so a run directory plus the code that
+started it is sufficient to resume — no live process state survives, none
+is needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_aerial_transport.harness import checkpoint
+from tpu_aerial_transport.harness.rollout import (
+    chunk_index_offset,
+    concat_chunk_logs,
+)
+
+JOURNAL_SCHEMA = 1
+CARRY_PREFIX = "carry"
+LOGS_PREFIX = "logs"
+
+
+@dataclasses.dataclass(frozen=True)
+class RunPlan:
+    """Static description of a chunked run — journaled at start, re-read by
+    :func:`resume_run` so resumption needs only the run directory (plus the
+    deterministic setup the ``meta``/``seed`` fields describe)."""
+
+    run_dir: str
+    n_hl_steps: int
+    n_chunks: int
+    seed: int | None = None
+    config_hash: str | None = None
+    keep_last: int = 3
+    # Axis the per-chunk logs concatenate on: 0 for a single-scenario
+    # rollout, 1 when the chunk is vmapped over a leading batch axis
+    # (parallel.mesh.scenario_rollout_resumable sets 1).
+    logs_time_axis: int = 0
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def chunk_len(self) -> int:
+        return self.n_hl_steps // self.n_chunks
+
+
+@dataclasses.dataclass
+class RunResult:
+    """Outcome of :func:`run_chunks` / :func:`resume_run`. ``logs`` is the
+    full concatenated log pytree over every completed chunk (``None`` when
+    zero chunks completed); ``status`` is ``"done"`` or ``"preempted"``;
+    ``resumed_from_chunk`` is the chunk index execution (re)started at
+    (``None`` for a fresh, uninterrupted run); ``retries`` counts
+    host-level device-error requeues."""
+
+    carry: object
+    logs: object
+    status: str
+    chunks_done: int
+    resumed_from_chunk: int | None = None
+    retries: int = 0
+
+
+class Preempted(RuntimeError):
+    """Raised by drivers that prefer an exception over a ``"preempted"``
+    result (kept for callers embedding :func:`run_chunks` in larger jobs)."""
+
+
+class RunJournal:
+    """Append-only jsonl journal. Every append is flushed AND fsync'd
+    before returning — a chunk is only "completed" once its journal line is
+    durable — and :meth:`read` tolerates a torn final line (the exact state
+    a power cut mid-append leaves behind): the partial line is ignored, so
+    the run resumes from the last durable chunk instead of refusing."""
+
+    FILENAME = "journal.jsonl"
+
+    def __init__(self, run_dir: str, filename: str | None = None):
+        self.run_dir = run_dir
+        self.path = os.path.join(run_dir, filename or self.FILENAME)
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def append(self, event: dict) -> None:
+        os.makedirs(self.run_dir, exist_ok=True)
+        with open(self.path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(event) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def read(self) -> list[dict]:
+        if not self.exists():
+            return []
+        out: list[dict] = []
+        with open(self.path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail from a crash mid-append.
+        return out
+
+    def completed_chunks(self) -> set[int]:
+        return {e["chunk"] for e in self.read() if e.get("event") == "chunk"}
+
+
+class GracefulInterrupt:
+    """Context manager turning SIGTERM/SIGINT into a chunk-boundary stop.
+
+    First signal: record it and let the in-flight XLA computation finish —
+    :func:`run_chunks` sees :attr:`triggered` at the next boundary, flushes
+    a final snapshot, journals ``preempted`` and returns. Second signal:
+    escalate to ``KeyboardInterrupt`` immediately (the operator insists).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = signals
+        self._old: dict = {}
+        self.triggered: str | None = None
+
+    def _handle(self, signum, frame):
+        del frame
+        if self.triggered is not None:
+            raise KeyboardInterrupt(f"second signal {signum}")
+        self.triggered = signal.Signals(signum).name
+
+    def __enter__(self) -> "GracefulInterrupt":
+        for s in self._signals:
+            self._old[s] = signal.signal(s, self._handle)
+        return self
+
+    def __exit__(self, *exc):
+        for s, old in self._old.items():
+            signal.signal(s, old)
+        return False
+
+
+def read_plan(run_dir: str) -> RunPlan:
+    """Reconstruct the :class:`RunPlan` from a run directory's journal."""
+    journal = RunJournal(run_dir)
+    for e in journal.read():
+        if e.get("event") == "run_start":
+            return RunPlan(
+                run_dir=run_dir,
+                n_hl_steps=e["n_hl_steps"],
+                n_chunks=e["n_chunks"],
+                seed=e.get("seed"),
+                config_hash=e.get("config_hash"),
+                keep_last=e.get("keep_last", 3),
+                logs_time_axis=e.get("logs_time_axis", 0),
+                meta=e.get("meta", {}),
+            )
+    raise checkpoint.SnapshotError(
+        "unreadable", journal.path,
+        "no run_start event in journal (not a recovery run directory?)",
+    )
+
+
+def run_chunks(
+    plan: RunPlan,
+    chunk_jit,
+    carry,
+    *,
+    start_chunk: int = 0,
+    prior_logs=(),
+    interrupt: GracefulInterrupt | None = None,
+    place=None,
+    max_retries: int = 0,
+    resumed_from_chunk: int | None = None,
+) -> RunResult:
+    """Drive ``chunk_jit(carry, i0) -> (carry, logs)`` from ``start_chunk``
+    to ``plan.n_chunks``, snapshotting the carry and the chunk's logs at
+    every boundary and journaling completion.
+
+    ``place`` (optional) maps a host carry onto devices (e.g.
+    ``parallel.mesh.shard_scenarios``) — applied to the initial carry and
+    after every device-error restore. ``max_retries`` > 0 enables the
+    host-level retry: a chunk that raises (a device error, a wedged chip
+    surfacing as a runtime error) is requeued on the carry restored from
+    the last boundary's HOST copy — donation may have consumed the device
+    buffers of the failed call, the host copy survives.
+
+    Carry snapshots are pruned to ``plan.keep_last``; per-chunk log
+    snapshots are kept for ALL chunks (the full trajectory must be
+    reconstructable) and are only removed by the operator deleting the run
+    directory.
+    """
+    journal = RunJournal(plan.run_dir)
+    os.makedirs(plan.run_dir, exist_ok=True)
+    if start_chunk == 0 and not any(
+        e.get("event") == "run_start" for e in journal.read()
+    ):
+        journal.append({
+            "event": "run_start", "schema": JOURNAL_SCHEMA,
+            "n_hl_steps": plan.n_hl_steps, "n_chunks": plan.n_chunks,
+            "chunk_len": plan.chunk_len, "seed": plan.seed,
+            "config_hash": plan.config_hash, "keep_last": plan.keep_last,
+            "logs_time_axis": plan.logs_time_axis, "meta": plan.meta,
+        })
+    logs_chunks = list(prior_logs)
+    # The host copy is the retry/requeue anchor: donation consumes device
+    # buffers, a dying device drops them — numpy on the host survives both.
+    # np.array(copy=True), NOT np.asarray: on the CPU backend np.asarray is
+    # a zero-copy VIEW of the device buffer, which the next chunk's
+    # donation would silently recycle under the "backup".
+    carry_host = jax.tree.map(lambda l: np.array(l, copy=True), carry)
+    carry = place(carry) if place is not None else carry
+    retries_total = 0
+    attempt = 0
+    c = start_chunk
+    while c < plan.n_chunks:
+        if interrupt is not None and interrupt.triggered:
+            if c > 0:
+                # Flush a final snapshot of the boundary carry. Normally a
+                # rewrite of the snapshot published right after chunk c-1
+                # (atomic, idempotent); it guarantees the preempted state
+                # is durable even if that publish predates this process.
+                checkpoint.save_snapshot(
+                    plan.run_dir, c - 1, carry_host,
+                    prefix=CARRY_PREFIX, config_hash=plan.config_hash,
+                    keep_last=plan.keep_last, meta={"chunk": c - 1},
+                )
+            journal.append({
+                "event": "preempted", "chunk": c,
+                "signal": interrupt.triggered,
+            })
+            return RunResult(
+                carry=carry,
+                logs=(concat_chunk_logs(logs_chunks, plan.logs_time_axis)
+                      if logs_chunks else None),
+                status="preempted", chunks_done=c,
+                resumed_from_chunk=resumed_from_chunk,
+                retries=retries_total,
+            )
+        try:
+            new_carry, logs = chunk_jit(
+                carry, chunk_index_offset(c, plan.chunk_len)
+            )
+            # The copy both syncs (device errors surface inside this try)
+            # and backs the carry up before the next donation consumes it
+            # (see the zero-copy-view note above). It stays a LOCAL until
+            # the boundary is fully published: rebinding carry_host here
+            # would make a snapshot IO failure retry chunk c from chunk
+            # c's own output — applying its dynamics twice.
+            new_carry_host = jax.tree.map(
+                lambda l: np.array(l, copy=True), new_carry
+            )
+            checkpoint.save_snapshot(
+                plan.run_dir, c, new_carry_host, prefix=CARRY_PREFIX,
+                config_hash=plan.config_hash, keep_last=plan.keep_last,
+                meta={"chunk": c},
+            )
+            checkpoint.save_snapshot(
+                plan.run_dir, c, logs, prefix=LOGS_PREFIX,
+                config_hash=plan.config_hash, keep_last=0,
+                meta={"chunk": c},
+            )
+        except checkpoint.SnapshotError:
+            raise  # a disk-integrity problem; retrying the chunk won't help.
+        except Exception as e:  # noqa: BLE001 — device errors have no
+            # common base class across backends (XlaRuntimeError,
+            # RuntimeError, ValueError from a poisoned transfer...).
+            if attempt >= max_retries:
+                raise
+            attempt += 1
+            retries_total += 1
+            journal.append({
+                "event": "retry", "chunk": c, "attempt": attempt,
+                "error": f"{type(e).__name__}: {e}"[:300],
+            })
+            carry = jax.tree.map(jnp.asarray, carry_host)
+            carry = place(carry) if place is not None else carry
+            continue
+        journal.append({
+            "event": "chunk", "chunk": c,
+            "step_end": (c + 1) * plan.chunk_len,
+            "carry_snapshot": os.path.basename(
+                checkpoint.snapshot_path(plan.run_dir, c, CARRY_PREFIX)
+            ),
+            "retries": attempt,
+        })
+        logs_chunks.append(logs)
+        carry = new_carry
+        carry_host = new_carry_host  # boundary published: advance the anchor.
+        c += 1
+        attempt = 0
+    journal.append({"event": "done", "chunks": plan.n_chunks})
+    return RunResult(
+        carry=carry,
+        logs=(concat_chunk_logs(logs_chunks, plan.logs_time_axis)
+              if logs_chunks else None),
+        status="done", chunks_done=plan.n_chunks,
+        resumed_from_chunk=resumed_from_chunk,
+        retries=retries_total,
+    )
+
+
+def resume_run(
+    run_dir: str,
+    chunk_jit,
+    initial_carry,
+    *,
+    config_hash: str | None = None,
+    interrupt: GracefulInterrupt | None = None,
+    place=None,
+    max_retries: int = 0,
+) -> RunResult:
+    """Resume a journaled run from its newest fully-valid boundary.
+
+    ``initial_carry`` is the chunk-0 carry regenerated DETERMINISTICALLY
+    from the journaled seed/meta (``run.init_carry(...)`` on freshly built
+    setup state); it doubles as the structure/dtype template every snapshot
+    is verified against, and as the restart point when no snapshot survives
+    validation. A resume point ``c`` is accepted only when the carry
+    snapshot of chunk ``c`` AND the log snapshots of chunks ``0..c`` all
+    pass integrity + config checks — otherwise the walk falls back to the
+    previous boundary (rejected snapshots are journaled with their
+    structured error). ``config_hash`` (when given) must match the
+    journaled one — refusing to silently mix configurations is the point.
+
+    Returns the SAME result an uninterrupted run would have produced,
+    bit-exactly: restored chunks contribute their stored logs, remaining
+    chunks recompute from the restored carry through the one compiled
+    chunk function.
+    """
+    plan = read_plan(run_dir)
+    if (config_hash is not None and plan.config_hash is not None
+            and config_hash != plan.config_hash):
+        raise checkpoint.SnapshotError(
+            "config_mismatch", RunJournal(run_dir).path,
+            f"journal config {plan.config_hash} != current {config_hash}: "
+            "the run was started under a different configuration",
+        )
+    check_hash = config_hash if config_hash is not None else plan.config_hash
+    # Shape-only evaluation of the chunk gives the log template without
+    # running (or even compiling) anything.
+    _, logs_template = jax.eval_shape(
+        chunk_jit, initial_carry, chunk_index_offset(0, plan.chunk_len)
+    )
+    journal = RunJournal(run_dir)
+
+    skipped: list[str] = []
+    start_chunk = 0
+    carry = initial_carry
+    prior_logs: list = []
+    for step, path in reversed(
+        checkpoint.list_snapshots(run_dir, CARRY_PREFIX)
+    ):
+        try:
+            cand, _ = checkpoint.load_snapshot(
+                path, initial_carry, config_hash=check_hash
+            )
+            cand_logs = []
+            for lc in range(step + 1):
+                lpath = checkpoint.snapshot_path(run_dir, lc, LOGS_PREFIX)
+                lg, _ = checkpoint.load_snapshot(
+                    lpath, logs_template, config_hash=check_hash
+                )
+                cand_logs.append(lg)
+        except checkpoint.SnapshotError as e:
+            skipped.append(str(e))
+            continue
+        start_chunk = step + 1
+        carry = cand
+        prior_logs = cand_logs
+        break
+    journal.append({
+        "event": "resume", "start_chunk": start_chunk,
+        "skipped": skipped[:8],
+    })
+    return run_chunks(
+        plan, chunk_jit, carry, start_chunk=start_chunk,
+        prior_logs=prior_logs, interrupt=interrupt, place=place,
+        max_retries=max_retries, resumed_from_chunk=start_chunk,
+    )

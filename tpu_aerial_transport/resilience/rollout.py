@@ -100,6 +100,35 @@ def make_dd_hl_step(params, cfg, forest=None, plan=None) -> Callable:
     return hl_step
 
 
+def init_resilient_carry(
+    hl_step: Callable,
+    params: rqp.RQPParams,
+    state0: rqp.RQPState,
+    ctrl_state0,
+    faults: faults_mod.FaultSchedule | None = None,
+):
+    """The full :func:`resilient_rollout` scan carry — ``(state, ctrl_state,
+    prev_applied_force, sticky_quarantine_flag)`` — for a fresh run.
+    Surfacing it (rather than keeping it internal to the scan) is what makes
+    the fault-aware rollout chunkable: a snapshot of this tuple at a chunk
+    boundary captures the fallback ladder's hold force and the sticky
+    quarantine flag bit-exactly, so a resumed run cannot silently un-freeze
+    a quarantined lane or re-seed a poisoned warm start."""
+    active = faults is not None and faults.active
+    if active and hasattr(hl_step, "prepare_ctrl_state"):
+        # Controller adapters seed resilience-only state carries (e.g. the
+        # delivered-snapshot ``held`` fields) so the scan carry structure
+        # is fixed from step 0.
+        ctrl_state0 = hl_step.prepare_ctrl_state(ctrl_state0)
+    n = params.n
+    dtype = state0.xl.dtype
+    return (
+        state0, ctrl_state0,
+        jnp.full((n, 3), jnp.nan, dtype),  # no previous force yet.
+        jnp.zeros((), bool),
+    )
+
+
 def resilient_rollout(
     hl_step: Callable,
     ll_control: Callable,
@@ -111,6 +140,9 @@ def resilient_rollout(
     dt: float = 1e-3,
     acc_des_fn: Callable | None = None,
     faults: faults_mod.FaultSchedule | None = None,
+    carry0=None,
+    step_offset=0,
+    return_carry: bool = False,
 ):
     """Run ``n_hl_steps`` high-level control periods with fault injection,
     the fallback ladder, and NaN quarantine.
@@ -124,17 +156,32 @@ def resilient_rollout(
         the third argument is only passed when fault injection is active.
       faults: optional :class:`FaultSchedule`. ``None`` or a schedule with
         ``active=False`` compiles the identical nominal program.
+      carry0: a full carry from :func:`init_resilient_carry` (or a previous
+        ``return_carry=True`` call) — the chunk-resume path. When given,
+        ``state0``/``ctrl_state0`` may be ``None`` and ``acc_des_fn`` must
+        be explicit (the hover default would re-anchor per chunk).
+      step_offset: global index of the first HL step (traced int32 under
+        chunking; the per-step fault schedule and sensor-noise RNG are
+        indexed by the GLOBAL step, so chunked and unchunked runs draw
+        identical faults).
+      return_carry: return ``(carry, logs)`` instead of unpacking — the
+        uniform chunk contract ``resilience.recovery`` snapshots.
 
-    Returns ``(final_state, final_ctrl_state, logs: RQPLogStep)``; the
-    sticky quarantine flag is ``logs.quarantined`` (last entry = final).
+    Returns ``(final_state, final_ctrl_state, logs: RQPLogStep)`` (or
+    ``(carry, logs)``); the sticky quarantine flag is ``logs.quarantined``
+    (last entry = final).
     """
     active = faults is not None and faults.active
-    if active and hasattr(hl_step, "prepare_ctrl_state"):
-        # Controller adapters seed resilience-only state carries (e.g. the
-        # delivered-snapshot ``held`` fields) so the scan carry structure
-        # is fixed from step 0.
-        ctrl_state0 = hl_step.prepare_ctrl_state(ctrl_state0)
+    if carry0 is None:
+        carry0 = init_resilient_carry(
+            hl_step, params, state0, ctrl_state0, faults
+        )
     if acc_des_fn is None:
+        if state0 is None:
+            raise ValueError(
+                "acc_des_fn must be explicit when resuming from carry0: "
+                "the hover default anchors at state0"
+            )
         x0 = state0.xl
 
         def acc_des_fn(state, t):
@@ -142,8 +189,7 @@ def resilient_rollout(
             dvl_des = -1.0 * state.vl - 1.0 * (state.xl - x0)
             return (dvl_des, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
 
-    n = params.n
-    dtype = state0.xl.dtype
+    dtype = carry0[0].xl.dtype
     f_eq_full = centralized.equilibrium_forces(params)
 
     def hl_body(carry, i):
@@ -231,14 +277,13 @@ def resilient_rollout(
         )
         return (new_state, cs_next, prev_next, quar_new), log
 
-    init = (
-        state0, ctrl_state0,
-        jnp.full((n, 3), jnp.nan, dtype),  # no previous force yet.
-        jnp.zeros((), bool),
-    )
-    (state, cs, _, _), logs = lax.scan(
-        hl_body, init, jnp.arange(n_hl_steps)
-    )
+    steps = jnp.arange(n_hl_steps)
+    if not (isinstance(step_offset, int) and step_offset == 0):
+        steps = steps + step_offset
+    carry, logs = lax.scan(hl_body, carry0, steps)
+    if return_carry:
+        return carry, logs
+    state, cs, _, _ = carry
     return state, cs, logs
 
 
@@ -267,3 +312,55 @@ def jit_resilient_rollout(
         )
 
     return jax.jit(run, donate_argnums=(0, 1) if donate else ())
+
+
+def make_chunked_resilient_rollout(
+    hl_step: Callable,
+    ll_control: Callable,
+    params: rqp.RQPParams,
+    *,
+    n_hl_steps: int,
+    n_chunks: int,
+    hl_rel_freq: int = 10,
+    dt: float = 1e-3,
+    acc_des_fn: Callable,
+    faults: faults_mod.FaultSchedule | None = None,
+    donate: bool = False,
+):
+    """Fault-aware twin of ``harness.rollout.make_chunked_rollout``: the
+    resilient rollout split into ``n_chunks`` chunks reusing ONE compiled
+    chunk ``chunk(carry, i0) -> (carry, logs)`` whose carry is the FULL
+    :func:`init_resilient_carry` tuple — hold force and sticky quarantine
+    flag included, so a chunk-boundary snapshot resumes the fallback ladder
+    and a quarantined Monte-Carlo lane bit-exactly (tests/test_recovery.py
+    asserts identity against an uninterrupted run, quarantined lane and
+    all). The fault schedule and sensor-noise RNG index by GLOBAL step via
+    ``step_offset``, so chunking never re-draws or shifts faults.
+    ``donate`` defaults OFF for the same bit-reproducibility reason as
+    ``make_chunked_rollout`` (see its docstring).
+
+    Returns ``run(state0, ctrl_state0, on_boundary=None) -> (final_state,
+    final_ctrl_state, logs)`` with ``run.chunk_jit`` / ``run.n_chunks`` /
+    ``run.chunk_len`` / ``run.init_carry`` exposed for
+    ``resilience.recovery``."""
+    from tpu_aerial_transport.harness.rollout import (
+        make_chunk_driver,
+        validate_chunking,
+    )
+
+    chunk_len = validate_chunking(n_hl_steps, n_chunks, acc_des_fn)
+
+    def chunk(carry, i0):
+        return resilient_rollout(
+            hl_step, ll_control, params, None, None, chunk_len,
+            hl_rel_freq, dt, acc_des_fn, faults,
+            carry0=carry, step_offset=i0, return_carry=True,
+        )
+
+    return make_chunk_driver(
+        chunk, n_chunks=n_chunks, chunk_len=chunk_len,
+        init_carry=lambda state0, ctrl_state0: init_resilient_carry(
+            hl_step, params, state0, ctrl_state0, faults
+        ),
+        unpack=lambda carry: (carry[0], carry[1]), donate=donate,
+    )

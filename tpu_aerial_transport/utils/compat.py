@@ -1,5 +1,5 @@
-"""Version-compat shims over the small jax API surface whose location or
-keyword names moved across the jax releases this package supports.
+"""Version-compat shims over the small jax-ecosystem API surface whose
+location or keyword names moved across the releases this package supports.
 
 ``shard_map``: promoted from ``jax.experimental.shard_map.shard_map`` to
 ``jax.shard_map`` (and its replication-check kwarg renamed
@@ -8,11 +8,21 @@ only the experimental path and the old kwarg exist. All package/test code
 goes through :func:`shard_map` below, which accepts the NEW spelling
 (``check_vma``) and translates as needed — so call sites are written
 against the modern API and keep working when jax upgrades.
+
+``pytree_io``: orbax's ``PyTreeCheckpointer`` is deprecated in current
+orbax in favor of ``StandardCheckpointer`` (and before this shim,
+``harness.checkpoint.save_state`` hard-ImportError'd on boxes without
+orbax at all). :func:`pytree_io` resolves, in order: modern
+``StandardCheckpointer`` -> legacy ``PyTreeCheckpointer`` -> a
+dependency-free npz fallback, and returns one ``(save, restore)`` pair so
+callers never touch orbax's moving API directly. The orbax pin lives in
+the ``checkpoint`` extra of pyproject.toml.
 """
 
 from __future__ import annotations
 
 import inspect
+import os
 
 import jax
 
@@ -45,3 +55,74 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
     return _SHARD_MAP(
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
     )
+
+
+def _import_orbax():
+    """Import hook for :func:`pytree_io`, separated so tests (and boxes
+    that want the npz path deliberately) can monkeypatch orbax away."""
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError:
+        return None
+    return ocp
+
+
+def pytree_io():
+    """Resolve the installed pytree-checkpoint backend.
+
+    Returns ``(save, restore, backend_name)`` where ``save(path, state)``
+    persists an arbitrary pytree and ``restore(path, template)`` loads it
+    back with ``template`` supplying structure/dtypes. Backends, in
+    preference order:
+
+    - ``"orbax-standard"``: ``ocp.StandardCheckpointer`` (the maintained
+      API; ``PyTreeCheckpointer`` is deprecated in current orbax);
+    - ``"orbax-pytree"``: legacy ``PyTreeCheckpointer`` on old orbax;
+    - ``"npz"``: flat-leaf ``np.savez`` fallback when orbax is absent —
+      a plain file at ``path + ".npz"`` (orbax writes directories), so the
+      two backends never shadow each other's artifacts.
+    """
+    import numpy as np
+
+    ocp = _import_orbax()
+    if ocp is not None and hasattr(ocp, "StandardCheckpointer"):
+        ckptr = ocp.StandardCheckpointer()
+
+        def save(path, state):
+            ckptr.save(os.path.abspath(path), state, force=True)
+            # Async checkpointers return before the write is durable.
+            getattr(ckptr, "wait_until_finished", lambda: None)()
+
+        def restore(path, template):
+            return ckptr.restore(os.path.abspath(path), template)
+
+        return save, restore, "orbax-standard"
+    if ocp is not None:
+        ckptr = ocp.PyTreeCheckpointer()
+
+        def save(path, state):
+            ckptr.save(os.path.abspath(path), state, force=True)
+
+        def restore(path, template):
+            return ckptr.restore(os.path.abspath(path), item=template)
+
+        return save, restore, "orbax-pytree"
+
+    def save(path, state):
+        leaves = jax.tree.leaves(state)
+        arrs = {f"leaf_{i:06d}": np.asarray(l) for i, l in enumerate(leaves)}
+        tmp = path + f".tmp.{os.getpid()}.npz"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **arrs)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path + ".npz")
+
+    def restore(path, template):
+        with np.load(path + ".npz", allow_pickle=False) as raw:
+            leaves = [raw[f"leaf_{i:06d}"]
+                      for i in range(len(raw.files))]
+        treedef = jax.tree.structure(template)
+        return jax.tree.unflatten(treedef, leaves)
+
+    return save, restore, "npz"
