@@ -492,6 +492,18 @@ def headline(profile_dir: str | None = None, platform: str = "unknown",
     if profile_dir:
         # Warm up outside the trace so the profile shows steady-state execution.
         measure(step, css, states, jax.devices()[0], timed_steps, N_SCENARIOS)
+        # Compiled-HLO dump next to the trace: op_name metadata maps each
+        # instruction to its tat.* named scope, which op_profile.py
+        # --by-phase rolls op self-time up to (CPU traces carry no per-
+        # event tf_op stat, so the dump is the attribution source there).
+        try:
+            os.makedirs(profile_dir, exist_ok=True)
+            hlo_text = step.lower(css, states, timed_steps).compile().as_text()
+            with open(os.path.join(profile_dir, "headline.hlo.txt"),
+                      "w") as fh:
+                fh.write(hlo_text)
+        except Exception as e:  # profiling aid only — never sink the bench.
+            print(f"# headline HLO dump failed: {e}", flush=True)
         with jax.profiler.trace(profile_dir):
             tpu_rate = measure(
                 step, css, states, jax.devices()[0], timed_steps, N_SCENARIOS
@@ -749,6 +761,7 @@ def _measured_iter_ms(controller, n, k_lo=4, k_hi=24, n_steps=30):
 
 SWEEP_PARTIAL_PATH = "BENCH_SWEEP_PARTIAL.json"
 SWEEP_JOURNAL_PATH = "BENCH_SWEEP_JOURNAL.jsonl"
+SWEEP_METRICS_PATH = "artifacts/bench_sweep.metrics.jsonl"
 
 
 def _git_head() -> str:
@@ -799,6 +812,7 @@ def sweep(resume: bool = False):
     ``resumed_from_chunk`` (restored-cell count) in its ``_meta`` and in
     the final JSON line (tools/bench_retry.py passes ``--resume`` on
     retry attempts and forwards the field)."""
+    from tpu_aerial_transport.obs import export as export_mod
     from tpu_aerial_transport.resilience.recovery import RunJournal
 
     head = _git_head()
@@ -811,6 +825,7 @@ def sweep(resume: bool = False):
             "or delete the file to start fresh — refusing to overwrite."
         )
     resumed_from_chunk = 0
+    legacy_cells: dict = {}
     if resume and (journal.exists() or os.path.exists(SWEEP_PARTIAL_PATH)):
         cached_head, cached_cells = "missing", {}
         if journal.exists():
@@ -826,6 +841,10 @@ def sweep(resume: bool = False):
                 cached = json.load(fh)
             cached_head = cached.get("_meta", {}).get("git_head", "missing")
             cached_cells = {k: v for k, v in cached.items() if k != "_meta"}
+            # Re-journal below: without cell events for these, a SECOND
+            # crash+resume would read the (journal-first) empty journal
+            # and silently re-measure every legacy cell.
+            legacy_cells = cached_cells
         # 'unknown'/'-dirty' states never match safely: dirty trees can
         # differ between the two runs even at the same SHA.
         if cached_head != head or "unknown" in (cached_head, head) \
@@ -850,10 +869,26 @@ def sweep(resume: bool = False):
     if not any(e.get("event") == "run_start" for e in journal.read()):
         journal.append({"event": "run_start", "mode": "sweep",
                         "git_head": head})
+    for key, value in legacy_cells.items():
+        journal.append({"event": "cell", "cell": key, "value": value})
+
+    # Flight-recorder export (obs.export): one bench_cell event per
+    # measured config, appended across --resume attempts; a fresh sweep
+    # resets the file with the journal. tools/run_health.py renders it,
+    # tools/ci_check.sh schema-validates it.
+    if not resume and os.path.exists(SWEEP_METRICS_PATH):
+        os.remove(SWEEP_METRICS_PATH)
+    metrics = export_mod.MetricsWriter(
+        SWEEP_METRICS_PATH,
+        meta=(None if os.path.exists(SWEEP_METRICS_PATH)
+              else {"mode": "sweep", "git_head": head,
+                    "resumed_from_chunk": resumed_from_chunk}),
+    )
 
     def record(key, value):
         results[key] = value
         journal.append({"event": "cell", "cell": key, "value": value})
+        metrics.emit("bench_cell", cell=key, value=value)
         _write_json_atomic(SWEEP_PARTIAL_PATH, results)
         print(f"# {key}: {value}", flush=True)
 
@@ -984,6 +1019,7 @@ def sweep(resume: bool = False):
             })
 
     _write_json_atomic("BENCH_SWEEP.json", results)
+    metrics.emit("done", chunks=len(results) - 1)
     if os.path.exists(SWEEP_PARTIAL_PATH):
         os.remove(SWEEP_PARTIAL_PATH)
     if journal.exists():

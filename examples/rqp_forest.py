@@ -19,6 +19,13 @@ and resume bit-exactly from the journal:
       --chunks 10 --ckpt-dir /tmp/run1
   # ... kill it mid-run, then:
   python examples/rqp_forest.py --resume /tmp/run1
+
+Flight-recorder telemetry (obs/): accumulate run-health metrics on-device
+and export a schema-versioned metrics jsonl, rendered by run_health:
+
+  python examples/rqp_forest.py --controller cadmm -T 2 --telemetry \
+      --chunks 4 --ckpt-dir /tmp/run2
+  python tools/run_health.py /tmp/run2/run.metrics.jsonl
 """
 
 from __future__ import annotations
@@ -61,6 +68,13 @@ def main() -> None:
                         "run's settings (controller/n/T/seed/...) are "
                         "restored from the journal and the matching CLI "
                         "flags are ignored")
+    p.add_argument("--telemetry", action="store_true",
+                   help="thread the in-jit run-health accumulator "
+                        "(obs.telemetry) through the rollout carry")
+    p.add_argument("--metrics", default=None, metavar="PATH",
+                   help="metrics jsonl path (obs.export; default with "
+                        "--chunks: <ckpt-dir>/run.metrics.jsonl). Render "
+                        "with tools/run_health.py")
     args = p.parse_args()
 
     from tpu_aerial_transport.control import cadmm, centralized, dd, lowlevel
@@ -85,6 +99,9 @@ def main() -> None:
         args.seed = plan.seed
         args.chunks = plan.n_chunks
         args.ckpt_dir = args.resume
+        # The telemetry accumulator is part of the chunk carry: the resumed
+        # chunk program must match the journaled one structurally.
+        args.telemetry = bool(meta.get("telemetry", False))
 
     params, col, state0 = setup.rqp_setup(args.n)
     forest = forest_mod.make_forest(seed=args.seed)
@@ -128,7 +145,15 @@ def main() -> None:
         dist_eps = cfg.base.dist_eps
 
     n_hl_steps = int(args.T / (args.dt * args.hl_rel_freq))
-    checkpointed = args.chunks >= 2 or args.resume
+    tcfg = None
+    if args.telemetry:
+        from tpu_aerial_transport.obs import telemetry as telemetry_mod
+
+        tcfg = telemetry_mod.TelemetryConfig()
+    # chunks >= 1 (not >= 2): asking for ONE checkpointed chunk is a valid
+    # request (snapshot at the end, resumable journal) — silently running
+    # the snapshot-less path would strand a later --resume.
+    checkpointed = args.chunks >= 1 or args.resume
     if checkpointed:
         from tpu_aerial_transport.harness import checkpoint
         from tpu_aerial_transport.resilience import recovery
@@ -144,10 +169,13 @@ def main() -> None:
             controller=args.controller, n=args.n, seed=args.seed,
             dt=args.dt, hl_rel_freq=args.hl_rel_freq, cfg=cfg,
         )
+        metrics_path = args.metrics or os.path.join(
+            args.ckpt_dir, "run.metrics.jsonl"
+        )
         runner = ro.make_chunked_rollout(
             hl, ll.control, params, n_hl_steps=n_hl_steps,
             n_chunks=args.chunks, hl_rel_freq=args.hl_rel_freq, dt=args.dt,
-            acc_des_fn=acc_des_fn,
+            acc_des_fn=acc_des_fn, telemetry=tcfg,
         )
         # Decouple constant-deduped zero leaves before the chunk donates
         # the carry (see harness.rollout.jit_rollout's caveat).
@@ -161,19 +189,25 @@ def main() -> None:
                 res = recovery.resume_run(
                     args.resume, runner.chunk_jit, carry0,
                     config_hash=config_hash, interrupt=interrupt,
+                    metrics=metrics_path,
                 )
                 print(f"resumed from chunk {res.resumed_from_chunk}")
             else:
-                plan = recovery.RunPlan(
+                # NOTE the name: the cadmm/dd Schur/QN `plan` above is
+                # captured late-bound by the `hl` lambda — rebinding `plan`
+                # here would hand the controller a RunPlan mid-rollout.
+                run_plan = recovery.RunPlan(
                     run_dir=args.ckpt_dir, n_hl_steps=n_hl_steps,
                     n_chunks=args.chunks, seed=args.seed,
                     config_hash=config_hash,
                     meta={"controller": args.controller, "n": args.n,
                           "T": args.T, "dt": args.dt,
-                          "hl_rel_freq": args.hl_rel_freq},
+                          "hl_rel_freq": args.hl_rel_freq,
+                          "telemetry": bool(args.telemetry)},
                 )
                 res = recovery.run_chunks(
-                    plan, runner.chunk_jit, carry0, interrupt=interrupt,
+                    run_plan, runner.chunk_jit, carry0, interrupt=interrupt,
+                    metrics=metrics_path,
                 )
         dt_wall = time.perf_counter() - t0
         if res.status == "preempted":
@@ -190,17 +224,33 @@ def main() -> None:
             lambda s0, c0: ro.rollout(
                 hl, ll.control, params, s0, c0, n_hl_steps=n_hl_steps,
                 hl_rel_freq=args.hl_rel_freq, dt=args.dt,
-                acc_des_fn=acc_des_fn,
+                acc_des_fn=acc_des_fn, telemetry=tcfg,
             )
         )
         print(f"compiling + running {args.controller}, n={args.n}, "
               f"{n_hl_steps} MPC steps ...")
         t0 = time.perf_counter()
-        final, _, logs = run(state0, cs0)
+        if tcfg is not None:
+            final, _, logs, tel = run(state0, cs0)
+        else:
+            final, _, logs = run(state0, cs0)
+            tel = None
         jax.block_until_ready(final.xl)
         dt_wall = time.perf_counter() - t0
         print(f"done in {dt_wall:.1f} s ({n_hl_steps / dt_wall:.1f} MPC "
               f"steps/s incl. compile)")
+        if args.metrics or tel is not None:
+            # On-demand export from rollout results (obs.export).
+            from tpu_aerial_transport.obs import export as export_mod
+
+            path = args.metrics or "artifacts/rollout.metrics.jsonl"
+            export_mod.rollout_metrics(
+                path, logs, tel, tcfg,
+                meta={"controller": args.controller, "n": args.n,
+                      "T": args.T},
+            )
+            print(f"metrics written to {path} "
+                  f"(render: python tools/run_health.py {path})")
 
     # Aggregate stats (reference _print_stats, rqp_example.py:62-80).
     iters = np.asarray(logs.iters)

@@ -217,3 +217,21 @@ def test_config_fingerprint_sensitivity():
     assert a == checkpoint.config_fingerprint(n=4, cfg="config-repr")
     assert a != checkpoint.config_fingerprint(n=5, cfg="config-repr")
     assert a != checkpoint.config_fingerprint(n=4, cfg="other")
+
+
+def test_config_fingerprint_sees_interior_of_big_arrays():
+    """Array leaves hash from their full bytes: numpy's repr elides
+    interiors past ~1000 elements with '...', which used to make two
+    different big-fleet params tables fingerprint identical — the resume
+    config_mismatch check would then silently accept a stale snapshot."""
+    a = np.zeros(2000, np.float32)
+    b = a.copy()
+    b[1000] = 1.0  # repr(a) == repr(b): both elide the changed interior.
+    assert repr(a) == repr(b)
+    assert checkpoint.config_fingerprint(params=a) \
+        != checkpoint.config_fingerprint(params=b)
+    assert checkpoint.config_fingerprint(params=a) \
+        == checkpoint.config_fingerprint(params=a.copy())
+    # dtype/shape changes flip it even when the bytes match.
+    assert checkpoint.config_fingerprint(params=np.zeros(4, np.float32)) \
+        != checkpoint.config_fingerprint(params=np.zeros(2, np.float64))

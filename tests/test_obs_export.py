@@ -1,0 +1,269 @@
+"""obs.export + tools/run_health.py: schema-versioned jsonl writer,
+validation (the ci_check gate), chunk-boundary emission from
+recovery.run_chunks with a telemetry-threaded carry, and the operator
+summary tables from a real chunked run."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.control import centralized, lowlevel
+from tpu_aerial_transport.harness import rollout as h_rollout
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.obs import export as export_mod
+from tpu_aerial_transport.obs import telemetry as tmod
+from tpu_aerial_transport.resilience import recovery
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUN_HEALTH = os.path.join(REPO, "tools", "run_health.py")
+
+
+def _chunked_run_bits(n=4):
+    params, col, state0 = setup.rqp_setup(n)
+    cfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=10
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    llc = lowlevel.make_lowlevel_controller("pd", params)
+
+    def hl(cs, s, a):
+        return centralized.control(params, cfg, f_eq, cs, s, a)
+
+    x0 = state0.xl
+
+    def acc_des_fn(state, t):
+        del t
+        dvl = -1.0 * state.vl - 1.0 * (state.xl - x0)
+        return (dvl, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
+
+    cs0 = centralized.init_ctrl_state(params, cfg)
+    return params, state0, cs0, hl, llc, acc_des_fn
+
+
+# --------------------------- writer + schema ---------------------------
+
+def test_writer_roundtrip_and_validation(tmp_path):
+    path = str(tmp_path / "run.metrics.jsonl")
+    w = export_mod.MetricsWriter(path, meta={"seed": 7})
+    w.emit("chunk", chunk=0, wall_s=0.5)
+    w.emit("done", chunks=1)
+    events = export_mod.read_events(path)
+    assert [e["event"] for e in events] == ["run_start", "chunk", "done"]
+    assert all(e["schema"] == export_mod.SCHEMA_VERSION for e in events)
+    assert export_mod.validate_file(path) == []
+
+
+def test_writer_rejects_unknown_event(tmp_path):
+    w = export_mod.MetricsWriter(str(tmp_path / "m.jsonl"))
+    with pytest.raises(ValueError, match="unknown metrics event"):
+        w.emit("mystery", foo=1)
+
+
+def test_validate_flags_schema_violations(tmp_path):
+    path = str(tmp_path / "bad.metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"schema": 99, "event": "chunk", "ts": 0}) + "\n")
+        fh.write('{"torn interior\n')
+        fh.write(json.dumps({
+            "schema": export_mod.SCHEMA_VERSION, "event": "chunk", "ts": 0,
+        }) + "\n")
+        fh.write('{"torn final tail')  # crash artifact: tolerated.
+    errs = export_mod.validate_file(path)
+    text = "\n".join(errs)
+    assert "schema 99" in text
+    assert "unparseable" in text
+    assert "missing fields" in text  # chunk without chunk/wall_s.
+    assert "torn final" not in text and "line 4" not in text
+
+
+def test_logs_summary_exact_digest():
+    params, state0, cs0, hl, llc, acc_des_fn = _chunked_run_bits()
+    _, _, logs = jax.jit(
+        lambda s, c: h_rollout.rollout(
+            hl, llc.control, params, s, c, 5, acc_des_fn=acc_des_fn
+        )
+    )(state0, cs0)
+    d = export_mod.logs_summary(logs)
+    assert d["steps"] == 5
+    assert sum(d["rung_hist"]) == 5
+    assert d["residual"]["count"] == 5
+    assert d["min_env_dist"] == pytest.approx(
+        float(np.min(np.asarray(logs.min_env_dist)))
+    )
+    assert d["quarantined_final"] == 0
+
+
+def test_rollout_metrics_on_demand(tmp_path):
+    params, state0, cs0, hl, llc, acc_des_fn = _chunked_run_bits()
+    tcfg = tmod.TelemetryConfig()
+    _, _, logs, tel = jax.jit(
+        lambda s, c: h_rollout.rollout(
+            hl, llc.control, params, s, c, 4, acc_des_fn=acc_des_fn,
+            telemetry=tcfg,
+        )
+    )(state0, cs0)
+    path = str(tmp_path / "rollout.metrics.jsonl")
+    rec = export_mod.rollout_metrics(path, logs, tel, tcfg, meta={"n": 4})
+    assert rec["logs"]["steps"] == 4
+    assert rec["telemetry"]["steps"] == 4
+    assert export_mod.validate_file(path) == []
+
+
+# ------------------- chunk-boundary emission + CLI ---------------------
+
+@pytest.fixture(scope="module")
+def chunked_metrics_run(tmp_path_factory):
+    """One real chunked run with telemetry + metrics export, shared by the
+    emission and CLI tests."""
+    tmp = tmp_path_factory.mktemp("obsrun")
+    params, state0, cs0, hl, llc, acc_des_fn = _chunked_run_bits()
+    tcfg = tmod.TelemetryConfig()
+    run = h_rollout.make_chunked_rollout(
+        hl, llc.control, params, n_hl_steps=6, n_chunks=3,
+        acc_des_fn=acc_des_fn, telemetry=tcfg,
+    )
+    plan = recovery.RunPlan(
+        run_dir=str(tmp / "run"), n_hl_steps=6, n_chunks=3, seed=0
+    )
+    metrics_path = str(tmp / "run.metrics.jsonl")
+    result = recovery.run_chunks(
+        plan, run.chunk_jit, run.init_carry(state0, cs0),
+        metrics=metrics_path,
+    )
+    return metrics_path, result
+
+
+def test_batched_carry_metrics_export(tmp_path):
+    """A VMAPPED chunk carry threading telemetry (the
+    scenario_rollout_resumable shape: every telemetry leaf grows a leading
+    lane axis) must export a cross-lane roll-up at each boundary instead
+    of crashing summary() on non-scalar leaves."""
+    params, state0, cs0, hl, llc, acc_des_fn = _chunked_run_bits()
+    tcfg = tmod.TelemetryConfig()
+    run = h_rollout.make_chunked_rollout(
+        hl, llc.control, params, n_hl_steps=4, n_chunks=2,
+        acc_des_fn=acc_des_fn, telemetry=tcfg,
+    )
+    batched_jit = jax.jit(jax.vmap(run.chunk_fn, in_axes=(0, None)))
+    n_lanes = 3
+    batch = jax.tree.map(
+        lambda x: jnp.tile(x[None], (n_lanes,) + (1,) * x.ndim),
+        run.init_carry(state0, cs0),
+    )
+    plan = recovery.RunPlan(
+        run_dir=str(tmp_path / "run"), n_hl_steps=4, n_chunks=2,
+        logs_time_axis=1,
+    )
+    path = str(tmp_path / "batched.metrics.jsonl")
+    res = recovery.run_chunks(plan, batched_jit, batch, metrics=path)
+    assert res.status == "done"
+    assert export_mod.validate_file(path) == []
+    chunks = [e for e in export_mod.read_events(path)
+              if e["event"] == "chunk"]
+    tel = chunks[-1]["telemetry"]
+    assert tel["lanes"] == n_lanes
+    assert tel["steps"] == 4
+    assert sum(tel["rung_hist"]) == 4 * n_lanes
+    assert tel["residual"]["count"] == 4 * n_lanes
+    assert tel["residual"]["p50"] is not None
+
+
+def test_run_chunks_emits_boundary_events(chunked_metrics_run):
+    metrics_path, result = chunked_metrics_run
+    assert result.status == "done"
+    assert export_mod.validate_file(metrics_path) == []
+    events = export_mod.read_events(metrics_path)
+    kinds = [e["event"] for e in events]
+    assert kinds == ["run_start", "chunk", "chunk", "chunk", "done"]
+    chunks = [e for e in events if e["event"] == "chunk"]
+    for i, e in enumerate(chunks):
+        assert e["chunk"] == i
+        assert e["wall_s"] > 0
+        assert e["logs"]["steps"] == 2  # chunk_len.
+    # Telemetry is cumulative across boundaries: 2 -> 4 -> 6 steps.
+    assert [e["telemetry"]["steps"] for e in chunks] == [2, 4, 6]
+    assert chunks[-1]["telemetry"]["residual"]["count"] == 6
+
+
+def test_run_health_renders_summary(chunked_metrics_run):
+    metrics_path, _ = chunked_metrics_run
+    proc = subprocess.run(
+        [sys.executable, RUN_HEALTH, metrics_path],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    assert "fallback-rung distribution" in out
+    assert "consensus residual" in out
+    assert "safety margins" in out
+    assert "chunk wall-times" in out
+    assert "chunks: 3" in out
+
+
+def test_run_health_renders_nondefault_quantiles(tmp_path):
+    """The residual table's percentile columns come from the event keys,
+    so a run recorded with non-default quantiles shows its actual
+    percentiles instead of empty p50/p90/p99 columns."""
+    params, state0, cs0, hl, llc, acc_des_fn = _chunked_run_bits()
+    tcfg = tmod.TelemetryConfig(quantiles=(0.25, 0.75))
+    _, _, logs, tel = jax.jit(
+        lambda s, c: h_rollout.rollout(
+            hl, llc.control, params, s, c, 6, acc_des_fn=acc_des_fn,
+            telemetry=tcfg,
+        )
+    )(state0, cs0)
+    path = str(tmp_path / "q.metrics.jsonl")
+    export_mod.rollout_metrics(path, logs, tel, tcfg)
+    proc = subprocess.run(
+        [sys.executable, RUN_HEALTH, path],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    header = next(l for l in proc.stdout.splitlines()
+                  if l.startswith("| count"))
+    assert "p25" in header and "p75" in header and "p50" not in header
+    row = proc.stdout.splitlines()[
+        proc.stdout.splitlines().index(header) + 2
+    ]
+    assert "—" not in row.split("|")[2]  # p25 cell holds a number.
+
+
+def test_run_health_json_mode(chunked_metrics_run):
+    metrics_path, _ = chunked_metrics_run
+    proc = subprocess.run(
+        [sys.executable, RUN_HEALTH, metrics_path, "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["telemetry"]["steps"] == 6
+    assert payload["chunks"]["count"] == 3
+
+
+def test_run_health_validate_gate(chunked_metrics_run, tmp_path):
+    metrics_path, _ = chunked_metrics_run
+    ok = subprocess.run(
+        [sys.executable, RUN_HEALTH, "--validate", metrics_path],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad_path = str(tmp_path / "bad.metrics.jsonl")
+    with open(bad_path, "w") as fh:
+        fh.write(json.dumps({"schema": 0, "event": "nope", "ts": 0}) + "\n")
+        fh.write("x\n")  # make the torn line non-final.
+        fh.write(json.dumps({
+            "schema": export_mod.SCHEMA_VERSION, "event": "done",
+            "chunks": 1, "ts": 0,
+        }) + "\n")
+    bad = subprocess.run(
+        [sys.executable, RUN_HEALTH, "--validate", bad_path],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert bad.returncode == 1
+    assert "schema violation" in bad.stderr

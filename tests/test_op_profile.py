@@ -1,0 +1,212 @@
+"""tools/op_profile.py: plane/line selection and op aggregation over a
+synthesized xplane proto (the checked-in-fixture substitute — the proto is
+built in-test so it tracks the installed schema), the --by-phase rollup's
+three attribution sources (per-event tf_op stats, HLO op_name metadata,
+consumer-chain inheritance for compiler-split ops), and an end-to-end
+capture of a real scoped program asserting the >= 80% attribution bar."""
+
+import importlib.util
+import os
+import re
+import sys
+
+import pytest
+
+xplane_pb2 = pytest.importorskip(
+    "tensorflow.tsl.profiler.protobuf.xplane_pb2"
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_op_profile():
+    spec = importlib.util.spec_from_file_location(
+        "op_profile", os.path.join(REPO, "tools", "op_profile.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+op_profile = _load_op_profile()
+
+
+def _plane(xs, name):
+    plane = xs.planes.add()
+    plane.name = name
+    return plane
+
+
+def _line(plane, name):
+    line = plane.lines.add()
+    line.name = name
+    return line
+
+
+def _event(plane, line, op_name, dur_us, tf_op=None):
+    meta_id = len(plane.event_metadata) + 1
+    plane.event_metadata[meta_id].name = op_name
+    ev = line.events.add()
+    ev.metadata_id = meta_id
+    ev.duration_ps = int(dur_us * 1e6)
+    if tf_op is not None:
+        stat_id = len(plane.stat_metadata) + 1
+        plane.stat_metadata[stat_id].name = "tf_op"
+        stat = ev.stats.add()
+        stat.metadata_id = stat_id
+        stat.str_value = tf_op
+    return ev
+
+
+def _synth_space():
+    """A TPU-shaped capture: one device plane with an 'XLA Ops' line
+    (events carry tf_op scope stats), an 'XLA Modules' line whose single
+    whole-executable event must NOT be double-counted against the ops, a
+    framework line that must be ignored, and a metadata plane that must
+    be skipped entirely."""
+    xs = xplane_pb2.XSpace()
+    dev = _plane(xs, "/device:TPU:0")
+    modules = _line(dev, "XLA Modules")
+    _event(dev, modules, "jit_step(1)", 1400.0)  # spans all op events.
+    ops = _line(dev, "XLA Ops")
+    _event(dev, ops, "fusion.1", 600.0,
+           tf_op="jit(step)/tat.local_solve/dot_general")
+    _event(dev, ops, "fusion.1", 400.0,
+           tf_op="jit(step)/tat.local_solve/dot_general")
+    _event(dev, ops, "fusion.7", 300.0,
+           tf_op="jit(step)/tat.consensus/reduce_sum")
+    _event(dev, ops, "copy.3", 100.0)  # no scope: unattributed.
+    host_frames = _line(dev, "python")
+    _event(dev, host_frames, "should_not_count", 1e6)
+    meta = _plane(xs, "/host:metadata")
+    _event(meta, _line(meta, "whatever"), "also_not_counted", 1e6)
+    return xs
+
+
+def test_plane_and_line_selection_and_aggregation():
+    agg = op_profile.op_aggregate([_synth_space()])
+    assert "should_not_count" not in agg
+    assert "also_not_counted" not in agg
+    # The module-level event spans the whole executable — counting it
+    # would double op_total and tank the attribution fraction.
+    assert "jit_step(1)" not in agg
+    assert agg["fusion.1"]["count"] == 2
+    assert agg["fusion.1"]["total_us"] == pytest.approx(1000.0)
+    assert agg["fusion.1"]["scope"].endswith("dot_general")
+    # Back-compat per-op table shim.
+    times = op_profile.device_op_times([_synth_space()])
+    assert times["fusion.7"] == {"total_us": pytest.approx(300.0),
+                                 "count": 1}
+
+
+def test_phase_rollup_from_tf_op_stats():
+    rows, op_total, attributed = op_profile.rollup_phases(
+        op_profile.op_aggregate([_synth_space()]), hlo_map=None
+    )
+    assert op_total == pytest.approx(1400.0)
+    assert attributed == pytest.approx(1300.0)
+    assert rows["local_solve"]["total_us"] == pytest.approx(1000.0)
+    assert rows["consensus"]["total_us"] == pytest.approx(300.0)
+    assert rows["(unattributed)"]["total_us"] == pytest.approx(100.0)
+
+
+def test_phase_rollup_from_hlo_map_cpu_shape():
+    """CPU-shaped capture: thunk lines named tf_XLAEigen/..., no per-event
+    stats — attribution resolves through the HLO op_name map, including
+    the .clone/renumber fallback and consumer-chain inheritance for a
+    metadata-less compiler-split op."""
+    xs = xplane_pb2.XSpace()
+    host = _plane(xs, "/host:CPU")
+    thunks = _line(host, "tf_XLAEigen/-123")
+    _event(host, thunks, "dot.5", 500.0)          # exact HLO name.
+    _event(host, thunks, "sine.4.clone", 200.0)   # renumbered clone.
+    _event(host, thunks, "reduce-window", 300.0)  # no metadata: consumer.
+    _event(host, thunks, "while.36", 50.0)        # genuinely unattributed.
+    client = _line(host, "tf_XLATfrtCpuClient/9")
+    _event(host, client, "TfrtCpuExecutable::Execute", 5000.0)
+
+    hlo = """
+  %sine.0.clone = f32[8]{0} sine(f32[8]{0} %p), metadata={op_name="jit(f)/tat.local_solve/sin"}
+  %dot.5 = f32[8]{0} dot(f32[8]{0} %sine.0.clone, f32[8]{0} %q), metadata={op_name="jit(f)/tat.local_solve/dot_general"}
+  %reduce-window = f32[2]{0} reduce-window(f32[8]{0} %dot.5, f32[] %c)
+  %reduce.0 = f32[]{} reduce(f32[2]{0} %reduce-window, f32[] %c), metadata={op_name="jit(f)/tat.consensus/reduce_sum"}
+"""
+    hlo_path = None
+    import tempfile
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".hlo.txt", delete=False
+    ) as fh:
+        fh.write(hlo)
+        hlo_path = fh.name
+    try:
+        hlo_map = op_profile.load_hlo_map(hlo_path)
+    finally:
+        os.unlink(hlo_path)
+    # Consumer inheritance: the split reduce-window inherits reduce.0's
+    # consensus scope.
+    assert op_profile.phase_of(hlo_map["reduce-window"]) == "consensus"
+
+    agg = op_profile.op_aggregate([xs])
+    rows, op_total, attributed = op_profile.rollup_phases(agg, hlo_map)
+    # The client-line framework event never enters the aggregation; the
+    # '::' guard is belt-and-suspenders for broad-filter fallbacks.
+    assert "TfrtCpuExecutable::Execute" not in agg
+    assert op_total == pytest.approx(1050.0)
+    assert rows["local_solve"]["total_us"] == pytest.approx(700.0)
+    assert rows["consensus"]["total_us"] == pytest.approx(300.0)
+    assert rows["(unattributed)"]["total_us"] == pytest.approx(50.0)
+    assert attributed / op_total >= 0.8
+
+
+def test_phase_of_uses_innermost_scope():
+    assert op_profile.phase_of(
+        "jit(f)/tat.sharded_step/while/tat.local_solve/dot"
+    ) == "local_solve"
+    assert op_profile.phase_of("jit(f)/while/dot") is None
+    assert op_profile.phase_of(None) is None
+
+
+def test_real_trace_attribution_meets_bar(tmp_path):
+    """End-to-end on a real capture of a scoped scan program (the shape of
+    the rollout hot loop): >= 80% of XLA op self-time attributes to tat.*
+    phases — the ISSUE 5 acceptance bar, runnable on CPU."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tpu_aerial_transport.obs import phases
+
+    @jax.jit
+    def step(x):
+        def body(c, _):
+            with phases.scope(phases.LOCAL_SOLVE):
+                c = jnp.tanh(c @ c)
+            with phases.scope(phases.CONSENSUS):
+                c = c - jnp.mean(c, axis=0, keepdims=True)
+            return c, None
+
+        return lax.scan(body, x, None, length=24)[0]
+
+    # Compute-dominant sizing (the real control step's shape: the scoped
+    # solve/consensus ops dwarf loop bookkeeping); on a toy-sized carry
+    # the pre-loop input copies and while-thunk overhead — genuinely
+    # phase-less — would swamp the ratio.
+    x = jnp.eye(256) * 0.5
+    step(x).block_until_ready()
+    trace_dir = str(tmp_path / "trace")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(3):
+            step(x).block_until_ready()
+    with open(os.path.join(trace_dir, "headline.hlo.txt"), "w") as fh:
+        fh.write(jax.jit(step).lower(x).compile().as_text())
+
+    agg = op_profile.op_aggregate(op_profile.load_xplanes(trace_dir))
+    assert agg, "no op events captured"
+    hlo_map = op_profile.load_hlo_map(
+        op_profile.find_hlo_dump(trace_dir)
+    )
+    rows, op_total, attributed = op_profile.rollup_phases(agg, hlo_map)
+    assert op_total > 0
+    frac = attributed / op_total
+    assert frac >= 0.8, (frac, rows)
+    assert "local_solve" in rows and "consensus" in rows

@@ -1,0 +1,277 @@
+"""In-jit telemetry (obs.telemetry): the zero-cost-when-disabled HLO
+identity (the acceptance bar, mirroring resilience's no_faults contract),
+P² percentile accuracy against np.percentile, accumulator correctness
+against the exact per-step logs, chunked-vs-unchunked identity, and the
+per-agent solve-health path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from tpu_aerial_transport.control import cadmm, centralized, lowlevel
+from tpu_aerial_transport.harness import rollout as h_rollout
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.obs import telemetry as tmod
+from tpu_aerial_transport.resilience import faults as faults_mod
+from tpu_aerial_transport.resilience import rollout as r_rollout
+
+
+def _centralized_bits(n=4):
+    params, col, state0 = setup.rqp_setup(n)
+    cfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=10
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    llc = lowlevel.make_lowlevel_controller("pd", params)
+
+    def hl(cs, s, a):
+        return centralized.control(params, cfg, f_eq, cs, s, a)
+
+    return params, state0, centralized.init_ctrl_state(params, cfg), hl, llc
+
+
+def _cadmm_bits(n=4, **cfg_kw):
+    params, col, state0 = setup.rqp_setup(n)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=4, inner_iters=10, **cfg_kw,
+    )
+    llc = lowlevel.make_lowlevel_controller("pd", params)
+    hl = r_rollout.make_cadmm_hl_step(params, cfg)
+    return params, state0, cadmm.init_cadmm_state(params, cfg), hl, llc
+
+
+def test_disabled_telemetry_compiles_to_identical_hlo():
+    """telemetry=None and telemetry=no_telemetry() lower to the SAME HLO
+    (``active`` is static, every telemetry branch is Python-level) — the
+    same zero-cost contract as resilience.no_faults()."""
+    params, state0, cs0, hl, llc = _centralized_bits()
+
+    def run(tel):
+        return jax.jit(
+            lambda s, c: h_rollout.rollout(
+                hl, llc.control, params, s, c, 3, telemetry=tel
+            )
+        ).lower(state0, cs0).as_text()
+
+    assert run(None) == run(tmod.no_telemetry())
+
+
+def test_disabled_telemetry_identical_hlo_resilient():
+    params, state0, cs0, hl, llc = _cadmm_bits()
+    sched = faults_mod.make_schedule(4, t_fail={1: 1}, drop_rate=0.3)
+
+    def run(tel):
+        return jax.jit(
+            lambda s, c: r_rollout.resilient_rollout(
+                hl, llc.control, params, s, c, 3, faults=sched,
+                telemetry=tel,
+            )
+        ).lower(state0, cs0).as_text()
+
+    assert run(None) == run(tmod.no_telemetry())
+
+
+def test_p2_percentiles_track_np_percentile():
+    """The vectorized P² estimator tracks exact percentiles of a skewed
+    stream to a few percent after a few thousand observations."""
+    tcfg = tmod.TelemetryConfig()
+    tel0 = tmod.init_telemetry(tcfg)
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(
+        rng.lognormal(mean=-3.0, sigma=1.0, size=4000), jnp.float32
+    )
+
+    def step(tel, x):
+        q, n = tmod._p2_update(tcfg, tel.p2_q, tel.p2_n, tel.res_count, x)
+        return tel.replace(p2_q=q, p2_n=n, res_count=tel.res_count + 1), None
+
+    tel, _ = jax.jit(lambda t, v: lax.scan(step, t, v))(tel0, xs)
+    est = tmod.residual_percentiles(tel, tcfg.quantiles)
+    for p in tcfg.quantiles:
+        key = "p%g" % (p * 100)
+        ref = float(np.percentile(np.asarray(xs), p * 100))
+        assert abs(est[key] - ref) / ref < 0.08, (key, est[key], ref)
+
+
+def test_p2_small_sample_is_exact():
+    """Below 5 observations the bootstrap markers ARE the sample — the
+    host-side percentile falls back to the exact small-sample estimate."""
+    tcfg = tmod.TelemetryConfig(quantiles=(0.5,))
+    tel = tmod.init_telemetry(tcfg)
+    for x in (3.0, 1.0, 2.0):
+        q, n = tmod._p2_update(
+            tcfg, tel.p2_q, tel.p2_n, tel.res_count, jnp.float32(x)
+        )
+        tel = tel.replace(p2_q=q, p2_n=n, res_count=tel.res_count + 1)
+    assert tmod.residual_percentiles(tel, (0.5,))["p50"] == pytest.approx(2.0)
+
+
+def test_rollout_telemetry_matches_logs():
+    """The on-device accumulator agrees with exact reductions over the
+    per-step logs for every metric both can see."""
+    params, state0, cs0, hl, llc = _centralized_bits()
+    tcfg = tmod.TelemetryConfig()
+    state, cs, logs, tel = jax.jit(
+        lambda s, c: h_rollout.rollout(
+            hl, llc.control, params, s, c, 8, telemetry=tcfg
+        )
+    )(state0, cs0)
+    assert int(tel.steps) == 8
+    assert int(tel.res_count) == 8
+    np.testing.assert_array_equal(
+        np.asarray(tel.rung_hist),
+        np.bincount(np.asarray(logs.fallback_rung), minlength=4),
+    )
+    assert float(tel.min_env_dist) == pytest.approx(
+        float(np.min(np.asarray(logs.min_env_dist)))
+    )
+    assert float(tel.res_max) == pytest.approx(
+        float(np.max(np.asarray(logs.solve_res))), rel=1e-6
+    )
+    assert float(tel.res_sum) == pytest.approx(
+        float(np.sum(np.asarray(logs.solve_res), dtype=np.float64)),
+        rel=1e-5,
+    )
+    s = tmod.summary(tel, tcfg)
+    assert s["steps"] == 8 and s["residual"]["count"] == 8
+
+
+def test_resilient_telemetry_counts_rungs_and_quarantine():
+    """Under an agent kill + dropout the rung histogram matches the logged
+    ladder rungs and the quarantine counter matches the sticky flag."""
+    params, state0, cs0, hl, llc = _cadmm_bits()
+    sched = faults_mod.make_schedule(4, t_fail={1: 2}, drop_rate=0.4)
+    tcfg = tmod.TelemetryConfig()
+    state, cs, logs, tel = jax.jit(
+        lambda s, c: r_rollout.resilient_rollout(
+            hl, llc.control, params, s, c, 6, faults=sched, telemetry=tcfg
+        )
+    )(state0, cs0)
+    np.testing.assert_array_equal(
+        np.asarray(tel.rung_hist),
+        np.bincount(np.asarray(logs.fallback_rung), minlength=4),
+    )
+    assert int(tel.quarantine_steps) == int(
+        np.sum(np.asarray(logs.quarantined))
+    )
+    assert int(tel.steps) == 6
+
+
+def test_chunked_telemetry_matches_unchunked():
+    """The accumulator through C chunks (ONE compiled chunk, carry
+    threaded) equals the fused-scan accumulator bitwise."""
+    params, state0, cs0, hl, llc = _centralized_bits()
+    x0 = state0.xl
+
+    def acc_des_fn(state, t):
+        del t
+        dvl = -1.0 * state.vl - 1.0 * (state.xl - x0)
+        return (dvl, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
+
+    tcfg = tmod.TelemetryConfig()
+    _, _, _, tel_fused = jax.jit(
+        lambda s, c: h_rollout.rollout(
+            hl, llc.control, params, s, c, 6, acc_des_fn=acc_des_fn,
+            telemetry=tcfg,
+        )
+    )(state0, cs0)
+
+    run = h_rollout.make_chunked_rollout(
+        hl, llc.control, params, n_hl_steps=6, n_chunks=3,
+        acc_des_fn=acc_des_fn, telemetry=tcfg,
+    )
+    seen = {}
+    run(state0, cs0,
+        on_boundary=lambda c, carry, logs: seen.update(carry=carry))
+    tel_chunked = tmod.find_state(seen["carry"])
+    assert tel_chunked is not None
+    for a, b in zip(jax.tree.leaves(tel_fused), jax.tree.leaves(tel_chunked)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_track_agent_stats_surfaces_per_agent_residuals():
+    n = 4
+    params, state0, cs0, hl, llc = _cadmm_bits(track_agent_stats=True)
+    tcfg = tmod.TelemetryConfig(track_agents=True, solver_tol=5e-3)
+    state, cs, logs, tel = jax.jit(
+        lambda s, c: r_rollout.resilient_rollout(
+            hl, llc.control, params, s, c, 4, telemetry=tcfg
+        )
+    )(state0, cs0)
+    assert tel.agent_fail_steps.shape == (n,)
+    assert tel.agent_res_max.shape == (n,)
+    # Warm-started steady-state solves meet tolerance: no agent should be
+    # failing every step, and the per-agent max residual is finite.
+    assert np.all(np.asarray(tel.agent_fail_steps) <= 4)
+    assert np.all(np.isfinite(np.asarray(tel.agent_res_max)))
+    s = tmod.summary(tel, tcfg)
+    assert len(s["agent_fail_steps"]) == n
+
+
+def test_track_agents_mismatch_raises():
+    """telemetry.track_agents without the controller's track_agent_stats
+    is a configuration error, caught at trace time — not a silent zero."""
+    params, state0, cs0, hl, llc = _cadmm_bits()  # no track_agent_stats.
+    tcfg = tmod.TelemetryConfig(track_agents=True)
+    with pytest.raises(ValueError, match="track_agent_stats"):
+        jax.eval_shape(
+            lambda s, c: r_rollout.resilient_rollout(
+                hl, llc.control, params, s, c, 2, telemetry=tcfg
+            ),
+            state0, cs0,
+        )
+
+
+def test_nondefault_quantiles_label_from_state():
+    """The quantile labels ride the STATE (static field), so a reader
+    holding only a snapshot — recovery.run_chunks' boundary export calls
+    summary() with no config — labels non-default configs correctly
+    instead of crashing on the (Q,5) marker shape."""
+    tcfg = tmod.TelemetryConfig(quantiles=(0.25, 0.75))
+    tel = tmod.init_telemetry(tcfg)
+    from tpu_aerial_transport.control.types import SolverStats
+
+    for i in range(8):
+        tel = tmod.update(tcfg, tel, SolverStats(
+            iters=jnp.asarray(1, jnp.int32),
+            solve_res=jnp.asarray(float(i + 1), jnp.float32),
+            collision=jnp.zeros((), bool),
+            min_env_dist=jnp.asarray(1.0, jnp.float32),
+        ))
+    s = tmod.summary(tel)  # no config — the run_chunks reader's view.
+    assert set(s["residual"]) >= {"p25", "p75"}
+    assert "p50" not in s["residual"]
+    assert s["residual"]["p25"] <= s["residual"]["p75"]
+    # A host/numpy snapshot copy keeps the labels (treedef, not leaves).
+    host = jax.tree.map(lambda x: np.array(x), tel)
+    assert tmod.summary(host)["residual"]["p75"] == s["residual"]["p75"]
+
+
+def test_update_ignores_nonfinite_residuals():
+    """A poisoned step's inf/nan residual must not wedge the P² markers or
+    the min/max; the rung histogram still counts the step."""
+    tcfg = tmod.TelemetryConfig()
+    tel = tmod.init_telemetry(tcfg)
+    from tpu_aerial_transport.control.types import SolverStats
+
+    def stats(res):
+        return SolverStats(
+            iters=jnp.asarray(3, jnp.int32),
+            solve_res=jnp.asarray(res, jnp.float32),
+            collision=jnp.zeros((), bool),
+            min_env_dist=jnp.asarray(2.0, jnp.float32),
+        )
+
+    tel = tmod.update(tcfg, tel, stats(0.5))
+    tel = tmod.update(tcfg, tel, stats(jnp.nan))
+    tel = tmod.update(tcfg, tel, stats(jnp.inf))
+    tel = tmod.update(tcfg, tel, stats(0.25))
+    assert int(tel.steps) == 4
+    assert int(tel.res_count) == 2
+    assert float(tel.res_max) == pytest.approx(0.5)
+    assert float(tel.res_min) == pytest.approx(0.25)
+    assert np.all(np.isfinite(np.asarray(tel.p2_q)[:, :2]))
+    assert int(tel.iters_sum) == 12

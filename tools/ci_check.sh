@@ -24,6 +24,16 @@ fail=0
 echo "== jaxlint (Tier A) =="
 python tools/jaxlint.py "${PATHS[@]}" || fail=1
 
+echo "== metrics jsonl schema (obs.export) =="
+shopt -s nullglob
+metrics_files=(artifacts/*.metrics.jsonl)
+shopt -u nullglob
+if [ ${#metrics_files[@]} -gt 0 ]; then
+    python tools/run_health.py --validate "${metrics_files[@]}" || fail=1
+else
+    echo "no artifacts/*.metrics.jsonl — skipped"
+fi
+
 echo "== black --check =="
 if python -c "import black" 2>/dev/null; then
     python -m black --check --quiet "${PATHS[@]}" || fail=1

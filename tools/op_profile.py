@@ -1,4 +1,4 @@
-"""Op-level attribution table from a ``jax.profiler.trace`` capture.
+"""Op-level and phase-level attribution from a ``jax.profiler.trace`` capture.
 
 Parses the raw ``*.xplane.pb`` written by ``bench.py --profile DIR`` (the
 SURVEY.md §5.1 tracing tier) without TensorBoard: aggregates XLA op event
@@ -7,9 +7,29 @@ the top-k ops by total self time. The tensorboard profile plugin's converter
 is broken against this image's TF build, so this reads the xplane proto
 directly (``tensorflow.tsl.profiler.protobuf.xplane_pb2``).
 
+``--by-phase`` rolls op self-time up to the ``jax.named_scope``
+annotations over the algorithm phases (``tat.<phase>``, the
+``tpu_aerial_transport.obs.phases`` vocabulary) — "what fraction of a
+control step is consensus vs. solve" instead of fusion names. Two
+attribution sources, in precedence order:
+
+1. a ``tf_op``/``op_name`` stat on the trace event itself (TPU device
+   planes record the framework op path per op event);
+2. the compiled HLO text dumped next to the trace (``bench.py --profile``
+   writes ``<dir>/headline.hlo.txt``): each instruction's
+   ``metadata={op_name="..."}`` carries the scope path; trace event names
+   are HLO instruction names (modulo ``.clone``/renumber suffixes), so op
+   events resolve through the instruction table.
+
+An op's phase is the INNERMOST ``tat.*`` segment of its scope path.
+C++ framework events (names containing ``::``) are excluded from the op
+self-time base; real XLA ops that resolve to no phase (loop bookkeeping,
+copies) report as ``(unattributed)``.
+
 Usage:
   python bench.py --profile /tmp/trace
-  python tools/op_profile.py /tmp/trace --top 30 [--json artifacts/op_profile.json]
+  python tools/op_profile.py /tmp/trace --top 30 [--json out.json]
+  python tools/op_profile.py /tmp/trace --by-phase [--hlo trace/headline.hlo.txt]
 """
 
 from __future__ import annotations
@@ -18,7 +38,17 @@ import argparse
 import glob
 import json
 import os
+import re
 from collections import defaultdict
+
+PHASE_RE = re.compile(r"tat\.([A-Za-z0-9_]+)")
+# HLO text: `%name = type op(...), ..., metadata={... op_name="..." ...}`.
+_HLO_INSTR_RE = re.compile(
+    r"%([\w.\-]+)\s*=\s*.*?op_name=\"([^\"]+)\""
+)
+# Event-stat keys carrying a framework op path.
+_SCOPE_STAT_KEYS = ("tf_op", "op_name")
+_SUFFIX_RE = re.compile(r"((\.\d+)|(\.clone)|(\.remat\d*))+$")
 
 
 def load_xplanes(trace_dir: str):
@@ -38,35 +68,216 @@ def load_xplanes(trace_dir: str):
     return spaces
 
 
-def device_op_times(spaces) -> dict[str, dict]:
-    """name -> {total_us, count} aggregated over device-plane XLA op events.
+def _event_scope(plane, ev) -> str | None:
+    """Framework op path recorded ON the event (TPU 'XLA Ops' lines carry a
+    tf_op stat; CPU captures usually do not)."""
+    for stat in ev.stats:
+        meta = plane.stat_metadata.get(stat.metadata_id)
+        if meta is None or meta.name not in _SCOPE_STAT_KEYS:
+            continue
+        if stat.str_value:
+            return stat.str_value
+        ref = plane.stat_metadata.get(stat.ref_value)
+        if ref is not None and ref.name:
+            return ref.name
+    return None
 
-    Device planes are named like '/device:TPU:0'; each line's events carry
-    duration_ps and an event-metadata name (the XLA op / fusion name)."""
-    agg = defaultdict(lambda: {"total_us": 0.0, "count": 0})
+
+def op_aggregate(spaces) -> dict[str, dict]:
+    """name -> {total_us, count, scope} aggregated over compute-plane XLA
+    op events. Device planes are named like '/device:TPU:0'; host-only
+    captures put the XLA thunk lines on '/host:CPU'."""
+    agg: dict[str, dict] = defaultdict(
+        lambda: {"total_us": 0.0, "count": 0, "scope": None}
+    )
     for xs in spaces:
         for plane in xs.planes:
-            # Compute planes: '/device:TPU:0' on accelerator captures,
-            # '/host:CPU' on host-only captures (metadata/task planes skipped).
             is_compute = ("device:" in plane.name or "TPU" in plane.name
                           or plane.name == "/host:CPU")
             if not is_compute:
                 continue
             meta = plane.event_metadata
             # Prefer XLA-op lines (non-overlapping op events): 'XLA Ops' on
-            # TPU device planes, 'xla-cpu-codegen' on host captures. The
-            # 'python' line holds nested host frames that would double-count.
+            # TPU device planes, 'XLAEigen'/'xla-cpu' thunk lines on host
+            # captures. The 'python' line holds nested host frames, the
+            # TfrtCpuClient line holds whole-execution framework events
+            # (PjitFunction, Execute), and TPU planes also carry an
+            # 'XLA Modules' line whose single event SPANS the whole
+            # executable — any of these would double-count op time.
             lines = [l for l in plane.lines
-                     if "XLA Ops" in l.name or "xla" in l.name.lower()]
+                     if "XLA Ops" in l.name or "XLAEigen" in l.name
+                     or (l.name.lower().startswith("xla")
+                         and "module" not in l.name.lower())]
+            if not lines:
+                lines = [l for l in plane.lines
+                         if "xla" in l.name.lower()
+                         and "module" not in l.name.lower()]
             if not lines:
                 lines = [l for l in plane.lines if l.name != "python"]
             for line in lines:
                 for ev in line.events:
-                    name = meta[ev.metadata_id].name if ev.metadata_id in meta \
-                        else f"id{ev.metadata_id}"
-                    agg[name]["total_us"] += ev.duration_ps / 1e6
-                    agg[name]["count"] += 1
+                    name = meta[ev.metadata_id].name if ev.metadata_id \
+                        in meta else f"id{ev.metadata_id}"
+                    a = agg[name]
+                    a["total_us"] += ev.duration_ps / 1e6
+                    a["count"] += 1
+                    if a["scope"] is None:
+                        a["scope"] = _event_scope(plane, ev)
     return dict(agg)
+
+
+def device_op_times(spaces) -> dict[str, dict]:
+    """Back-compat shim for the original per-op table: name ->
+    {total_us, count}."""
+    return {
+        k: {"total_us": v["total_us"], "count": v["count"]}
+        for k, v in op_aggregate(spaces).items()
+    }
+
+
+_HLO_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=")
+_HLO_REF_RE = re.compile(r"%([\w.\-]+)")
+
+
+def load_hlo_map(path: str) -> dict[str, str]:
+    """instruction name -> op_name metadata, over every instruction (fused
+    computations included — a fusion event resolves through either its own
+    metadata or its fused instructions' shared phase).
+
+    Compiler-synthesized instructions carry NO metadata (e.g. the
+    partial-reduction ``reduce-window`` XLA:CPU splits out of a scoped
+    ``reduce``); they inherit the op_name of their first CONSUMER that has
+    one — the split piece feeds the instruction it was split from, so the
+    consumer's scope is the original op's scope."""
+    defs: list[tuple[str, str | None]] = []  # (name, op_name|None).
+    consumer_of: dict[str, str] = {}  # operand name -> first consumer name.
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            d = _HLO_DEF_RE.match(line)
+            if not d:
+                continue
+            name = d.group(1)
+            m = _HLO_INSTR_RE.search(line)
+            defs.append((name, m.group(2) if m else None))
+            for ref in _HLO_REF_RE.findall(line)[1:]:
+                consumer_of.setdefault(ref, name)
+    out = {name: opname for name, opname in defs if opname is not None}
+    # Consumer-chain inheritance for metadata-less instructions (depth-
+    # limited: split chains are short).
+    for name, opname in defs:
+        if opname is not None:
+            continue
+        cur, seen = name, set()
+        for _ in range(4):
+            cur = consumer_of.get(cur)
+            if cur is None or cur in seen:
+                break
+            seen.add(cur)
+            if cur in out:
+                out[name] = out[cur]
+                break
+    return out
+
+
+def find_hlo_dump(trace_dir: str) -> str | None:
+    """The HLO text ``bench.py --profile`` drops next to the trace."""
+    hits = sorted(glob.glob(os.path.join(trace_dir, "**", "*.hlo.txt"),
+                            recursive=True))
+    return hits[0] if hits else None
+
+
+def _base_name(name: str) -> str:
+    return _SUFFIX_RE.sub("", name)
+
+
+def phase_of(scope_path: str | None) -> str | None:
+    """Innermost ``tat.*`` segment of a scope path (nested scopes: the
+    finest-grained annotation wins)."""
+    if not scope_path:
+        return None
+    hits = PHASE_RE.findall(scope_path)
+    return hits[-1] if hits else None
+
+
+def rollup_phases(agg: dict[str, dict], hlo_map: dict[str, str] | None):
+    """Roll op self-time up to phases.
+
+    Returns ``(rows, op_total_us, attributed_us)`` where ``rows`` maps
+    phase -> {total_us, count, ops (example op names)}. C++ framework
+    events (``::`` in the name) are excluded from the op-time base;
+    everything else counts, attributed or not.
+    """
+    hlo_map = hlo_map or {}
+    # Base-name index: unique-phase fallback for renumbered clones
+    # ('sine.4.clone' event vs '%sine.0.clone' instruction).
+    base_phases: dict[str, set] = defaultdict(set)
+    for iname, opname in hlo_map.items():
+        base_phases[_base_name(iname)].add(phase_of(opname))
+
+    rows: dict[str, dict] = defaultdict(
+        lambda: {"total_us": 0.0, "count": 0, "ops": []}
+    )
+    op_total = 0.0
+    attributed = 0.0
+    for name, a in agg.items():
+        if "::" in name or name.startswith(
+            ("ThreadpoolListener", "ThunkExecutor", "TfrtCpu",
+             "PjitFunction", "ParseArguments")
+        ):
+            continue  # C++ framework helper, not an XLA op.
+        op_total += a["total_us"]
+        scope = a["scope"]
+        if scope is None:
+            scope = hlo_map.get(name) or hlo_map.get(_base_name(name))
+        phase = phase_of(scope)
+        if phase is None and hlo_map:
+            cands = base_phases.get(_base_name(name), set()) - {None}
+            if len(cands) == 1:
+                phase = next(iter(cands))
+        key = phase if phase is not None else "(unattributed)"
+        row = rows[key]
+        row["total_us"] += a["total_us"]
+        row["count"] += a["count"]
+        if len(row["ops"]) < 4:
+            row["ops"].append(name)
+        if phase is not None:
+            attributed += a["total_us"]
+    return dict(rows), op_total, attributed
+
+
+def print_phase_table(rows, op_total, attributed) -> list[dict]:
+    print(f"# phase-level device self-time "
+          f"({op_total / 1e3:.2f} ms of XLA ops; "
+          f"{100.0 * attributed / op_total if op_total else 0.0:.1f}% "
+          "attributed to tat.* phases)")
+    print("| phase | total ms | % of op time | example ops |")
+    print("|---|---|---|---|")
+    table = []
+    for phase, r in sorted(rows.items(), key=lambda kv: -kv[1]["total_us"]):
+        pct = 100.0 * r["total_us"] / op_total if op_total else 0.0
+        ops = ", ".join(f"`{o}`" for o in r["ops"][:3])
+        print(f"| {phase} | {r['total_us'] / 1e3:.3f} | {pct:.1f} | {ops} |")
+        table.append({"phase": phase, "total_ms": r["total_us"] / 1e3,
+                      "pct_op_time": pct, "calls": r["count"]})
+    return table
+
+
+def print_op_table(agg, top: int) -> list[dict]:
+    total = sum(v["total_us"] for v in agg.values())
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total_us"])[:top]
+    print(f"# device op self-time, top {top} of {len(agg)} ops "
+          f"({total / 1e3:.2f} ms total on-device)")
+    print("| op | total ms | calls | % of device time |")
+    print("|---|---|---|---|")
+    table = []
+    for name, v in rows:
+        pct = 100.0 * v["total_us"] / total if total else 0.0
+        short = name if len(name) <= 90 else name[:87] + "..."
+        print(f"| `{short}` | {v['total_us'] / 1e3:.3f} | {v['count']} "
+              f"| {pct:.1f} |")
+        table.append({"op": name, "total_ms": v["total_us"] / 1e3,
+                      "calls": v["count"], "pct_device_time": pct})
+    return table
 
 
 def main() -> None:
@@ -74,32 +285,42 @@ def main() -> None:
     ap.add_argument("trace_dir")
     ap.add_argument("--top", type=int, default=30)
     ap.add_argument("--json", default=None, metavar="PATH")
+    ap.add_argument("--by-phase", action="store_true",
+                    help="roll op self-time up to the tat.* named-scope "
+                         "phases (obs/phases.py)")
+    ap.add_argument("--hlo", default=None, metavar="PATH",
+                    help="compiled HLO text for instruction->scope mapping "
+                         "(default: *.hlo.txt found under the trace dir)")
     args = ap.parse_args()
 
-    agg = device_op_times(load_xplanes(args.trace_dir))
+    agg = op_aggregate(load_xplanes(args.trace_dir))
     if not agg:
         raise SystemExit("no device-plane op events found in the trace")
-    total = sum(v["total_us"] for v in agg.values())
-    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total_us"])[: args.top]
 
-    print(f"# device op self-time, top {args.top} of {len(agg)} ops "
-          f"({total / 1e3:.2f} ms total on-device)")
-    print("| op | total ms | calls | % of device time |")
-    print("|---|---|---|---|")
-    table = []
-    for name, v in rows:
-        pct = 100.0 * v["total_us"] / total
-        short = name if len(name) <= 90 else name[:87] + "..."
-        print(f"| `{short}` | {v['total_us'] / 1e3:.3f} | {v['count']} "
-              f"| {pct:.1f} |")
-        table.append({"op": name, "total_ms": v["total_us"] / 1e3,
-                      "calls": v["count"], "pct_device_time": pct})
+    payload: dict = {}
+    if args.by_phase:
+        hlo_path = args.hlo or find_hlo_dump(args.trace_dir)
+        hlo_map = load_hlo_map(hlo_path) if hlo_path else None
+        if hlo_map is None:
+            print("# note: no HLO dump found — attribution relies on "
+                  "per-event tf_op stats only (TPU traces); rerun "
+                  "bench.py --profile to get <dir>/headline.hlo.txt")
+        rows, op_total, attributed = rollup_phases(agg, hlo_map)
+        payload["phases"] = print_phase_table(rows, op_total, attributed)
+        payload["op_total_ms"] = op_total / 1e3
+        payload["attributed_frac"] = (
+            attributed / op_total if op_total else 0.0
+        )
+    else:
+        payload["top_ops"] = print_op_table(agg, args.top)
+        payload["device_total_ms"] = (
+            sum(v["total_us"] for v in agg.values()) / 1e3
+        )
 
     if args.json:
         os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
         with open(args.json, "w") as fh:
-            json.dump({"device_total_ms": total / 1e3, "top_ops": table}, fh,
-                      indent=1)
+            json.dump(payload, fh, indent=1)
         print(f"written to {args.json}")
 
 

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Run-health summary from a flight-recorder metrics jsonl.
+
+Renders the schema-versioned event log written by ``obs.export``
+(``resilience.recovery.run_chunks(metrics=...)`` chunk boundaries,
+``bench.py --sweep`` cells, on-demand ``obs.export.rollout_metrics``)
+as operator-facing tables: fallback-rung distribution, consensus-residual
+percentiles, safety-margin minima, chunk wall-times, and
+resume/retry/preemption events — "is this fleet healthy and where is the
+time going" without re-running the workload.
+
+Usage:
+  python tools/run_health.py RUN.metrics.jsonl [--json]
+  python tools/run_health.py --validate artifacts/*.metrics.jsonl
+
+``--validate`` only schema-checks the files (the ``tools/ci_check.sh``
+gate); exit 1 on any violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tpu_aerial_transport.obs import export as export_mod  # noqa: E402
+
+RUNG_LABELS = ("0 clean", "1 retry", "2 hold", "3 equilibrium")
+
+
+def _percentile(xs: list[float], p: float) -> float:
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, round(p * (len(xs) - 1))))
+    return xs[k]
+
+
+def summarize(events: list[dict]) -> dict:
+    """Aggregate a run's events into the summary dict the tables render."""
+    chunks = [e for e in events if e.get("event") == "chunk"]
+    out: dict = {
+        "events": {},
+        "run_start": next(
+            (e for e in events if e.get("event") == "run_start"), None
+        ),
+    }
+    for e in events:
+        k = e.get("event", "?")
+        out["events"][k] = out["events"].get(k, 0) + 1
+
+    # The telemetry accumulator is cumulative: the LAST chunk's telemetry
+    # block is the whole-run summary. rollout_summary events carry their
+    # own exact digests.
+    tel = next(
+        (e["telemetry"] for e in reversed(chunks)
+         if e.get("telemetry")), None,
+    )
+    if tel is None:
+        tel = next(
+            (e["telemetry"] for e in reversed(events)
+             if e.get("event") == "rollout_summary" and e.get("telemetry")),
+            None,
+        )
+    out["telemetry"] = tel
+
+    # Exact per-chunk / rollout log digests, summed.
+    digests = [e["logs"] for e in chunks if e.get("logs")] + [
+        e["logs"] for e in events
+        if e.get("event") == "rollout_summary" and e.get("logs")
+    ]
+    if digests:
+        agg = {
+            "steps": sum(d["steps"] for d in digests),
+            "rung_hist": [
+                sum(d["rung_hist"][i] for d in digests) for i in range(4)
+            ],
+            "min_env_dist": min(d["min_env_dist"] for d in digests),
+            "collision_steps": sum(d["collision_steps"] for d in digests),
+            "quarantined_final": digests[-1].get("quarantined_final", 0),
+            "residual_max": max(
+                (d["residual"]["max"] for d in digests
+                 if d["residual"].get("max") is not None),
+                default=None,
+            ),
+        }
+        out["logs"] = agg
+
+    if chunks:
+        walls = [e["wall_s"] for e in chunks]
+        out["chunks"] = {
+            "count": len(chunks),
+            "wall_s_total": sum(walls),
+            "wall_s_mean": sum(walls) / len(walls),
+            "wall_s_p50": _percentile(walls, 0.5),
+            "wall_s_max": max(walls),
+            "retries": sum(e.get("retries", 0) for e in chunks),
+        }
+    out["interruptions"] = [
+        {k: e.get(k) for k in ("event", "chunk", "start_chunk", "signal",
+                               "attempt", "error") if k in e}
+        for e in events
+        if e.get("event") in ("retry", "resume", "preempted")
+    ]
+    cells = [e for e in events if e.get("event") == "bench_cell"]
+    if cells:
+        out["bench_cells"] = {e["cell"]: e["value"] for e in cells}
+    return out
+
+
+def render(summary: dict) -> None:
+    ev = summary["events"]
+    print("# run health")
+    print("events: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(ev.items())
+    ))
+
+    tel = summary.get("telemetry")
+    logs = summary.get("logs")
+    rung_src = None
+    if tel:
+        rung_src = ("telemetry (cumulative, on-device)", tel["rung_hist"],
+                    tel["steps"])
+    elif logs:
+        rung_src = ("log digests (exact)", logs["rung_hist"], logs["steps"])
+    if rung_src:
+        label, hist, steps = rung_src
+        print(f"\n## fallback-rung distribution — {label}")
+        print("| rung | steps | % |")
+        print("|---|---|---|")
+        for name, count in zip(RUNG_LABELS, hist):
+            pct = 100.0 * count / steps if steps else 0.0
+            print(f"| {name} | {count} | {pct:.1f} |")
+
+    if tel:
+        r = tel["residual"]
+        # Percentile columns come from the event's own keys (the state
+        # carries its quantile labels), so non-default configs render
+        # their actual percentiles instead of empty p50/p90/p99 columns.
+        pkeys = sorted(
+            (k for k in r if k.startswith("p") and k != "pct"),
+            key=lambda k: float(k[1:]),
+        )
+        cols = ["count", "min", *pkeys, "max", "mean"]
+        print("\n## consensus residual (P² streaming percentiles)")
+        print("| " + " | ".join(cols) + " |")
+        print("|" + "---|" * len(cols))
+        print("| " + " | ".join(
+            [str(r["count"])] + [_fmt(r.get(k)) for k in cols[1:]]
+        ) + " |")
+        print("\n## safety margins")
+        if "lanes" in tel:
+            print(f"- fleet lanes (batched run, worst-lane percentiles): "
+                  f"{tel['lanes']}")
+        print(f"- min env/CBF margin: {_fmt(tel['min_env_dist'])} m")
+        print(f"- worst-step ok_frac: {_fmt(tel['ok_frac_min'])}")
+        print(f"- collision steps: {tel['collision_steps']}")
+        print(f"- quarantined steps: {tel['quarantine_steps']}")
+        if "agent_fail_steps" in tel:
+            worst = max(range(len(tel["agent_fail_steps"])),
+                        key=lambda i: tel["agent_fail_steps"][i])
+            print(f"- per-agent solve failures: {tel['agent_fail_steps']} "
+                  f"(worst: agent {worst})")
+    elif logs:
+        print("\n## safety margins (from log digests)")
+        print(f"- min env/CBF margin: {_fmt(logs['min_env_dist'])} m")
+        print(f"- collision steps: {logs['collision_steps']}")
+        print(f"- quarantined lanes at end: {logs['quarantined_final']}")
+
+    ch = summary.get("chunks")
+    if ch:
+        print("\n## chunk wall-times")
+        print(f"- chunks: {ch['count']}, total {ch['wall_s_total']:.2f} s")
+        print(f"- per-chunk mean/p50/max: {ch['wall_s_mean']:.3f} / "
+              f"{ch['wall_s_p50']:.3f} / {ch['wall_s_max']:.3f} s")
+        print(f"- host-level retries: {ch['retries']}")
+
+    if summary.get("interruptions"):
+        print("\n## resume / retry / preemption events")
+        for e in summary["interruptions"]:
+            print(f"- {json.dumps(e)}")
+
+    if summary.get("bench_cells"):
+        print("\n## bench cells")
+        print("| cell | value |")
+        print("|---|---|")
+        for k, v in summary["bench_cells"].items():
+            print(f"| {k} | {json.dumps(v)} |")
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "—"
+    return f"{v:.4g}"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="+", metavar="METRICS_JSONL")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable summary instead of "
+                         "tables")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-check only (ci gate); exit 1 on any "
+                         "violation")
+    args = ap.parse_args()
+
+    failed = False
+    for path in args.paths:
+        errs = export_mod.validate_file(path)
+        if errs:
+            failed = True
+            print(f"{path}: {len(errs)} schema violation(s)",
+                  file=sys.stderr)
+            for e in errs[:20]:
+                print(f"  {e}", file=sys.stderr)
+        elif args.validate:
+            print(f"{path}: OK")
+    if args.validate or failed:
+        raise SystemExit(1 if failed else 0)
+
+    for path in args.paths:
+        if len(args.paths) > 1:
+            print(f"\n===== {path} =====")
+        summary = summarize(export_mod.read_events(path))
+        if args.json:
+            print(json.dumps(summary, indent=1))
+        else:
+            render(summary)
+
+
+if __name__ == "__main__":
+    main()

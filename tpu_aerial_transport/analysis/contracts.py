@@ -424,6 +424,26 @@ def _build_chunked_rollout():
     return fn, make_args
 
 
+@_register("harness.rollout:rollout_telemetry")
+def _build_rollout_telemetry():
+    from tpu_aerial_transport.harness import rollout as h_rollout
+    from tpu_aerial_transport.obs import telemetry as telemetry_mod
+
+    params, cfg, centralized, llc, hl = _rollout_bits()
+    tcfg = telemetry_mod.TelemetryConfig()
+
+    def fn(s0, cs0):
+        return h_rollout.rollout(
+            hl, llc.control, params, s0, cs0, n_hl_steps=2, hl_rel_freq=2,
+            telemetry=tcfg,
+        )
+
+    def make_args():
+        return (_rqp_bits(4)[2], centralized.init_ctrl_state(params, cfg))
+
+    return fn, make_args
+
+
 @_register("resilience.rollout:resilient_rollout")
 def _build_resilient():
     from tpu_aerial_transport.control import cadmm, lowlevel
@@ -481,6 +501,39 @@ def _build_resilient_donated():
             jnp.copy,
             (_rqp_bits(4)[2], cadmm.init_cadmm_state(params, cfg)),
         )
+
+    return fn, make_args
+
+
+@_register("resilience.rollout:resilient_rollout_telemetry")
+def _build_resilient_telemetry():
+    from tpu_aerial_transport.control import cadmm, lowlevel
+    from tpu_aerial_transport.obs import telemetry as telemetry_mod
+    from tpu_aerial_transport.resilience import faults as faults_mod
+    from tpu_aerial_transport.resilience import rollout as r_rollout
+
+    params, col, state = _rqp_bits(4)
+    # pad_operators pinned True (TC104 checks the tile-target program on
+    # the CPU lint host); track_agent_stats exercises the per-agent
+    # solve-health stats path + telemetry's matching agent accumulators.
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=2, inner_iters=4, pad_operators=True,
+        track_agent_stats=True,
+    )
+    sched = faults_mod.make_schedule(4, t_fail={1: 1}, drop_rate=0.3)
+    hl = r_rollout.make_cadmm_hl_step(params, cfg)
+    llc = lowlevel.make_lowlevel_controller("pd", params)
+    tcfg = telemetry_mod.TelemetryConfig(track_agents=True)
+
+    def fn(s0, cs0):
+        return r_rollout.resilient_rollout(
+            hl, llc.control, params, s0, cs0, n_hl_steps=2, hl_rel_freq=2,
+            faults=sched, telemetry=tcfg,
+        )
+
+    def make_args():
+        return (_rqp_bits(4)[2], cadmm.init_cadmm_state(params, cfg))
 
     return fn, make_args
 

@@ -75,6 +75,7 @@ TRACED_FUNCTIONS: dict[str, tuple[str, ...]] = {
     "tpu_aerial_transport/resilience/quarantine.py": (
         "tree_all_finite", "tree_where",
     ),
+    "tpu_aerial_transport/obs/telemetry.py": ("update", "_p2_update"),
 }
 
 # name -> short description; analysis.contracts.REGISTRY must carry
@@ -102,6 +103,12 @@ CONTRACT_ENTRYPOINTS: dict[str, str] = {
         "fault-injected rollout with fallback ladder + quarantine",
     "resilience.rollout:resilient_rollout_donated":
         "donation-clean jitted fault-injected rollout",
+    "harness.rollout:rollout_telemetry":
+        "rollout with the in-jit run-health telemetry accumulator on the "
+        "scan carry (obs.telemetry)",
+    "resilience.rollout:resilient_rollout_telemetry":
+        "fault-injected rollout with telemetry + per-agent solve health "
+        "(track_agent_stats)",
     "parallel.mesh:cadmm_control_sharded":
         "agent-sharded C-ADMM step (shard_map + psum/pmax)",
     "parallel.mesh:scenario_rollout":
@@ -158,6 +165,9 @@ TILE_WAIVERS: dict[str, str] = {
     "harness.rollout:rollout_donated": "same program as harness.rollout",
     "harness.rollout:chunked_rollout":
         "same per-step program as harness.rollout, split into chunks",
+    "harness.rollout:rollout_telemetry":
+        "same program as harness.rollout plus the telemetry accumulator "
+        "(elementwise P2/histogram updates; no long contractions)",
     "parallel.mesh:scenario_rollout":
         "scenario axis is data-parallel over the centralized-controller "
         "rollout; per-lane ops are 3-vectors",

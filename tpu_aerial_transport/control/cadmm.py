@@ -45,6 +45,7 @@ from tpu_aerial_transport.control.types import EnvCBF, SolverStats, inactive_env
 from tpu_aerial_transport.envs import forest as forest_mod
 from tpu_aerial_transport.harness.bucketing import bucket_dim as _bucket_dim
 from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
+from tpu_aerial_transport.obs import phases
 from tpu_aerial_transport.ops import lie, socp
 from tpu_aerial_transport.control.centralized import (
     equilibrium_forces,
@@ -158,6 +159,11 @@ class RQPCADMMConfig:
     # this field always holds the RESOLVED bool. False is also the
     # bench's padded-vs-unpadded A/B switch.
     pad_operators: bool = struct.field(pytree_node=False, default=True)
+    # Per-agent solve-health telemetry (obs.telemetry track_agents): when
+    # True, SolverStats.agent_solve_res carries every agent's exit-time QP
+    # residual (all_gathered to the full (n,) table under shard_map).
+    # STATIC and default-off: the nominal program is bit-identical.
+    track_agent_stats: bool = struct.field(pytree_node=False, default=False)
 
 
 def make_config(
@@ -180,6 +186,7 @@ def make_config(
     inner_check_every: int = 10,
     solve_retry_iters: int = 4,
     pad_operators: bool | None = None,
+    track_agent_stats: bool = False,
 ) -> RQPCADMMConfig:
     """Defaults are reference-conservative (max_iter mirrors the reference's
     100-iteration cap). For warm-started receding-horizon use, the measured
@@ -242,6 +249,7 @@ def make_config(
         # None = "auto", resolved here (config build time, outside jit)
         # like socp_fused above: tile-padded on tiled backends, raw on CPU.
         pad_operators=socp.resolve_pad_operators(pad_operators),
+        track_agent_stats=track_agent_stats,
     )
 
 
@@ -977,7 +985,8 @@ def control(
 
     r_local = jnp.take(params.r, agent_ids, axis=0)
 
-    env_cbfs = agent_env_cbfs_for(params, cfg, forest, state, r_local)
+    with phases.scope(phases.CBF_ROWS):
+        env_cbfs = agent_env_cbfs_for(params, cfg, forest, state, r_local)
     leaders = (agent_ids == cfg.leader_idx).astype(dtype)
 
     if health is not None:
@@ -1112,8 +1121,9 @@ def control(
     n_rho = len(rhos)
     rho_arr = jnp.asarray(rhos, dtype)
     if n_rho == 1:
-        data0 = (build_qp(rho_arr[0], jax.tree.map(lambda x: x[0], plan))
-                 if use_reduced else build_qp(rho_arr[0]))
+        with phases.scope(phases.QP_BUILD):
+            data0 = (build_qp(rho_arr[0], jax.tree.map(lambda x: x[0], plan))
+                     if use_reduced else build_qp(rho_arr[0]))
 
         def qp_at(it):
             return data0
@@ -1121,8 +1131,9 @@ def control(
         def rho_at(it):
             return rho_arr[0]
     else:
-        stack = (jax.vmap(build_qp)(rho_arr, plan)
-                 if use_reduced else jax.vmap(build_qp)(rho_arr))
+        with phases.scope(phases.QP_BUILD):
+            stack = (jax.vmap(build_qp)(rho_arr, plan)
+                     if use_reduced else jax.vmap(build_qp)(rho_arr))
 
         def qp_at(it):
             idx = jnp.minimum(it, n_rho - 1)
@@ -1154,9 +1165,10 @@ def control(
     def _consensus_iter_impl(solve_one, carry):
         (f, lam, f_mean, warm, it, res, err_buf, okf, _ok_last,
          fail_count) = carry
-        f_new, sols = primal_solve(
-            solve_one, qp_at(it), rho_at(it), lam, f_mean, warm
-        )
+        with phases.scope(phases.LOCAL_SOLVE):
+            f_new, sols = primal_solve(
+                solve_one, qp_at(it), rho_at(it), lam, f_mean, warm
+            )
         # Failed agents fall back to equilibrium forces (reference :491-494).
         ok = (sols.prim_res < cfg.solver_tol)[:, None, None] & jnp.all(
             jnp.isfinite(f_new), axis=(1, 2), keepdims=True
@@ -1189,39 +1201,43 @@ def control(
         )
         # Consensus all-reduce: mean + inf-norm residual (psum/pmax over the
         # mesh axis when agents are sharded).
-        if health is None:
-            f_mean_new = _mean_over_agents(f_new)
-            res_new = _max_over_agents(
-                jnp.abs(f_new - f_mean_new[None, :, :])
-            )
-        else:
-            # Masked consensus: dropped agents contribute their HELD copy,
-            # dead agents contribute nothing, and the mean divides by the
-            # alive count. The residual measures agreement of the FRESH
-            # delivered copies only (a permanently-dropped agent's stale
-            # copy is expected to disagree — it must not stall the loop).
-            f_eff = jnp.where(msg_ok_l[:, None, None], f_new, f_stale)
-            s = jnp.sum(f_eff * w_alive[:, None, None], axis=0)
-            if axis_name is not None:
-                s = lax.psum(s, axis_name)
-            f_mean_new = s / n_alive
-            res_new = _max_over_agents(jnp.where(
-                contrib[:, None, None],
-                jnp.abs(f_eff - f_mean_new[None, :, :]), 0.0,
-            ))
+        with phases.scope(phases.CONSENSUS):
+            if health is None:
+                f_mean_new = _mean_over_agents(f_new)
+                res_new = _max_over_agents(
+                    jnp.abs(f_new - f_mean_new[None, :, :])
+                )
+            else:
+                # Masked consensus: dropped agents contribute their HELD
+                # copy, dead agents contribute nothing, and the mean
+                # divides by the alive count. The residual measures
+                # agreement of the FRESH delivered copies only (a
+                # permanently-dropped agent's stale copy is expected to
+                # disagree — it must not stall the loop).
+                f_eff = jnp.where(msg_ok_l[:, None, None], f_new, f_stale)
+                s = jnp.sum(f_eff * w_alive[:, None, None], axis=0)
+                if axis_name is not None:
+                    s = lax.psum(s, axis_name)
+                f_mean_new = s / n_alive
+                res_new = _max_over_agents(jnp.where(
+                    contrib[:, None, None],
+                    jnp.abs(f_eff - f_mean_new[None, :, :]), 0.0,
+                ))
         err_buf = err_buf.at[it].set(res_new)
         it = it + 1
         # Dual update, gated exactly like the reference's loop (:655-665):
         # rho advances after the solves, the loop breaks BEFORE the dual
         # update when converged or past the cap, and the update uses the
         # advanced rho.
-        do_dual = (res_new >= cfg.res_tol) & (it <= cfg.max_iter)
-        lam_new = jnp.where(
-            do_dual, lam + rho_at(it) * (f_new - f_mean_new[None, :, :]), lam
-        )
-        if health is not None:
-            # Frozen duals for dead agents.
-            lam_new = jnp.where(alive_l[:, None, None], lam_new, lam)
+        with phases.scope(phases.DUAL_UPDATE):
+            do_dual = (res_new >= cfg.res_tol) & (it <= cfg.max_iter)
+            lam_new = jnp.where(
+                do_dual, lam + rho_at(it) * (f_new - f_mean_new[None, :, :]),
+                lam,
+            )
+            if health is not None:
+                # Frozen duals for dead agents.
+                lam_new = jnp.where(alive_l[:, None, None], lam_new, lam)
         # Worst-iteration solve-success fraction (observability of the
         # equilibrium-fallback path).
         ok_last = _mean_over_agents(ok_flat.astype(dtype))
@@ -1297,6 +1313,14 @@ def control(
         err_seq=err_buf,
         ok_frac=ok_frac,
     )
+    if cfg.track_agent_stats:
+        # Exit-time per-agent QP residuals for solve-health telemetry
+        # (obs.telemetry track_agents): the final warm start's prim_res,
+        # all_gathered to the full (n,) table when agents are sharded.
+        agent_res = warm.prim_res
+        if axis_name is not None:
+            agent_res = lax.all_gather(agent_res, axis_name).reshape(n)
+        stats = stats.replace(agent_solve_res=agent_res)
     return f_app, new_state, stats
 
 

@@ -39,6 +39,7 @@ from tpu_aerial_transport.control.centralized import (
 from tpu_aerial_transport.control.types import EnvCBF, SolverStats
 from tpu_aerial_transport.envs import forest as forest_mod
 from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
+from tpu_aerial_transport.obs import phases
 from tpu_aerial_transport.ops import lie, socp
 
 
@@ -69,6 +70,7 @@ def make_config(
     inner_check_every: int = 10,
     solve_retry_iters: int = 4,
     pad_operators: bool | None = None,
+    track_agent_stats: bool = False,
 ) -> RQPDDConfig:
     """Defaults are reference-conservative. For warm-started receding-horizon
     use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
@@ -92,6 +94,7 @@ def make_config(
         k_smooth=k_smooth, dt=dt, socp_fused=socp_fused,
         inner_tol=inner_tol, inner_check_every=inner_check_every,
         solve_retry_iters=solve_retry_iters, pad_operators=pad_operators,
+        track_agent_stats=track_agent_stats,
     )
     return RQPDDConfig(base=base, prim_inf_tol=prim_inf_tol)
 
@@ -540,32 +543,34 @@ def control(
     r_com_local = jnp.take(params.r_com, agent_ids, axis=0)
     f_eq_local = jnp.take(f_eq, agent_ids, axis=0)
 
-    env_cbfs = agent_env_cbfs_for(params, base, forest, state, r_local)
+    with phases.scope(phases.CBF_ROWS):
+        env_cbfs = agent_env_cbfs_for(params, base, forest, state, r_local)
     # Equality test (not .at[idx]) so leader_idx = -1 (unset_leader) yields no
     # leader rather than wrapping to the last agent.
     leaders = (agent_ids == base.leader_idx).astype(dtype)
 
     R_local = jnp.take(state.R, agent_ids, axis=0)
     w_local = jnp.take(state.w, agent_ids, axis=0)
-    P, q0, A, lb, ub, shift = jax.vmap(
-        lambda fi_eq, r_i, R_i, w_i, ld, cbf: _build_agent_qp(
-            params, base, fi_eq, r_i, R_i, w_i, state, acc_des, cbf, ld
-        )
-    )(f_eq_local, r_com_local, R_local, w_local, leaders, env_cbfs)
-
-    _, n_box_raw, _, n_box, m = _qp_dims(cfg)
-    if base.pad_operators:
-        # Tile-aligned operator layout (ops/socp.py padded tier; exact —
-        # pad rows are free, pad variables rest at 0).
+    with phases.scope(phases.QP_BUILD):
         P, q0, A, lb, ub, shift = jax.vmap(
-            lambda P_, q_, A_, lb_, ub_, s_: socp.pad_qp(
-                P_, q_, A_, lb_, ub_, s_, n_box=n_box_raw, soc_dims=(4, 4)
+            lambda fi_eq, r_i, R_i, w_i, ld, cbf: _build_agent_qp(
+                params, base, fi_eq, r_i, R_i, w_i, state, acc_des, cbf, ld
             )
-        )(P, q0, A, lb, ub, shift)
-    rho_vec = jax.vmap(
-        lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
-    )(lb, ub)
-    op = socp.kkt_operator(P, A, rho_vec)
+        )(f_eq_local, r_com_local, R_local, w_local, leaders, env_cbfs)
+
+        _, n_box_raw, _, n_box, m = _qp_dims(cfg)
+        if base.pad_operators:
+            # Tile-aligned operator layout (ops/socp.py padded tier; exact
+            # — pad rows are free, pad variables rest at 0).
+            P, q0, A, lb, ub, shift = jax.vmap(
+                lambda P_, q_, A_, lb_, ub_, s_: socp.pad_qp(
+                    P_, q_, A_, lb_, ub_, s_, n_box=n_box_raw, soc_dims=(4, 4)
+                )
+            )(P, q0, A, lb, ub, shift)
+        rho_vec = jax.vmap(
+            lambda lb_, ub_: socp.make_rho_vec(m, n_box, lb_, ub_, 0.4, dtype)
+        )(lb, ub)
+        op = socp.kkt_operator(P, A, rho_vec)
 
     # Quasi-Newton preparation (reference :634-657, where n 9x9 inverses and
     # a 6n x 6n factorization re-ran every control step): the state-free
@@ -614,26 +619,29 @@ def control(
         # while dropped and zero while dead; the aggregation and the
         # subtract-own step use the same visible values so "sum of the
         # others' prices" stays exact w.r.t. delivered messages.
-        if health is None:
-            lamF_eff, lamM_eff = lam_F, lam_M
-        else:
-            lamF_eff = jnp.where(
-                msg_ok_l[:, None], lam_F, lamF_stale
-            ) * w_alive[:, None]
-            lamM_eff = jnp.where(
-                msg_ok_l[:, None], lam_M, lamM_stale
-            ) * w_alive[:, None]
-        sum_lF = _sum_over_agents(lamF_eff)
-        sum_lM = _sum_over_agents(lamM_eff)
-        c_F = lam_F
-        c_M = lam_M
-        c_f = -(sum_lF[None, :] - lamF_eff) + jnp.einsum(
-            "nij,nj->ni",
-            jax.vmap(lambda r: state.Rl @ lie.hat(r))(r_com_local),
-            sum_lM[None, :] - lamM_eff,
-        )
-        q = q0.at[:, 9:12].add(c_f).at[:, 12:15].add(c_F).at[:, 15:18].add(c_M)
-        sols = solve_one(P, q, A, lb, ub, shift, op, warm)
+        with phases.scope(phases.CONSENSUS):
+            if health is None:
+                lamF_eff, lamM_eff = lam_F, lam_M
+            else:
+                lamF_eff = jnp.where(
+                    msg_ok_l[:, None], lam_F, lamF_stale
+                ) * w_alive[:, None]
+                lamM_eff = jnp.where(
+                    msg_ok_l[:, None], lam_M, lamM_stale
+                ) * w_alive[:, None]
+            sum_lF = _sum_over_agents(lamF_eff)
+            sum_lM = _sum_over_agents(lamM_eff)
+            c_F = lam_F
+            c_M = lam_M
+            c_f = -(sum_lF[None, :] - lamF_eff) + jnp.einsum(
+                "nij,nj->ni",
+                jax.vmap(lambda r: state.Rl @ lie.hat(r))(r_com_local),
+                sum_lM[None, :] - lamM_eff,
+            )
+            q = (q0.at[:, 9:12].add(c_f).at[:, 12:15].add(c_F)
+                 .at[:, 15:18].add(c_M))
+        with phases.scope(phases.LOCAL_SOLVE):
+            sols = solve_one(P, q, A, lb, ub, shift, op, warm)
         x = sols.x
         ok = (sols.prim_res < base.solver_tol) & jnp.all(
             jnp.isfinite(x), axis=-1
@@ -667,23 +675,24 @@ def control(
         # (held while dropped, zero while dead) and dead agents' violation
         # blocks are zeroed so they drive neither the residual nor the
         # dual ascent.
-        if health is None:
-            f_c = f_new
-        else:
-            f_c = jnp.where(
-                msg_ok_l[:, None], f_new, f_stale
-            ) * w_alive[:, None]
-        moments = jnp.einsum("nij,nj->ni", G_local, f_c)
-        sum_f = _sum_over_agents(f_c)
-        sum_m = _sum_over_agents(moments)
-        err_F = F_new - (sum_f[None, :] - f_c)
-        err_M = M_new - (sum_m[None, :] - moments)
-        if health is not None:
-            err_F = err_F * w_alive[:, None]
-            err_M = err_M * w_alive[:, None]
-        err_new = _max_over_agents(
-            jnp.maximum(jnp.max(jnp.abs(err_F)), jnp.max(jnp.abs(err_M)))
-        )
+        with phases.scope(phases.CONSENSUS):
+            if health is None:
+                f_c = f_new
+            else:
+                f_c = jnp.where(
+                    msg_ok_l[:, None], f_new, f_stale
+                ) * w_alive[:, None]
+            moments = jnp.einsum("nij,nj->ni", G_local, f_c)
+            sum_f = _sum_over_agents(f_c)
+            sum_m = _sum_over_agents(moments)
+            err_F = F_new - (sum_f[None, :] - f_c)
+            err_M = M_new - (sum_m[None, :] - moments)
+            if health is not None:
+                err_F = err_F * w_alive[:, None]
+                err_M = err_M * w_alive[:, None]
+            err_new = _max_over_agents(
+                jnp.maximum(jnp.max(jnp.abs(err_F)), jnp.max(jnp.abs(err_M)))
+            )
         err_buf = err_buf.at[it].set(err_new)
         it = it + 1
         # Quasi-Newton dual ascent (reference :678-693). The dual gradient
@@ -695,18 +704,21 @@ def control(
         # orthogonal change of basis, identical to the world-frame step.
         # Gated like the reference's loop (:742-748): it breaks BEFORE the
         # ascent when converged or past the iteration cap.
-        dual_grad = _gather_blocks(
-            jnp.concatenate([err_F @ state.Rl, err_M], axis=1)
-        ).reshape(-1)
-        step = (qn_inv @ dual_grad).reshape(n, 6)
-        step = jnp.take(step, agent_ids, axis=0)
-        do_dual = (err_new >= cfg.prim_inf_tol) & (it <= base.max_iter)
-        lam_F_new = jnp.where(do_dual, lam_F + step[:, :3] @ state.Rl.T, lam_F)
-        lam_M_new = jnp.where(do_dual, lam_M + step[:, 3:], lam_M)
-        if health is not None:
-            # Frozen duals for dead agents.
-            lam_F_new = jnp.where(alive_l[:, None], lam_F_new, lam_F)
-            lam_M_new = jnp.where(alive_l[:, None], lam_M_new, lam_M)
+        with phases.scope(phases.DUAL_UPDATE):
+            dual_grad = _gather_blocks(
+                jnp.concatenate([err_F @ state.Rl, err_M], axis=1)
+            ).reshape(-1)
+            step = (qn_inv @ dual_grad).reshape(n, 6)
+            step = jnp.take(step, agent_ids, axis=0)
+            do_dual = (err_new >= cfg.prim_inf_tol) & (it <= base.max_iter)
+            lam_F_new = jnp.where(
+                do_dual, lam_F + step[:, :3] @ state.Rl.T, lam_F
+            )
+            lam_M_new = jnp.where(do_dual, lam_M + step[:, 3:], lam_M)
+            if health is not None:
+                # Frozen duals for dead agents.
+                lam_F_new = jnp.where(alive_l[:, None], lam_F_new, lam_F)
+                lam_M_new = jnp.where(alive_l[:, None], lam_M_new, lam_M)
         ok_last = _sum_over_agents(ok.astype(dtype)) / n
         okf = jnp.minimum(okf, ok_last)  # worst-iteration success fraction.
         fail_count = jnp.where(ok_last < 1.0, fail_count + 1, 0)  # consecutive.
@@ -765,6 +777,12 @@ def control(
         err_seq=err_buf,
         ok_frac=ok_frac,
     )
+    if base.track_agent_stats:
+        # Exit-time per-agent QP residuals for solve-health telemetry
+        # (see the matching cadmm.control block).
+        stats = stats.replace(
+            agent_solve_res=_gather_blocks(warm.prim_res[:, None])[:, 0]
+        )
     return f, new_state, stats
 
 

@@ -36,6 +36,14 @@ class SolverStats:
     fallback_rung: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.zeros((), jnp.int32)
     )
+    # Per-agent final QP residuals ((n,); the distributed controllers'
+    # exit-time warm-start prim_res) for per-agent solve-health telemetry
+    # (obs.telemetry). Populated ONLY under the controllers' static
+    # ``track_agent_stats`` config so the default program is unchanged;
+    # the (0,) default means "not tracked".
+    agent_solve_res: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((0,))
+    )
 
 
 @struct.dataclass

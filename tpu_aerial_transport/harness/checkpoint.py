@@ -150,11 +150,34 @@ def tree_fingerprint(tree) -> str:
 
 def config_fingerprint(**named) -> str:
     """Hash of named configuration objects (params, controller config,
-    fault schedule, CLI args...). Uses ``repr`` — the configs here are
-    flax struct / frozen dataclasses whose reprs are deterministic and
-    value-complete — so any config drift between save and resume flips the
-    hash and :func:`load_snapshot` refuses the mix."""
-    blob = json.dumps({k: repr(v) for k, v in sorted(named.items())})
+    fault schedule, CLI args...), such that ANY config drift between save
+    and resume flips the hash and :func:`load_snapshot` refuses the mix.
+
+    Array leaves are hashed from their full bytes + shape/dtype, NOT their
+    repr: numpy/jax array reprs summarize interiors with ``...`` beyond
+    ~1000 elements, so two different big-fleet params tables (or long
+    per-step fault schedules) would repr — and therefore hash — identical.
+    Non-array leaves keep the repr path (the configs here are flax struct
+    / frozen dataclasses whose reprs are deterministic and
+    value-complete)."""
+
+    def _digest(v) -> str:
+        leaves, treedef = jax.tree.flatten(v)
+        parts = [repr(treedef)]
+        for leaf in leaves:
+            if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+                a = np.asarray(leaf)
+                parts.append(
+                    f"ndarray:{a.dtype}:{a.shape}:"
+                    + hashlib.sha256(
+                        np.ascontiguousarray(a).tobytes()
+                    ).hexdigest()
+                )
+            else:
+                parts.append(repr(leaf))
+        return "\x00".join(parts)
+
+    blob = json.dumps({k: _digest(v) for k, v in sorted(named.items())})
     return hashlib.sha256(blob.encode()).hexdigest()[:32]
 
 

@@ -21,6 +21,8 @@ from jax import lax
 from tpu_aerial_transport.control.types import SolverStats
 from tpu_aerial_transport.envs import forest as forest_mod
 from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.obs import phases
+from tpu_aerial_transport.obs import telemetry as telemetry_mod
 
 
 @struct.dataclass
@@ -86,6 +88,8 @@ def rollout(
     dt: float = 1e-3,
     acc_des_fn: Callable | None = None,
     step_offset=0,
+    telemetry: "telemetry_mod.TelemetryConfig | None" = None,
+    telem0: "telemetry_mod.TelemetryState | None" = None,
 ):
     """Run ``n_hl_steps`` high-level control periods.
 
@@ -101,10 +105,20 @@ def rollout(
         compiled program). The scan runs over ``step_offset + arange``;
         int32 addition is exact, so the per-step times — and therefore the
         whole trajectory — are bitwise-identical to an unchunked run.
+      telemetry: optional :class:`obs.telemetry.TelemetryConfig`. When
+        active, an :class:`obs.telemetry.TelemetryState` accumulator rides
+        the scan carry (run-health metrics folded on-device every step)
+        and a fourth return value carries its final value. ``None`` or an
+        inactive config compiles the IDENTICAL HLO to the telemetry-less
+        harness (asserted by tests/test_telemetry.py).
+      telem0: accumulator to continue from (the chunked path); default is
+        a fresh :func:`obs.telemetry.init_telemetry`.
 
     Returns ``(final_state, final_ctrl_state, logs: RQPLogStep)`` with a leading
-    time axis of length ``n_hl_steps`` on every log leaf.
+    time axis of length ``n_hl_steps`` on every log leaf — plus the final
+    ``TelemetryState`` when telemetry is active.
     """
+    tel_on = telemetry is not None and telemetry.active
     if acc_des_fn is None:
         x0 = state0.xl
 
@@ -114,7 +128,10 @@ def rollout(
             return (dvl_des, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
 
     def hl_body(carry, i):
-        state, cs = carry
+        if tel_on:
+            state, cs, tel = carry
+        else:
+            state, cs = carry
         t = i * hl_rel_freq * dt
         acc_des, x_ref, v_ref = acc_des_fn(state, t)
         f_des, cs, stats = hl_step(cs, state, acc_des)
@@ -123,7 +140,8 @@ def rollout(
             f, M = ll_control(s, f_des)
             return rqp.integrate(params, s, (f, M), dt), None
 
-        state, _ = lax.scan(ll_body, state, None, length=hl_rel_freq)
+        with phases.scope(phases.DYNAMICS):
+            state, _ = lax.scan(ll_body, state, None, length=hl_rel_freq)
         log = RQPLogStep(
             xl=state.xl,
             vl=state.vl,
@@ -139,11 +157,24 @@ def rollout(
             collision=stats.collision,
             min_env_dist=stats.min_env_dist,
         )
+        if tel_on:
+            with phases.scope(phases.TELEMETRY):
+                tel = telemetry_mod.update(telemetry, tel, stats)
+            return (state, cs, tel), log
         return (state, cs), log
 
     steps = jnp.arange(n_hl_steps)
     if not (isinstance(step_offset, int) and step_offset == 0):
         steps = steps + step_offset
+    if tel_on:
+        if telem0 is None:
+            telem0 = telemetry_mod.init_telemetry(
+                telemetry, params.n, state0.xl.dtype
+            )
+        (state, cs, tel), logs = lax.scan(
+            hl_body, (state0, ctrl_state0, telem0), steps
+        )
+        return state, cs, logs, tel
     (state, cs), logs = lax.scan(hl_body, (state0, ctrl_state0), steps)
     return state, cs, logs
 
@@ -158,6 +189,7 @@ def jit_rollout(
     dt: float = 1e-3,
     acc_des_fn: Callable | None = None,
     donate: bool = True,
+    telemetry: "telemetry_mod.TelemetryConfig | None" = None,
 ):
     """Donation-clean jitted rollout entrypoint: returns ``run(state0,
     ctrl_state0) -> (final_state, final_ctrl_state, logs)`` with BOTH
@@ -170,6 +202,10 @@ def jit_rollout(
     ``donate=False`` compiles the same program without aliasing for
     callers that must replay the same initial state.
 
+    ``telemetry``: forwarded to :func:`rollout` — when active the jitted
+    run returns ``(final_state, final_ctrl_state, logs, telemetry_state)``
+    with a fresh accumulator per call.
+
     Shared-buffer caveat: jax deduplicates identical small constants, so a
     freshly built initial state can hold several leaves backed by ONE
     buffer (e.g. the zero ``vl``/``wl``/``w`` of a rest state) — donating
@@ -180,6 +216,7 @@ def jit_rollout(
         return rollout(
             hl_step, ll_control, params, state0, ctrl_state0,
             n_hl_steps, hl_rel_freq, dt, acc_des_fn,
+            telemetry=telemetry,
         )
 
     return jax.jit(run, donate_argnums=(0, 1) if donate else ())
@@ -196,6 +233,7 @@ def make_chunked_rollout(
     dt: float = 1e-3,
     acc_des_fn: Callable,
     donate: bool = False,
+    telemetry: "telemetry_mod.TelemetryConfig | None" = None,
 ):
     """Preemption-safe twin of :func:`jit_rollout`: the T-step scan split
     into ``n_chunks`` chunks of ``T / n_chunks`` HL steps each, reusing ONE
@@ -236,19 +274,46 @@ def make_chunked_rollout(
     ``resilience.recovery`` drives for snapshot/resume.
     """
     chunk_len = validate_chunking(n_hl_steps, n_chunks, acc_des_fn)
+    tel_on = telemetry is not None and telemetry.active
 
-    def chunk(carry, i0):
-        state, cs = carry
-        state, cs, logs = rollout(
-            hl_step, ll_control, params, state, cs, chunk_len,
-            hl_rel_freq, dt, acc_des_fn, step_offset=i0,
-        )
-        return (state, cs), logs
+    if tel_on:
+        # Telemetry rides the chunk carry: every boundary snapshot (and so
+        # every crash-recovery resume) carries the accumulated run-health
+        # state, and recovery.run_chunks exports it per boundary.
+        def chunk(carry, i0):
+            state, cs, tel = carry
+            state, cs, logs, tel = rollout(
+                hl_step, ll_control, params, state, cs, chunk_len,
+                hl_rel_freq, dt, acc_des_fn, step_offset=i0,
+                telemetry=telemetry, telem0=tel,
+            )
+            return (state, cs, tel), logs
+
+        def init_carry(state0, ctrl_state0):
+            return (state0, ctrl_state0, telemetry_mod.init_telemetry(
+                telemetry, params.n, state0.xl.dtype
+            ))
+
+        def unpack(carry):
+            return carry[0], carry[1]
+    else:
+        def chunk(carry, i0):
+            state, cs = carry
+            state, cs, logs = rollout(
+                hl_step, ll_control, params, state, cs, chunk_len,
+                hl_rel_freq, dt, acc_des_fn, step_offset=i0,
+            )
+            return (state, cs), logs
+
+        def init_carry(state0, ctrl_state0):
+            return (state0, ctrl_state0)
+
+        def unpack(carry):
+            return carry
 
     return make_chunk_driver(
         chunk, n_chunks=n_chunks, chunk_len=chunk_len,
-        init_carry=lambda state0, ctrl_state0: (state0, ctrl_state0),
-        unpack=lambda carry: carry, donate=donate,
+        init_carry=init_carry, unpack=unpack, donate=donate,
     )
 
 
@@ -266,16 +331,19 @@ def chunked_rollout(
     acc_des_fn: Callable,
     donate: bool = False,
     on_boundary: Callable | None = None,
+    telemetry: "telemetry_mod.TelemetryConfig | None" = None,
 ):
     """Build-and-run convenience over :func:`make_chunked_rollout` (same
     return contract as :func:`rollout`). With ``donate=True`` the passed
     ``(state0, ctrl_state0)`` are consumed — the shared-constant-buffer
     caveat of :func:`jit_rollout` applies (``jax.tree.map(jnp.copy, ...)``
-    a freshly built rest state before donating it)."""
+    a freshly built rest state before donating it). With telemetry active,
+    the final accumulator is reachable through ``on_boundary``'s carry
+    (``obs.telemetry.find_state``)."""
     run = make_chunked_rollout(
         hl_step, ll_control, params, n_hl_steps=n_hl_steps,
         n_chunks=n_chunks, hl_rel_freq=hl_rel_freq, dt=dt,
-        acc_des_fn=acc_des_fn, donate=donate,
+        acc_des_fn=acc_des_fn, donate=donate, telemetry=telemetry,
     )
     return run(state0, ctrl_state0, on_boundary=on_boundary)
 

@@ -1,0 +1,21 @@
+"""Flight-recorder observability layer.
+
+Three parts (ISSUE 5):
+
+- :mod:`obs.telemetry` — an in-jit :class:`TelemetryState` pytree threaded
+  through the rollout / chunk carries that accumulates run-health metrics
+  on-device (fallback-rung histogram, P² consensus-residual percentiles,
+  safety-margin minima, quarantine counts, per-agent solve health);
+  zero-cost when disabled (identical HLO, same contract as
+  ``resilience.no_faults()``).
+- :mod:`obs.phases` — the ``jax.named_scope`` phase vocabulary
+  (``tat.<phase>``) annotating the algorithm phases across controllers,
+  solver, rollouts and mesh, which ``tools/op_profile.py --by-phase``
+  rolls XLA op self-time up to.
+- :mod:`obs.export` — the ONE schema-versioned jsonl metrics-event writer
+  (chunk boundaries via ``resilience.recovery.run_chunks``, bench sweep
+  cells, on-demand rollout summaries), rendered by
+  ``tools/run_health.py``.
+"""
+
+from tpu_aerial_transport.obs import export, phases, telemetry  # noqa: F401

@@ -1,0 +1,212 @@
+"""Host-side metrics export: the ONE schema-versioned jsonl event writer.
+
+Everything that leaves the device for a dashboard goes through
+:class:`MetricsWriter`: chunk-boundary events from
+``resilience.recovery.run_chunks`` (wall time + the chunk carry's
+telemetry accumulator + a per-chunk log digest), per-cell events from
+``bench.py --sweep``, and on-demand :func:`rollout_metrics` summaries
+from any rollout's logs. ``tools/run_health.py`` renders the file;
+``tools/ci_check.sh`` validates any ``artifacts/*.metrics.jsonl`` with
+:func:`validate_file`.
+
+Line format: one JSON object per line, append-only, fsync'd per event
+(same durability contract as ``resilience.recovery.RunJournal``; a torn
+final line from a crash mid-append is tolerated by readers). Every event
+carries ``schema`` (:data:`SCHEMA_VERSION`), ``event`` (type tag) and
+``ts`` (host unix time).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from tpu_aerial_transport.obs import telemetry as telemetry_mod
+
+SCHEMA_VERSION = 1
+
+# Event vocabulary -> required fields (beyond schema/event/ts). The
+# validator rejects unknown event types and missing fields; extra fields
+# are allowed (forward compatibility within a schema version).
+EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "run_start": (),
+    "chunk": ("chunk", "wall_s"),
+    "retry": ("chunk", "attempt", "error"),
+    "resume": ("start_chunk",),
+    "preempted": ("chunk",),
+    "done": ("chunks",),
+    "bench_cell": ("cell", "value"),
+    "rollout_summary": ("logs",),
+}
+
+
+def jsonl_append(path: str, obj: dict) -> None:
+    """THE durable jsonl append (flush + fsync before returning): shared
+    by :class:`MetricsWriter` and ``resilience.recovery.RunJournal`` so
+    the durability contract lives in exactly one place."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(obj) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def jsonl_read(path: str) -> list[dict]:
+    """All parseable lines; unparseable lines (the torn tail a crash
+    mid-append leaves) are skipped — :func:`validate_file` surfaces torn
+    INTERIOR lines as errors."""
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+class MetricsWriter:
+    """Append-only jsonl metrics writer (one per run/sweep)."""
+
+    def __init__(self, path: str, meta: dict | None = None):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if meta is not None:
+            self.emit("run_start", **meta)
+
+    def emit(self, event: str, **fields) -> dict:
+        if event not in EVENT_FIELDS:
+            raise ValueError(
+                f"unknown metrics event type {event!r} (known: "
+                f"{sorted(EVENT_FIELDS)}); extend EVENT_FIELDS and bump "
+                "SCHEMA_VERSION if readers must distinguish the new shape"
+            )
+        record = {"schema": SCHEMA_VERSION, "event": event,
+                  "ts": time.time(), **fields}
+        jsonl_append(self.path, record)
+        return record
+
+
+def read_events(path: str) -> list[dict]:
+    """All parseable events (see :func:`jsonl_read`)."""
+    return jsonl_read(path)
+
+
+def validate_event(obj, lineno: int = 0) -> list[str]:
+    """Schema errors for one decoded event (empty list = valid)."""
+    where = f"line {lineno}: " if lineno else ""
+    if not isinstance(obj, dict):
+        return [f"{where}event is not a JSON object"]
+    errs = []
+    if obj.get("schema") != SCHEMA_VERSION:
+        errs.append(
+            f"{where}schema {obj.get('schema')!r} != {SCHEMA_VERSION}"
+        )
+    event = obj.get("event")
+    if event not in EVENT_FIELDS:
+        errs.append(f"{where}unknown event type {event!r}")
+    else:
+        missing = [k for k in EVENT_FIELDS[event] if k not in obj]
+        if missing:
+            errs.append(f"{where}event {event!r} missing fields {missing}")
+    if not isinstance(obj.get("ts"), (int, float)):
+        errs.append(f"{where}missing/non-numeric ts")
+    return errs
+
+
+def validate_file(path: str) -> list[str]:
+    """Schema-validate a metrics jsonl. A torn FINAL line is tolerated
+    (the state a crash mid-append leaves); torn interior lines and any
+    schema violation are errors."""
+    errs: list[str] = []
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.readlines()
+    for i, line in enumerate(lines, start=1):
+        if not line.strip():
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            if i == len(lines):
+                continue  # torn tail from a crash — readers skip it.
+            errs.append(f"line {i}: unparseable JSON")
+            continue
+        errs.extend(validate_event(obj, i))
+    return errs
+
+
+def telemetry_event(tel, cfg=None) -> dict | None:
+    """JSON-ready telemetry block from a :class:`TelemetryState` (device
+    arrays or a host snapshot copy); None when ``tel`` is None."""
+    if tel is None:
+        return None
+    return telemetry_mod.summary(tel, cfg)
+
+
+def logs_summary(logs, quantiles=(0.5, 0.9, 0.99)) -> dict:
+    """Exact (non-streaming) digest of a rollout's ``RQPLogStep`` pytree —
+    any leading batch/time axes are flattened, so it works on single
+    rollouts, vmapped batches, and per-chunk slices alike."""
+    rung = np.asarray(logs.fallback_rung).reshape(-1)
+    res = np.asarray(logs.solve_res).reshape(-1).astype(np.float64)
+    res = res[np.isfinite(res)]
+    out = {
+        "steps": int(rung.size),
+        "rung_hist": [
+            int(v) for v in np.bincount(
+                np.clip(rung, 0, telemetry_mod.N_RUNGS - 1),
+                minlength=telemetry_mod.N_RUNGS,
+            )
+        ],
+        "min_env_dist": float(np.min(np.asarray(logs.min_env_dist))),
+        "collision_steps": int(np.sum(np.asarray(logs.collision))),
+        "quarantined_final": int(np.sum(_final_quarantine(logs))),
+        "residual": {
+            "count": int(res.size),
+            "min": float(res.min()) if res.size else None,
+            "max": float(res.max()) if res.size else None,
+            "mean": float(res.mean()) if res.size else None,
+            **{
+                "p%g" % (p * 100): (
+                    float(np.percentile(res, p * 100)) if res.size else None
+                )
+                for p in quantiles
+            },
+        },
+    }
+    return out
+
+
+def _final_quarantine(logs) -> np.ndarray:
+    """Per-scenario final sticky quarantine flags: the LAST time entry.
+    Time is axis 0 for single rollouts and axis 1 for batched chunk logs
+    (``parallel.mesh`` convention); both reduce to 'last along the axis
+    that matches the log length'. The flag is sticky, so max-over-time
+    equals the final value on EVERY layout — use that instead of guessing
+    the axis order."""
+    q = np.asarray(logs.quarantined)
+    if q.ndim <= 1:
+        q = q.reshape(1, -1)
+    return q.reshape(q.shape[0], -1).max(axis=1)
+
+
+def rollout_metrics(
+    path: str,
+    logs,
+    tel=None,
+    cfg=None,
+    meta: dict | None = None,
+) -> dict:
+    """On-demand export: write a ``rollout_summary`` event for a finished
+    rollout's logs (plus its telemetry accumulator when one was threaded)
+    and return the emitted record."""
+    writer = MetricsWriter(path)
+    return writer.emit(
+        "rollout_summary",
+        logs=logs_summary(logs),
+        telemetry=telemetry_event(tel, cfg),
+        **({"meta": meta} if meta else {}),
+    )

@@ -1,0 +1,43 @@
+"""Phase vocabulary for trace attribution.
+
+Every hot code region is wrapped in ``jax.named_scope("tat.<phase>")``;
+the scope lands in each HLO instruction's ``op_name`` metadata (and in
+TPU trace events' ``tf_op`` stat), which ``tools/op_profile.py
+--by-phase`` rolls op self-time up to. Scopes are pure metadata: they
+change NO ops — the zero-cost-when-disabled HLO-identity tests
+(telemetry, faults) run with the scopes present on both sides.
+
+Scopes nest; attribution uses the INNERMOST ``tat.*`` segment of the
+op_name path, so a coarse outer scope (e.g. the sharded-step wrapper)
+never steals time from the fine-grained phases inside it.
+"""
+
+from __future__ import annotations
+
+import jax
+
+PREFIX = "tat."
+
+# The algorithm phases (the op_profile rollup's row vocabulary):
+QP_BUILD = "qp_build"          # per-agent QP matrix assembly + KKT ops.
+CBF_ROWS = "cbf_rows"          # env CBF row construction (forest sweep).
+LOCAL_SOLVE = "local_solve"    # per-agent conic QP solves (inner ADMM).
+CONSENSUS = "consensus"        # consensus mean/residual all-reduce.
+DUAL_UPDATE = "dual_update"    # dual / price ascent step.
+DYNAMICS = "dynamics"          # physics substeps (integrate scan).
+PAD = "pad"                    # tile pad/unpad of operators & warm starts.
+FAULTS = "faults"              # fault schedule eval + sensor noise.
+FALLBACK = "fallback"          # force-fallback ladder + quarantine.
+TELEMETRY = "telemetry"        # in-jit telemetry accumulation.
+SHARDED_STEP = "sharded_step"  # shard_map plumbing outside finer scopes.
+
+PHASES = (
+    QP_BUILD, CBF_ROWS, LOCAL_SOLVE, CONSENSUS, DUAL_UPDATE, DYNAMICS,
+    PAD, FAULTS, FALLBACK, TELEMETRY, SHARDED_STEP,
+)
+
+
+def scope(phase: str):
+    """``with scope(phases.LOCAL_SOLVE): ...`` — a ``jax.named_scope``
+    carrying the ``tat.`` attribution prefix."""
+    return jax.named_scope(PREFIX + phase)

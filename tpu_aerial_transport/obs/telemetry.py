@@ -1,0 +1,438 @@
+"""In-jit run-health telemetry: a :class:`TelemetryState` pytree threaded
+through the rollout / chunk scan carries, updated once per HL control step
+ON-DEVICE — so a week-long chunked run answers "was this fleet healthy"
+from O(1) state instead of O(T) logs.
+
+Accumulated per step (from the controller's ``SolverStats`` plus the
+resilience layer's quarantine flag):
+
+- **fallback-rung histogram** (rungs 0-3, ``resilience.rollout`` ladder);
+- **consensus-residual running percentiles** via the P² (P-squared)
+  streaming estimator of Jain & Chlamtac — 5 markers per tracked
+  quantile, O(1) memory, no reservoir RNG, fully vectorized over the
+  quantile axis (so it lives happily inside a ``lax.scan``) — plus exact
+  running min/max/mean;
+- **safety-margin minima**: min environment/CBF margin
+  (``stats.min_env_dist``) and worst-step ``ok_frac``;
+- **counts**: collision steps, quarantined steps, total consensus
+  iterations;
+- **per-agent solve health** (optional; needs the controller's
+  ``track_agent_stats`` static config so it stays zero-cost when off):
+  per-agent count of steps whose final QP residual missed
+  ``solver_tol`` (the agents persistently falling back to equilibrium
+  forces) and the per-agent worst residual.
+
+**Zero-cost when disabled**: ``telemetry=None`` and
+``telemetry=no_telemetry()`` compile to the IDENTICAL HLO (``active`` is
+a static field and every telemetry branch in the rollouts is a
+Python-level ``if``) — asserted by tests/test_telemetry.py, the same
+contract as ``resilience.faults.no_faults()``.
+
+State is an ordinary pytree: it snapshots/restores through
+``harness.checkpoint`` with the chunk carry (telemetry survives
+preemption), and ``obs.export.telemetry_event`` renders it to the
+metrics jsonl at chunk boundaries.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+# Fallback-ladder rung count (resilience.rollout RUNG_* constants 0-3).
+N_RUNGS = 4
+
+
+@struct.dataclass
+class TelemetryConfig:
+    """Static telemetry knobs. ``active`` and the structure-determining
+    fields are static (they select the compiled program); ``solver_tol``
+    is a dynamic leaf (retunable without recompiling)."""
+
+    # Master switch: False compiles the exact no-telemetry program.
+    active: bool = struct.field(pytree_node=False, default=True)
+    # Quantiles tracked by the P² estimators over the per-step consensus
+    # residual (static: sizes the marker arrays).
+    quantiles: tuple = struct.field(
+        pytree_node=False, default=(0.5, 0.9, 0.99)
+    )
+    # Track per-agent solve health. Requires the controller config's
+    # matching ``track_agent_stats=True`` (cadmm/dd) so SolverStats
+    # carries ``agent_solve_res``; mismatches raise at trace time.
+    track_agents: bool = struct.field(pytree_node=False, default=False)
+    # Per-agent failure threshold for agent_fail_steps (the controllers'
+    # solver_tol; a residual at/above it means the step's final solve for
+    # that agent missed tolerance).
+    solver_tol: float = 5e-3
+
+
+@struct.dataclass
+class TelemetryState:
+    """The on-device accumulator (one per rollout / chunked-run carry).
+
+    ``quantiles`` rides along as a STATIC field (part of the treedef, not
+    a leaf): the host-side readers (``summary``/``residual_percentiles``,
+    hence ``obs.export`` and ``recovery.run_chunks``' boundary events)
+    label the P² marker rows from the state itself, so a snapshot or a
+    host copy is self-describing — no config needed at read time."""
+
+    steps: jnp.ndarray  # () int32 — HL steps accumulated.
+    rung_hist: jnp.ndarray  # (N_RUNGS,) int32 fallback-rung counts.
+    iters_sum: jnp.ndarray  # () int32 — total consensus iterations.
+    ok_frac_min: jnp.ndarray  # () worst-step solve-success fraction.
+    min_env_dist: jnp.ndarray  # () running min CBF/env margin.
+    collision_steps: jnp.ndarray  # () int32.
+    quarantine_steps: jnp.ndarray  # () int32 steps spent quarantined.
+    # Consensus-residual stream (finite observations only).
+    res_count: jnp.ndarray  # () int32.
+    res_min: jnp.ndarray  # ().
+    res_max: jnp.ndarray  # ().
+    res_sum: jnp.ndarray  # () (res_sum / res_count = mean).
+    p2_q: jnp.ndarray  # (Q, 5) P² marker heights.
+    p2_n: jnp.ndarray  # (Q, 5) P² marker positions (float).
+    # Per-agent solve health ((0,) when track_agents is off — the leaves
+    # stay in the pytree so the carry STRUCTURE never depends on data).
+    agent_fail_steps: jnp.ndarray  # (n,) int32 or (0,).
+    agent_res_max: jnp.ndarray  # (n,) or (0,).
+    # The quantile each p2_q/p2_n row tracks (see class docstring).
+    quantiles: tuple = struct.field(
+        pytree_node=False, default=(0.5, 0.9, 0.99)
+    )
+
+
+def no_telemetry() -> TelemetryConfig:
+    """A disabled config: ``rollout(..., telemetry=no_telemetry())``
+    compiles to the identical HLO as ``telemetry=None`` (asserted)."""
+    return TelemetryConfig(active=False)
+
+
+def init_telemetry(
+    cfg: TelemetryConfig, n_agents: int = 0, dtype=jnp.float32
+) -> TelemetryState:
+    """Fresh accumulator. ``n_agents`` sizes the per-agent leaves when
+    ``cfg.track_agents`` (pass the controller's ``params.n``)."""
+    nq = len(cfg.quantiles)
+    na = n_agents if cfg.track_agents else 0
+    return TelemetryState(
+        quantiles=tuple(cfg.quantiles),
+        steps=jnp.zeros((), jnp.int32),
+        rung_hist=jnp.zeros((N_RUNGS,), jnp.int32),
+        iters_sum=jnp.zeros((), jnp.int32),
+        ok_frac_min=jnp.ones((), dtype),
+        min_env_dist=jnp.asarray(jnp.inf, dtype),
+        collision_steps=jnp.zeros((), jnp.int32),
+        quarantine_steps=jnp.zeros((), jnp.int32),
+        res_count=jnp.zeros((), jnp.int32),
+        res_min=jnp.asarray(jnp.inf, dtype),
+        res_max=jnp.asarray(-jnp.inf, dtype),
+        res_sum=jnp.zeros((), dtype),
+        # +inf marker padding: the bootstrap insert-and-sort keeps the
+        # first < 5 observations sorted in the leading columns.
+        p2_q=jnp.full((nq, 5), jnp.inf, dtype),
+        p2_n=jnp.tile(jnp.arange(1.0, 6.0, dtype=dtype), (nq, 1)),
+        agent_fail_steps=jnp.zeros((na,), jnp.int32),
+        agent_res_max=jnp.full((na,), -jnp.inf, dtype),
+    )
+
+
+def _p2_update(cfg: TelemetryConfig, q, npos, count, x):
+    """One P² observation, vectorized over the quantile axis.
+
+    ``q``/``npos`` are (Q, 5) marker heights/positions, ``count`` the
+    number of PRIOR observations, ``x`` the new scalar. Returns the
+    updated ``(q, npos)``. The three middle markers adjust in parallel
+    from the pre-observation snapshot (the textbook algorithm adjusts
+    them sequentially; the parallel variant's estimates agree to the
+    same O(1/sqrt(n)) accuracy — tests/test_telemetry.py bounds it
+    against np.percentile)."""
+    dtype = q.dtype
+    quant = jnp.asarray(cfg.quantiles, dtype)  # (Q,)
+    # Desired marker positions for count+1 total observations:
+    # n'_i = 1 + count * d_i with d = [0, p/2, p, (1+p)/2, 1].
+    dvec = jnp.stack([
+        jnp.zeros_like(quant), quant / 2.0, quant,
+        (1.0 + quant) / 2.0, jnp.ones_like(quant),
+    ], axis=1)  # (Q, 5)
+
+    # --- Bootstrap (< 5 observations): insert sorted, positions fixed.
+    q_boot = jnp.sort(q.at[:, jnp.minimum(count, 4)].set(x), axis=1)
+
+    # --- Main path (>= 5 observations). Computed unconditionally and
+    # selected below; NaNs from the inf-padded bootstrap rows never
+    # propagate through the jnp.where select.
+    qc = q.at[:, 0].min(x).at[:, 4].max(x)
+    # Cell index k in 0..3 with q[k] <= x < q[k+1] (edges clamped).
+    k = jnp.clip(jnp.sum((x >= qc[:, 1:4]).astype(jnp.int32), axis=1), 0, 3)
+    npos_inc = npos + (jnp.arange(5)[None, :] > k[:, None]).astype(dtype)
+    ndes = 1.0 + count.astype(dtype) * dvec
+    nm, ni, npl = npos_inc[:, :-2], npos_inc[:, 1:-1], npos_inc[:, 2:]
+    qm, qi, qp = qc[:, :-2], qc[:, 1:-1], qc[:, 2:]
+    di = ndes[:, 1:-1] - ni
+    s = jnp.where(
+        (di >= 1.0) & (npl - ni > 1.0), 1.0,
+        jnp.where((di <= -1.0) & (nm - ni < -1.0), -1.0, 0.0),
+    ).astype(dtype)
+    # Piecewise-parabolic (P²) height estimate, linear fallback when the
+    # parabola leaves the bracketing markers.
+    gap_r = jnp.maximum(npl - ni, 1.0)
+    gap_l = jnp.maximum(ni - nm, 1.0)
+    qpar = qi + s / (npl - nm) * (
+        (ni - nm + s) * (qp - qi) / gap_r + (npl - ni - s) * (qi - qm) / gap_l
+    )
+    qlin = qi + s * jnp.where(s >= 0.0, (qp - qi) / gap_r, (qi - qm) / gap_l)
+    q_mid = jnp.where(
+        s != 0.0,
+        jnp.where((qm < qpar) & (qpar < qp), qpar, qlin),
+        qi,
+    )
+    q_main = qc.at[:, 1:-1].set(q_mid)
+    npos_main = npos_inc.at[:, 1:-1].add(s)
+
+    boot = count < 5
+    return (
+        jnp.where(boot, q_boot, q_main),
+        jnp.where(boot, npos, npos_main),
+    )
+
+
+def update(
+    cfg: TelemetryConfig,
+    tel: TelemetryState,
+    stats,
+    quarantined=None,
+) -> TelemetryState:
+    """Fold one control step's ``SolverStats`` (post fallback-rung
+    stamping) into the accumulator. Runs under the rollout scan — pure
+    jnp, no host round-trips. ``quarantined`` is the resilience layer's
+    sticky per-scenario flag (None in the nominal rollout)."""
+    dtype = tel.res_min.dtype
+    rung = jnp.clip(stats.fallback_rung.astype(jnp.int32), 0, N_RUNGS - 1)
+    rung_hist = tel.rung_hist + (rung == jnp.arange(N_RUNGS)).astype(jnp.int32)
+
+    # Consensus-residual stream: finite observations only (a poisoned
+    # step's inf/nan residual is already visible on the rung histogram;
+    # folding it into the percentile markers would wedge them at inf).
+    x = stats.solve_res.astype(dtype)
+    finite = jnp.isfinite(x)
+    p2_q, p2_n = _p2_update(cfg, tel.p2_q, tel.p2_n, tel.res_count, x)
+
+    na = tel.agent_fail_steps.shape[0]
+    agent_res = getattr(stats, "agent_solve_res", None)
+    if na and (agent_res is None or agent_res.shape[0] != na):
+        raise ValueError(
+            "telemetry.track_agents is on but this controller's "
+            "SolverStats carries no matching agent_solve_res — enable "
+            "track_agent_stats in the controller make_config "
+            f"(telemetry expects ({na},), stats has "
+            f"{None if agent_res is None else agent_res.shape})"
+        )
+    if na:
+        a_res = agent_res.astype(dtype)
+        a_fin = jnp.isfinite(a_res)
+        agent_fail = tel.agent_fail_steps + (
+            ~a_fin | (a_res >= cfg.solver_tol)
+        ).astype(jnp.int32)
+        agent_max = jnp.maximum(
+            tel.agent_res_max, jnp.where(a_fin, a_res, -jnp.inf)
+        )
+    else:
+        agent_fail, agent_max = tel.agent_fail_steps, tel.agent_res_max
+
+    quar = (jnp.zeros((), bool) if quarantined is None
+            else quarantined.astype(bool))
+    return TelemetryState(
+        quantiles=tel.quantiles,
+        steps=tel.steps + 1,
+        rung_hist=rung_hist,
+        iters_sum=tel.iters_sum + jnp.maximum(
+            stats.iters.astype(jnp.int32), 0
+        ),
+        ok_frac_min=jnp.minimum(
+            tel.ok_frac_min, stats.ok_frac.astype(dtype)
+        ),
+        min_env_dist=jnp.minimum(
+            tel.min_env_dist, stats.min_env_dist.astype(dtype)
+        ),
+        collision_steps=tel.collision_steps
+        + stats.collision.astype(jnp.int32),
+        quarantine_steps=tel.quarantine_steps + quar.astype(jnp.int32),
+        res_count=tel.res_count + finite.astype(jnp.int32),
+        res_min=jnp.where(
+            finite, jnp.minimum(tel.res_min, x), tel.res_min
+        ),
+        res_max=jnp.where(
+            finite, jnp.maximum(tel.res_max, x), tel.res_max
+        ),
+        res_sum=jnp.where(finite, tel.res_sum + x, tel.res_sum),
+        p2_q=jnp.where(finite, p2_q, tel.p2_q),
+        p2_n=jnp.where(finite, p2_n, tel.p2_n),
+        agent_fail_steps=agent_fail,
+        agent_res_max=agent_max,
+    )
+
+
+def find_state(tree):
+    """The first :class:`TelemetryState` inside an arbitrary carry pytree
+    (how ``resilience.recovery`` discovers telemetry in a chunk carry it
+    is otherwise generic over), or None. Works on host copies too: any
+    object of the dataclass type qualifies, whatever its leaf types."""
+    found = []
+
+    def visit(x):
+        if isinstance(x, TelemetryState):
+            found.append(x)
+            return True  # treat as leaf: do not recurse into it.
+        return False
+
+    jax.tree.flatten(tree, is_leaf=visit)
+    return found[0] if found else None
+
+
+def _lane_summaries(tel: TelemetryState) -> list[TelemetryState]:
+    """Split a BATCHED accumulator (every leaf carrying a leading
+    Monte-Carlo lane axis — the vmapped chunk carries of
+    ``parallel.mesh.scenario_rollout_resumable``) into per-lane states.
+    Host-side only."""
+    n_lanes = np.asarray(tel.steps).shape[0]
+    return [
+        jax.tree.map(lambda x, i=i: np.asarray(x)[i], tel)
+        for i in range(n_lanes)
+    ]
+
+
+def residual_percentiles(
+    tel: TelemetryState, quantiles=None
+) -> dict[str, float]:
+    """Host-side percentile estimates from the P² markers: the center
+    marker once >= 5 observations exist, exact small-sample percentiles
+    from the (sorted) bootstrap markers below that. The quantile labels
+    come from the STATE (``tel.quantiles`` — always row-aligned with
+    ``p2_q``); passing ``quantiles`` explicitly is not supported beyond
+    the state's own tuple and exists only for symmetry with summary().
+    Each quantile's estimator is independent, so small-sample estimates
+    can cross; a running max restores monotonicity for ASCENDING
+    quantiles (the config default) without biasing converged estimates."""
+    quantiles = tel.quantiles if quantiles is None else quantiles
+    if len(quantiles) != tel.p2_q.shape[0]:
+        raise ValueError(
+            f"{len(quantiles)} quantile labels for "
+            f"{tel.p2_q.shape[0]} P² marker rows — read the labels from "
+            "tel.quantiles (they are part of the state)"
+        )
+    count = int(np.asarray(tel.res_count))
+    out = {}
+    q_arr = np.asarray(tel.p2_q)
+    prev = -np.inf
+    for i, p in enumerate(quantiles):
+        key = "p%g" % (p * 100)
+        if count == 0:
+            out[key] = None
+        elif count < 5:
+            vals = q_arr[i][np.isfinite(q_arr[i])]
+            out[key] = float(np.percentile(vals, p * 100)) if len(vals) \
+                else None
+        else:
+            out[key] = float(max(q_arr[i, 2], prev))
+            prev = out[key]
+    return out
+
+
+def summary(tel: TelemetryState, cfg: TelemetryConfig | None = None) -> dict:
+    """Render an accumulator (device arrays or a host/numpy snapshot copy)
+    to the JSON-ready dict ``obs.export`` embeds in metrics events.
+    Quantile labels come from the state itself (``tel.quantiles``), so
+    readers that only hold a snapshot — ``recovery.run_chunks``' boundary
+    export — label non-default configs correctly; ``cfg`` is accepted for
+    API symmetry but never consulted for them.
+
+    A BATCHED accumulator (leading Monte-Carlo lane axis on every leaf —
+    the vmapped chunk carry of ``scenario_rollout_resumable``) rolls up
+    across lanes: counts/histograms sum, minima take the fleet min,
+    maxima the fleet max, and each percentile reports the WORST lane's
+    estimate (conservative for a health readout); ``lanes`` records the
+    batch width."""
+    del cfg
+    if np.asarray(tel.steps).ndim:
+        return _batched_summary(tel)
+    count = int(np.asarray(tel.res_count))
+    mean = float(np.asarray(tel.res_sum)) / count if count else None
+    out = {
+        "steps": int(np.asarray(tel.steps)),
+        "rung_hist": [int(v) for v in np.asarray(tel.rung_hist)],
+        "iters_sum": int(np.asarray(tel.iters_sum)),
+        "ok_frac_min": float(np.asarray(tel.ok_frac_min)),
+        "min_env_dist": float(np.asarray(tel.min_env_dist)),
+        "collision_steps": int(np.asarray(tel.collision_steps)),
+        "quarantine_steps": int(np.asarray(tel.quarantine_steps)),
+        "residual": {
+            "count": count,
+            "min": float(np.asarray(tel.res_min)) if count else None,
+            "max": float(np.asarray(tel.res_max)) if count else None,
+            "mean": mean,
+            **residual_percentiles(tel),
+        },
+    }
+    if tel.agent_fail_steps.shape[0]:
+        out["agent_fail_steps"] = [
+            int(v) for v in np.asarray(tel.agent_fail_steps)
+        ]
+        out["agent_res_max"] = [
+            float(v) for v in np.asarray(tel.agent_res_max)
+        ]
+    return out
+
+
+def _batched_summary(tel: TelemetryState) -> dict:
+    """Cross-lane roll-up of a batched accumulator (see :func:`summary`)."""
+    lanes = _lane_summaries(tel)
+    per = [summary(t) for t in lanes]
+    counts = [p["residual"]["count"] for p in per]
+    total = sum(counts)
+    out = {
+        "lanes": len(per),
+        "steps": max(p["steps"] for p in per),
+        "rung_hist": [
+            sum(p["rung_hist"][i] for p in per) for i in range(N_RUNGS)
+        ],
+        "iters_sum": sum(p["iters_sum"] for p in per),
+        "ok_frac_min": min(p["ok_frac_min"] for p in per),
+        "min_env_dist": min(p["min_env_dist"] for p in per),
+        "collision_steps": sum(p["collision_steps"] for p in per),
+        "quarantine_steps": sum(p["quarantine_steps"] for p in per),
+        "residual": {
+            "count": total,
+            "min": min(
+                (p["residual"]["min"] for p in per
+                 if p["residual"]["min"] is not None), default=None,
+            ),
+            "max": max(
+                (p["residual"]["max"] for p in per
+                 if p["residual"]["max"] is not None), default=None,
+            ),
+            "mean": (
+                sum(p["residual"]["mean"] * c
+                    for p, c in zip(per, counts) if c) / total
+                if total else None
+            ),
+            # Worst lane per quantile: conservative fleet health readout.
+            **{
+                "p%g" % (q * 100): max(
+                    (p["residual"]["p%g" % (q * 100)] for p in per
+                     if p["residual"]["p%g" % (q * 100)] is not None),
+                    default=None,
+                )
+                for q in tel.quantiles
+            },
+        },
+    }
+    if "agent_fail_steps" in per[0]:
+        na = len(per[0]["agent_fail_steps"])
+        out["agent_fail_steps"] = [
+            sum(p["agent_fail_steps"][i] for p in per) for i in range(na)
+        ]
+        out["agent_res_max"] = [
+            max(p["agent_res_max"][i] for p in per) for i in range(na)
+        ]
+    return out

@@ -57,6 +57,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpu_aerial_transport.obs import phases
+
 EQ_RHO_SCALE = 1e3  # OSQP's rho boost for equality rows.
 INF = 1e20  # "infinity" bound; keeps arithmetic finite in f32... used via clipping.
 
@@ -152,6 +154,7 @@ def n_box_p_from(m: int, m_p: int, n_box: int) -> int:
     return n_box + (m_p - m)
 
 
+@phases.scope(phases.PAD)
 def pad_qp(P, q, A, lb, ub, shift=None, *, n_box: int,
            soc_dims: Sequence[int] = ()):
     """Pad one QP to its tile bucket — EXACT in exact arithmetic, and the
@@ -197,6 +200,7 @@ def pad_qp(P, q, A, lb, ub, shift=None, *, n_box: int,
     return P_p, q_p, A_p, lb_p, ub_p, shift_p
 
 
+@phases.scope(phases.PAD)
 def pad_warm(warm: "SOCPSolution", *, n_box: int,
              soc_dims: Sequence[int] = ()) -> "SOCPSolution":
     """Lift an unpadded warm start into the padded layout (zero pad entries
@@ -219,6 +223,7 @@ def pad_warm(warm: "SOCPSolution", *, n_box: int,
     )
 
 
+@phases.scope(phases.PAD)
 def unpad_solution(sol: "SOCPSolution", nv: int, n_box: int,
                    n_box_p: int) -> "SOCPSolution":
     """Project a padded-layout solution back to the unpadded layout (drop

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_aerial_transport.control import cadmm, dd, rp_cadmm
 from tpu_aerial_transport.envs import forest as forest_mod
 from tpu_aerial_transport.models.rqp import RQPParams, RQPState
+from tpu_aerial_transport.obs import phases
 from tpu_aerial_transport.utils import compat
 
 
@@ -65,7 +66,11 @@ def _sharded_control(mesh: Mesh, axis: str, n: int, state_spec,
         check_vma=False,
     )
     def step(ctrl_state, state, acc_des):
-        return control_fn(ctrl_state, state, acc_des)
+        # Coarse attribution scope: the controllers' fine-grained tat.*
+        # scopes live inside control_fn and (being innermost) win; this
+        # one catches the shard_map plumbing around them.
+        with phases.scope(phases.SHARDED_STEP):
+            return control_fn(ctrl_state, state, acc_des)
 
     return step
 
@@ -220,6 +225,7 @@ def scenario_rollout_resumable(
     keep_last: int = 3,
     max_retries: int = 1,
     meta: dict | None = None,
+    metrics=None,
 ):
     """Preemption-safe serving twin of :func:`scenario_rollout`: the sharded
     Monte-Carlo batch rollout split into chunks, with the BATCHED carry
@@ -244,9 +250,24 @@ def scenario_rollout_resumable(
     boundary from ``run_dir`` (``batch_carry`` then being the
     deterministically regenerated chunk-0 batch carry / template). The
     jitted batched chunk is exposed as ``run.batched_jit``.
+
+    ``metrics`` (an ``obs.export.MetricsWriter`` or jsonl path) turns on
+    the per-boundary flight-recorder export — see
+    ``resilience.recovery.run_chunks``.
     """
     from tpu_aerial_transport.resilience import recovery
 
+    if n_hl_steps % n_chunks:
+        # RunPlan.chunk_len is a floor division: an uneven split would
+        # feed chunk_index_offset a chunk_len that disagrees with the
+        # chunk_fn's compiled static length, silently overlapping global
+        # step indices and breaking bit-exact resume (the same invariant
+        # harness.rollout.validate_chunking enforces for the factories
+        # that build chunk_fn).
+        raise ValueError(
+            f"n_hl_steps={n_hl_steps} not divisible by n_chunks={n_chunks}"
+            " — must match the chunking the chunk_fn was built with"
+        )
     batched_jit = jax.jit(
         jax.vmap(chunk_fn, in_axes=(0, None)),
         donate_argnums=(0,) if donate else (),
@@ -267,11 +288,11 @@ def scenario_rollout_resumable(
             return recovery.resume_run(
                 run_dir, batched_jit, batch_carry,
                 config_hash=config_hash, interrupt=interrupt, place=place,
-                max_retries=max_retries,
+                max_retries=max_retries, metrics=metrics,
             )
         return recovery.run_chunks(
             plan, batched_jit, batch_carry, interrupt=interrupt,
-            place=place, max_retries=max_retries,
+            place=place, max_retries=max_retries, metrics=metrics,
         )
 
     run.batched_jit = batched_jit

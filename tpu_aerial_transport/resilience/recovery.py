@@ -38,9 +38,9 @@ is needed.
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
 import signal
+import time
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +51,8 @@ from tpu_aerial_transport.harness.rollout import (
     chunk_index_offset,
     concat_chunk_logs,
 )
+from tpu_aerial_transport.obs import export as export_mod
+from tpu_aerial_transport.obs import telemetry as telemetry_mod
 
 JOURNAL_SCHEMA = 1
 CARRY_PREFIX = "carry"
@@ -120,22 +122,15 @@ class RunJournal:
 
     def append(self, event: dict) -> None:
         os.makedirs(self.run_dir, exist_ok=True)
-        with open(self.path, "a", encoding="utf-8") as fh:
-            fh.write(json.dumps(event) + "\n")
-            fh.flush()
-            os.fsync(fh.fileno())
+        # The durable-append + torn-tail-tolerant-read primitives are
+        # shared with the metrics writer (obs.export) — one copy of the
+        # durability contract.
+        export_mod.jsonl_append(self.path, event)
 
     def read(self) -> list[dict]:
         if not self.exists():
             return []
-        out: list[dict] = []
-        with open(self.path, encoding="utf-8") as fh:
-            for line in fh:
-                try:
-                    out.append(json.loads(line))
-                except ValueError:
-                    continue  # torn tail from a crash mid-append.
-        return out
+        return export_mod.jsonl_read(self.path)
 
     def completed_chunks(self) -> set[int]:
         return {e["chunk"] for e in self.read() if e.get("event") == "chunk"}
@@ -204,6 +199,7 @@ def run_chunks(
     place=None,
     max_retries: int = 0,
     resumed_from_chunk: int | None = None,
+    metrics: "export_mod.MetricsWriter | str | None" = None,
 ) -> RunResult:
     """Drive ``chunk_jit(carry, i0) -> (carry, logs)`` from ``start_chunk``
     to ``plan.n_chunks``, snapshotting the carry and the chunk's logs at
@@ -217,6 +213,15 @@ def run_chunks(
     the last boundary's HOST copy — donation may have consumed the device
     buffers of the failed call, the host copy survives.
 
+    ``metrics`` (optional; an ``obs.export.MetricsWriter`` or a jsonl
+    path) turns on the flight-recorder export: one schema-versioned
+    ``chunk`` event per boundary carrying the chunk wall time, a digest of
+    the chunk's logs, and — when the carry threads an
+    ``obs.telemetry.TelemetryState`` (the ``telemetry=`` option of the
+    chunked-rollout factories) — the cumulative run-health summary; plus
+    ``retry``/``preempted``/``done`` events. ``tools/run_health.py``
+    renders the file.
+
     Carry snapshots are pruned to ``plan.keep_last``; per-chunk log
     snapshots are kept for ALL chunks (the full trajectory must be
     reconstructable) and are only removed by the operator deleting the run
@@ -224,6 +229,14 @@ def run_chunks(
     """
     journal = RunJournal(plan.run_dir)
     os.makedirs(plan.run_dir, exist_ok=True)
+    if isinstance(metrics, str):
+        metrics = export_mod.MetricsWriter(metrics)
+    if metrics is not None and start_chunk == 0:
+        metrics.emit(
+            "run_start", run_dir=plan.run_dir,
+            n_hl_steps=plan.n_hl_steps, n_chunks=plan.n_chunks,
+            seed=plan.seed, config_hash=plan.config_hash, meta=plan.meta,
+        )
     if start_chunk == 0 and not any(
         e.get("event") == "run_start" for e in journal.read()
     ):
@@ -261,6 +274,10 @@ def run_chunks(
                 "event": "preempted", "chunk": c,
                 "signal": interrupt.triggered,
             })
+            if metrics is not None:
+                metrics.emit(
+                    "preempted", chunk=c, signal=interrupt.triggered
+                )
             return RunResult(
                 carry=carry,
                 logs=(concat_chunk_logs(logs_chunks, plan.logs_time_axis)
@@ -270,6 +287,7 @@ def run_chunks(
                 retries=retries_total,
             )
         try:
+            t0 = time.perf_counter()
             new_carry, logs = chunk_jit(
                 carry, chunk_index_offset(c, plan.chunk_len)
             )
@@ -282,6 +300,7 @@ def run_chunks(
             new_carry_host = jax.tree.map(
                 lambda l: np.array(l, copy=True), new_carry
             )
+            wall_s = time.perf_counter() - t0  # host copy = device sync.
             checkpoint.save_snapshot(
                 plan.run_dir, c, new_carry_host, prefix=CARRY_PREFIX,
                 config_hash=plan.config_hash, keep_last=plan.keep_last,
@@ -305,6 +324,11 @@ def run_chunks(
                 "event": "retry", "chunk": c, "attempt": attempt,
                 "error": f"{type(e).__name__}: {e}"[:300],
             })
+            if metrics is not None:
+                metrics.emit(
+                    "retry", chunk=c, attempt=attempt,
+                    error=f"{type(e).__name__}: {e}"[:300],
+                )
             carry = jax.tree.map(jnp.asarray, carry_host)
             carry = place(carry) if place is not None else carry
             continue
@@ -316,12 +340,25 @@ def run_chunks(
             ),
             "retries": attempt,
         })
+        if metrics is not None:
+            # The telemetry accumulator (if the chunk carry threads one) is
+            # CUMULATIVE over the run — the last chunk event holds the
+            # whole-run summary; the logs digest covers THIS chunk only.
+            tel = telemetry_mod.find_state(new_carry_host)
+            metrics.emit(
+                "chunk", chunk=c, wall_s=wall_s, retries=attempt,
+                step_end=(c + 1) * plan.chunk_len,
+                telemetry=export_mod.telemetry_event(tel),
+                logs=_logs_digest(logs),
+            )
         logs_chunks.append(logs)
         carry = new_carry
         carry_host = new_carry_host  # boundary published: advance the anchor.
         c += 1
         attempt = 0
     journal.append({"event": "done", "chunks": plan.n_chunks})
+    if metrics is not None:
+        metrics.emit("done", chunks=plan.n_chunks)
     return RunResult(
         carry=carry,
         logs=(concat_chunk_logs(logs_chunks, plan.logs_time_axis)
@@ -330,6 +367,19 @@ def run_chunks(
         resumed_from_chunk=resumed_from_chunk,
         retries=retries_total,
     )
+
+
+def _logs_digest(logs) -> dict | None:
+    """Per-chunk log digest for the metrics export, None when the chunk's
+    logs are not rollout-shaped (``run_chunks`` is generic over the chunk
+    function — bench sweeps and custom chunk drivers pass other pytrees)."""
+    if not all(
+        hasattr(logs, k)
+        for k in ("fallback_rung", "solve_res", "min_env_dist",
+                  "collision", "quarantined")
+    ):
+        return None
+    return export_mod.logs_summary(logs)
 
 
 def resume_run(
@@ -341,6 +391,7 @@ def resume_run(
     interrupt: GracefulInterrupt | None = None,
     place=None,
     max_retries: int = 0,
+    metrics: "export_mod.MetricsWriter | str | None" = None,
 ) -> RunResult:
     """Resume a journaled run from its newest fully-valid boundary.
 
@@ -405,8 +456,15 @@ def resume_run(
         "event": "resume", "start_chunk": start_chunk,
         "skipped": skipped[:8],
     })
+    if isinstance(metrics, str):
+        metrics = export_mod.MetricsWriter(metrics)
+    if metrics is not None:
+        metrics.emit(
+            "resume", start_chunk=start_chunk, skipped=skipped[:8]
+        )
     return run_chunks(
         plan, chunk_jit, carry, start_chunk=start_chunk,
         prior_logs=prior_logs, interrupt=interrupt, place=place,
         max_retries=max_retries, resumed_from_chunk=start_chunk,
+        metrics=metrics,
     )

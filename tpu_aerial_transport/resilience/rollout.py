@@ -42,6 +42,8 @@ from jax import lax
 from tpu_aerial_transport.control import centralized
 from tpu_aerial_transport.harness.rollout import RQPLogStep
 from tpu_aerial_transport.models import rqp
+from tpu_aerial_transport.obs import phases
+from tpu_aerial_transport.obs import telemetry as telemetry_mod
 from tpu_aerial_transport.resilience import faults as faults_mod
 from tpu_aerial_transport.resilience.quarantine import (
     tree_all_finite,
@@ -106,14 +108,16 @@ def init_resilient_carry(
     state0: rqp.RQPState,
     ctrl_state0,
     faults: faults_mod.FaultSchedule | None = None,
+    telemetry: "telemetry_mod.TelemetryConfig | None" = None,
 ):
     """The full :func:`resilient_rollout` scan carry — ``(state, ctrl_state,
-    prev_applied_force, sticky_quarantine_flag)`` — for a fresh run.
-    Surfacing it (rather than keeping it internal to the scan) is what makes
-    the fault-aware rollout chunkable: a snapshot of this tuple at a chunk
-    boundary captures the fallback ladder's hold force and the sticky
-    quarantine flag bit-exactly, so a resumed run cannot silently un-freeze
-    a quarantined lane or re-seed a poisoned warm start."""
+    prev_applied_force, sticky_quarantine_flag[, telemetry_state])`` — for
+    a fresh run. Surfacing it (rather than keeping it internal to the scan)
+    is what makes the fault-aware rollout chunkable: a snapshot of this
+    tuple at a chunk boundary captures the fallback ladder's hold force,
+    the sticky quarantine flag, and the run-health accumulator bit-exactly,
+    so a resumed run cannot silently un-freeze a quarantined lane, re-seed
+    a poisoned warm start, or forget its telemetry."""
     active = faults is not None and faults.active
     if active and hasattr(hl_step, "prepare_ctrl_state"):
         # Controller adapters seed resilience-only state carries (e.g. the
@@ -122,11 +126,16 @@ def init_resilient_carry(
         ctrl_state0 = hl_step.prepare_ctrl_state(ctrl_state0)
     n = params.n
     dtype = state0.xl.dtype
-    return (
+    carry = (
         state0, ctrl_state0,
         jnp.full((n, 3), jnp.nan, dtype),  # no previous force yet.
         jnp.zeros((), bool),
     )
+    if telemetry is not None and telemetry.active:
+        carry = carry + (
+            telemetry_mod.init_telemetry(telemetry, n, dtype),
+        )
+    return carry
 
 
 def resilient_rollout(
@@ -143,6 +152,7 @@ def resilient_rollout(
     carry0=None,
     step_offset=0,
     return_carry: bool = False,
+    telemetry: "telemetry_mod.TelemetryConfig | None" = None,
 ):
     """Run ``n_hl_steps`` high-level control periods with fault injection,
     the fallback ladder, and NaN quarantine.
@@ -166,15 +176,23 @@ def resilient_rollout(
         identical faults).
       return_carry: return ``(carry, logs)`` instead of unpacking — the
         uniform chunk contract ``resilience.recovery`` snapshots.
+      telemetry: optional :class:`obs.telemetry.TelemetryConfig`; when
+        active the run-health accumulator rides the carry (see
+        :func:`init_resilient_carry`) and is updated each step with the
+        post-ladder stats (so the rung histogram counts the ladder's
+        rungs) and the sticky quarantine flag. ``None``/inactive compiles
+        the identical telemetry-less program (tests/test_telemetry.py).
 
     Returns ``(final_state, final_ctrl_state, logs: RQPLogStep)`` (or
-    ``(carry, logs)``); the sticky quarantine flag is ``logs.quarantined``
-    (last entry = final).
+    ``(carry, logs)``; with telemetry active and ``return_carry=False``
+    the final ``TelemetryState`` is appended as a fourth value); the
+    sticky quarantine flag is ``logs.quarantined`` (last entry = final).
     """
     active = faults is not None and faults.active
+    tel_on = telemetry is not None and telemetry.active
     if carry0 is None:
         carry0 = init_resilient_carry(
-            hl_step, params, state0, ctrl_state0, faults
+            hl_step, params, state0, ctrl_state0, faults, telemetry
         )
     if acc_des_fn is None:
         if state0 is None:
@@ -193,19 +211,27 @@ def resilient_rollout(
     f_eq_full = centralized.equilibrium_forces(params)
 
     def hl_body(carry, i):
-        state, cs, prev_f, quar = carry
+        if tel_on:
+            state, cs, prev_f, quar, tel = carry
+        else:
+            state, cs, prev_f, quar = carry
         t = i * hl_rel_freq * dt
         if active:
-            health = faults_mod.fault_step(faults, i)
-            # faults.noisy is static: noise-free schedules (agent kill /
-            # dropout only) skip the per-step RNG draws at trace time.
-            sensed = (faults_mod.apply_sensor_noise(faults, i, state)
-                      if faults.noisy else state)
-            # The rung-3 fallback needs the healthy-mask equilibrium even
-            # though the hl_step adapters compute their own copy — a pinv
-            # of a 3 x n wrench matrix, noise next to one agent QP solve,
-            # accepted to keep the hl_step protocol controller-agnostic.
-            f_eq_t = centralized.equilibrium_forces(params, health.alive)
+            with phases.scope(phases.FAULTS):
+                health = faults_mod.fault_step(faults, i)
+                # faults.noisy is static: noise-free schedules (agent kill
+                # / dropout only) skip the per-step RNG draws at trace
+                # time.
+                sensed = (faults_mod.apply_sensor_noise(faults, i, state)
+                          if faults.noisy else state)
+                # The rung-3 fallback needs the healthy-mask equilibrium
+                # even though the hl_step adapters compute their own copy
+                # — a pinv of a 3 x n wrench matrix, noise next to one
+                # agent QP solve, accepted to keep the hl_step protocol
+                # controller-agnostic.
+                f_eq_t = centralized.equilibrium_forces(
+                    params, health.alive
+                )
         else:
             health = None
             sensed = state
@@ -214,34 +240,36 @@ def resilient_rollout(
         f_des, cs_new, stats = hl_step(cs, sensed, acc_des, health)
 
         # --- Fallback ladder (rungs 0-3, module docstring). ---
-        finite_f = jnp.all(jnp.isfinite(f_des))
-        if active:
-            prev_hold = prev_f * health.alive.astype(dtype)[:, None]
-        else:
-            prev_hold = prev_f
-        prev_ok = jnp.all(jnp.isfinite(prev_hold))
-        retried = stats.ok_frac < 1.0
-        if active:
-            # Consensus blackout: no alive agent delivered a message this
-            # step, so the masked consensus residual is vacuously 0 and the
-            # controller exits immediately on held values — a degraded
-            # step, not a clean one. Surface it on the retry rung so
-            # solve_res=0 steps cannot read as the healthiest in the run.
-            retried = retried | ~jnp.any(health.alive & health.msg_ok)
-        # jnp.where does not propagate NaNs from the unselected branch in
-        # the primal computation, so the nested select is NaN-safe.
-        f_used = jnp.where(
-            finite_f, f_des, jnp.where(prev_ok, prev_hold, f_eq_t)
-        )
-        rung = jnp.where(
-            finite_f,
-            jnp.where(retried, RUNG_RETRY, RUNG_CLEAN),
-            jnp.where(prev_ok, RUNG_HOLD, RUNG_EQUILIBRIUM),
-        ).astype(jnp.int32)
-        stats = stats.replace(fallback_rung=rung)
-        # A poisoned solve must not seed the next warm start: keep the new
-        # controller state only while it is entirely finite.
-        cs_next = tree_where(tree_all_finite(cs_new), cs_new, cs)
+        with phases.scope(phases.FALLBACK):
+            finite_f = jnp.all(jnp.isfinite(f_des))
+            if active:
+                prev_hold = prev_f * health.alive.astype(dtype)[:, None]
+            else:
+                prev_hold = prev_f
+            prev_ok = jnp.all(jnp.isfinite(prev_hold))
+            retried = stats.ok_frac < 1.0
+            if active:
+                # Consensus blackout: no alive agent delivered a message
+                # this step, so the masked consensus residual is vacuously
+                # 0 and the controller exits immediately on held values —
+                # a degraded step, not a clean one. Surface it on the
+                # retry rung so solve_res=0 steps cannot read as the
+                # healthiest in the run.
+                retried = retried | ~jnp.any(health.alive & health.msg_ok)
+            # jnp.where does not propagate NaNs from the unselected branch
+            # in the primal computation, so the nested select is NaN-safe.
+            f_used = jnp.where(
+                finite_f, f_des, jnp.where(prev_ok, prev_hold, f_eq_t)
+            )
+            rung = jnp.where(
+                finite_f,
+                jnp.where(retried, RUNG_RETRY, RUNG_CLEAN),
+                jnp.where(prev_ok, RUNG_HOLD, RUNG_EQUILIBRIUM),
+            ).astype(jnp.int32)
+            stats = stats.replace(fallback_rung=rung)
+            # A poisoned solve must not seed the next warm start: keep the
+            # new controller state only while it is entirely finite.
+            cs_next = tree_where(tree_all_finite(cs_new), cs_new, cs)
 
         def ll_body(s, _):
             if active:
@@ -250,13 +278,15 @@ def resilient_rollout(
                 f, M = ll_control(s, f_used)
             return rqp.integrate(params, s, (f, M), dt), None
 
-        new_state, _ = lax.scan(ll_body, state, None, length=hl_rel_freq)
+        with phases.scope(phases.DYNAMICS):
+            new_state, _ = lax.scan(ll_body, state, None, length=hl_rel_freq)
 
         # --- Per-scenario NaN quarantine (sticky). ---
-        quar_new = quar | ~tree_all_finite(new_state)
-        new_state = tree_where(quar_new, state, new_state)
-        cs_next = tree_where(quar_new, cs, cs_next)
-        prev_next = jnp.where(quar_new, prev_f, f_used)
+        with phases.scope(phases.FALLBACK):
+            quar_new = quar | ~tree_all_finite(new_state)
+            new_state = tree_where(quar_new, state, new_state)
+            cs_next = tree_where(quar_new, cs, cs_next)
+            prev_next = jnp.where(quar_new, prev_f, f_used)
 
         log = RQPLogStep(
             xl=new_state.xl,
@@ -275,6 +305,12 @@ def resilient_rollout(
             fallback_rung=stats.fallback_rung,
             quarantined=quar_new,
         )
+        if tel_on:
+            with phases.scope(phases.TELEMETRY):
+                tel = telemetry_mod.update(
+                    telemetry, tel, stats, quarantined=quar_new
+                )
+            return (new_state, cs_next, prev_next, quar_new, tel), log
         return (new_state, cs_next, prev_next, quar_new), log
 
     steps = jnp.arange(n_hl_steps)
@@ -283,6 +319,9 @@ def resilient_rollout(
     carry, logs = lax.scan(hl_body, carry0, steps)
     if return_carry:
         return carry, logs
+    if tel_on:
+        state, cs, _, _, tel = carry
+        return state, cs, logs, tel
     state, cs, _, _ = carry
     return state, cs, logs
 
@@ -298,17 +337,20 @@ def jit_resilient_rollout(
     acc_des_fn: Callable | None = None,
     faults: faults_mod.FaultSchedule | None = None,
     donate: bool = True,
+    telemetry: "telemetry_mod.TelemetryConfig | None" = None,
 ):
     """Donation-clean jitted :func:`resilient_rollout` (the fault-aware twin
     of ``harness.rollout.jit_rollout``): ``run(state0, ctrl_state0)`` with
     both carries donated. Note the ``prepare_ctrl_state`` seeding happens
     INSIDE the jitted program, so the ctrl-state argument is always the
     nominal pytree — callers chain ``state, cs, logs = run(state, cs)``
-    without tracking the resilience-only carry fields."""
+    without tracking the resilience-only carry fields. With telemetry
+    active the run returns a fourth value (the final accumulator)."""
     def run(state0, ctrl_state0):
         return resilient_rollout(
             hl_step, ll_control, params, state0, ctrl_state0,
             n_hl_steps, hl_rel_freq, dt, acc_des_fn, faults,
+            telemetry=telemetry,
         )
 
     return jax.jit(run, donate_argnums=(0, 1) if donate else ())
@@ -326,6 +368,7 @@ def make_chunked_resilient_rollout(
     acc_des_fn: Callable,
     faults: faults_mod.FaultSchedule | None = None,
     donate: bool = False,
+    telemetry: "telemetry_mod.TelemetryConfig | None" = None,
 ):
     """Fault-aware twin of ``harness.rollout.make_chunked_rollout``: the
     resilient rollout split into ``n_chunks`` chunks reusing ONE compiled
@@ -355,12 +398,13 @@ def make_chunked_resilient_rollout(
             hl_step, ll_control, params, None, None, chunk_len,
             hl_rel_freq, dt, acc_des_fn, faults,
             carry0=carry, step_offset=i0, return_carry=True,
+            telemetry=telemetry,
         )
 
     return make_chunk_driver(
         chunk, n_chunks=n_chunks, chunk_len=chunk_len,
         init_carry=lambda state0, ctrl_state0: init_resilient_carry(
-            hl_step, params, state0, ctrl_state0, faults
+            hl_step, params, state0, ctrl_state0, faults, telemetry
         ),
         unpack=lambda carry: (carry[0], carry[1]), donate=donate,
     )
