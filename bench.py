@@ -42,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -121,36 +122,22 @@ def _probe_backend() -> tuple[bool, str]:
     """Subprocess-watchdogged backend probe (no printing, no exiting):
     ``(True, platform)`` when a backend answered, ``(False, error)``
     otherwise. See :func:`ensure_backend_or_die` for why the probe exists
-    and why it runs in a subprocess."""
-    code = (
-        "import os, jax\n"
-        "envp = os.environ.get('JAX_PLATFORMS')\n"
-        "if envp: jax.config.update('jax_platforms', envp)\n"
-        "d = jax.devices()\n"
-        "print('BACKEND_OK', d[0].platform, len(d))"
-    )
+    and why it runs in a subprocess.
+
+    Delegates to ``resilience.backend.probe_subprocess``, which warms a
+    REAL device computation (matmul + an explicit ``convert_element_type``
+    round-trip) rather than just ``jax.devices()`` — round 2's probe
+    passed on backend enumeration while the first dispatched op raised the
+    lazy backend-init ``UNAVAILABLE`` (BENCH_r02.json); a probe "pass" now
+    implies the first real dispatch succeeds."""
+    from tpu_aerial_transport.resilience import backend as backend_mod
+
     errors = []
     for attempt in range(PROBE_ATTEMPTS):
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-                env=dict(os.environ),
-            )
-        except subprocess.TimeoutExpired:
-            errors.append(
-                f"attempt {attempt + 1}: backend probe timed out after "
-                f"{PROBE_TIMEOUT_S}s (chip unreachable/wedged)"
-            )
-            continue
-        token = [ln for ln in proc.stdout.splitlines()
-                 if ln.startswith("BACKEND_OK")]
-        if proc.returncode == 0 and token:
-            return True, token[0].split()[1]
-        tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
-        errors.append(
-            f"attempt {attempt + 1}: rc={proc.returncode}: " + " | ".join(tail)
-        )
+        ok, detail = backend_mod.probe_subprocess(timeout_s=PROBE_TIMEOUT_S)
+        if ok:
+            return True, detail
+        errors.append(f"attempt {attempt + 1}: {detail}")
     return False, " ;; ".join(errors)
 
 
@@ -797,7 +784,7 @@ def _write_json_atomic(path: str, payload) -> None:
     os.replace(tmp, path)
 
 
-def sweep(resume: bool = False):
+def sweep(resume: bool = False, platform: str | None = None):
     """Full BASELINE.json matrix. Each measured config ("chunk" of the
     sweep) is journaled to ``BENCH_SWEEP_JOURNAL.jsonl`` (the same
     append-only fsync'd jsonl ``resilience.recovery`` uses for rollout
@@ -813,6 +800,7 @@ def sweep(resume: bool = False):
     the final JSON line (tools/bench_retry.py passes ``--resume`` on
     retry attempts and forwards the field)."""
     from tpu_aerial_transport.obs import export as export_mod
+    from tpu_aerial_transport.resilience import backend as backend_mod
     from tpu_aerial_transport.resilience.recovery import RunJournal
 
     head = _git_head()
@@ -892,6 +880,56 @@ def sweep(resume: bool = False):
         _write_json_atomic(SWEEP_PARTIAL_PATH, results)
         print(f"# {key}: {value}", flush=True)
 
+    # Backend guard (resilience.backend): every cell's compile+measure
+    # runs under a deadline watchdog; classified infra failures (wedge,
+    # init, crash, oom) trip the per-backend circuit breaker and the cell
+    # re-runs on the tagged XLA-CPU rung — the sweep CONTINUES and each
+    # cell records the rung it actually ran at, instead of a wedged chip
+    # eating the round (the r03-r05 failure mode). backend_event rows land
+    # in both the sweep journal (resume keeps them) and the metrics file.
+    # The primary rung comes from the (subprocess-watchdogged) probe that
+    # ensure_backend already ran — resolving it via jax.default_backend()
+    # here would be the first IN-PROCESS backend init, unwatchdogged on
+    # this thread (the guard only pays that inside run()'s deadline).
+    guard = backend_mod.BackendGuard(
+        metrics=metrics, journal=journal,
+        primary_rung=(None if platform is None else
+                      backend_mod.RUNG_CPU if platform == "cpu"
+                      else backend_mod.RUNG_ONCHIP),
+    )
+
+    # Test/debug hook: TAT_SWEEP_CELLS=<regex> restricts which cells run
+    # (the fault-injection end-to-end test sweeps a cheap subset; a human
+    # debugging one cell re-measures just it).
+    cells_spec = os.environ.get("TAT_SWEEP_CELLS", "")
+    cells_pat = re.compile(cells_spec) if cells_spec else None
+
+    def want(key: str) -> bool:
+        return cells_pat is None or bool(cells_pat.search(key))
+
+    def guarded_cell(key, fn, *args, unpadded=False, **kw):
+        """Measure one cell through the guard; the returned value dict
+        carries ``rung`` (on-chip / on-chip-unpadded / cpu-tagged)."""
+        rung = None
+        if unpadded and guard.primary_rung == backend_mod.RUNG_ONCHIP:
+            rung = backend_mod.RUNG_ONCHIP_UNPADDED
+        value, ran_at = guard.run(
+            key, lambda: fn(*args, **kw),
+            fallback_fn=backend_mod.run_on_cpu(lambda: fn(*args, **kw)),
+            rung=rung,
+        )
+        return {**value, "rung": ran_at}
+
+    def _batched_cell(kw) -> dict:
+        rate = _batched(kw["controller"], kw["n"], kw["n_scenarios"],
+                        socp_fused=kw.get("socp_fused", "auto"),
+                        buckets=kw.get("buckets", 0),
+                        inner_tol=kw.get("inner_tol", 0.0),
+                        substep_unroll=kw.get("substep_unroll", 1),
+                        pad_operators=kw.get("pad_operators"))
+        return {"scenario_mpc_steps_per_sec": rate,
+                "agent_mpc_steps_per_sec": rate * kw["n"]}
+
     # The round-5 A/B cells run FIRST: if the tunnel dies mid-sweep,
     # the checkpoint must already hold the cells that decide this
     # round's default flips (fused/buckets/inner_tol/unroll), not
@@ -950,56 +988,56 @@ def sweep(resume: bool = False):
         for key, kw in ab_cells:
             # An "error" cell is retried on --resume (unlike a measured one):
             # a transient tunnel death must not be checkpointed as a result.
-            if key in results and "error" not in results[key]:
+            if not want(key) or (key in results
+                                 and "error" not in results[key]):
                 continue
             try:
-                rate = _batched(kw["controller"], kw["n"], kw["n_scenarios"],
-                                socp_fused=kw.get("socp_fused", "auto"),
-                                buckets=kw.get("buckets", 0),
-                                inner_tol=kw.get("inner_tol", 0.0),
-                                substep_unroll=kw.get("substep_unroll", 1),
-                                pad_operators=kw.get("pad_operators"))
-                record(key, {"scenario_mpc_steps_per_sec": rate,
-                             "agent_mpc_steps_per_sec": rate * kw["n"]})
+                record(key, guarded_cell(
+                    key, _batched_cell, kw,
+                    unpadded=kw.get("pad_operators") is False,
+                ))
             except Exception as e:
-                # Keep going: a Pallas lowering failure IS a result for its
-                # cell and must not kill the scan/bucket cells after it.
+                # Keep going: a Pallas lowering failure that ALSO fails on
+                # the CPU rung IS a result for its cell and must not kill
+                # the scan/bucket cells after it.
                 record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
 
     # MPC steps/sec/chip at N in {4, 16, 64} for all three controllers.
     for ctrl in ("centralized", "cadmm", "dd"):
         for n in (4, 16, 64):
             key = f"{ctrl}_n{n}_single"
-            if key in results:
+            if key in results or not want(key):
                 continue
-            record(key, _single_stream(ctrl, n))
+            record(key, guarded_cell(key, _single_stream, ctrl, n))
     # Measured per-consensus-iteration latency (differenced fixed-iteration
     # runs; see _measured_iter_ms — VERDICT r3 item 7).
     for ctrl in ("cadmm", "dd"):
         for n in (4, 16, 64):
             key = f"{ctrl}_n{n}_iter_latency"
-            if key in results:
+            if key in results or not want(key):
                 continue
-            record(key, _measured_iter_ms(ctrl, n))
+            record(key, guarded_cell(key, _measured_iter_ms, ctrl, n))
     # Batched throughput (the TPU's actual operating point) at the same Ns.
     for ctrl in ("cadmm", "dd"):
         for n, ns in ((4, 256), (16, 128), (64, 64)):
             key = f"{ctrl}_n{n}_batch{ns}"
-            if key in results:
+            if key in results or not want(key):
                 continue
-            rate = _batched(ctrl, n, ns)
-            record(key, {"scenario_mpc_steps_per_sec": rate,
-                         "agent_mpc_steps_per_sec": rate * n})
+            record(key, guarded_cell(
+                key, _batched_cell,
+                dict(controller=ctrl, n=n, n_scenarios=ns),
+            ))
     # Swarm (BASELINE.json config 5): 128 payloads x 8 quads = 1024 agents.
-    if "swarm_128x8" not in results:
-        rate = _batched("cadmm", 8, 128)
-        record("swarm_128x8", {"scenario_mpc_steps_per_sec": rate,
-                               "agent_mpc_steps_per_sec": rate * 8})
+    if "swarm_128x8" not in results and want("swarm_128x8"):
+        record("swarm_128x8", guarded_cell(
+            "swarm_128x8", _batched_cell,
+            dict(controller="cadmm", n=8, n_scenarios=128),
+        ))
     # North-star ratio (BASELINE.json): TPU throughput vs the reference-
     # architecture CPU baseline at 64 agents.
     for n, ns in ((8, 256), (64, 64)):
         ns_key = f"north_star_n{n}"
-        if ns_key in results:
+        if ns_key in results or not want(ns_key):
             continue
         try:
             ref = ref_arch_cpu_rate(n=n, n_steps=3)
@@ -1009,13 +1047,20 @@ def sweep(resume: bool = False):
         if ref:
             key = f"cadmm_n{n}_batch{ns}"
             if key in results:
-                tpu = results[key]["scenario_mpc_steps_per_sec"]
+                src = results[key]
             else:
-                tpu = _batched("cadmm", n, ns)
+                src = guarded_cell(
+                    ns_key, _batched_cell,
+                    dict(controller="cadmm", n=n, n_scenarios=ns),
+                )
+            tpu = src["scenario_mpc_steps_per_sec"]
             record(ns_key, {
                 "tpu_scenario_mpc_steps_per_sec": tpu,
                 "ref_arch_cpu_mpc_steps_per_sec": ref,
                 "ratio": tpu / ref,
+                # The rung the numerator ACTUALLY ran at: a cpu-tagged
+                # rate must never be read as a TPU speedup.
+                **({"rung": src["rung"]} if "rung" in src else {}),
             })
 
     _write_json_atomic("BENCH_SWEEP.json", results)
@@ -1031,7 +1076,9 @@ def sweep(resume: bool = False):
     print("|---|---|---|---|")
     for ctrl in ("centralized", "cadmm", "dd"):
         for n in (4, 16, 64):
-            r = results[f"{ctrl}_n{n}_single"]
+            r = results.get(f"{ctrl}_n{n}_single")
+            if r is None:  # filtered out via TAT_SWEEP_CELLS.
+                continue
             lat = results.get(f"{ctrl}_n{n}_iter_latency", {})
             per_iter = lat.get("ms_per_consensus_iter_measured")
             per_iter_s = f"{per_iter:.2f}" if per_iter is not None else "—"
@@ -1503,14 +1550,16 @@ def main():
                    else "bench_roofline" if args.roofline
                    else "bench_scaling" if args.scaling
                    else HEADLINE_METRIC)
-    # The headline and the scaling table are meaningful on XLA-CPU: a
-    # wedged/absent chip produces a TAGGED cpu record instead of a
-    # null-valued error row (the BENCH_r04/r05 failure mode). The other
+    # The headline, the scaling table AND the sweep are meaningful on
+    # XLA-CPU: a wedged/absent chip produces TAGGED cpu records instead of
+    # null-valued error rows (the BENCH_r04/r05 failure mode). The sweep
+    # additionally degrades PER CELL through the backend guard — a chip
+    # that wedges mid-sweep costs one watchdog deadline per tripped cell,
+    # then the open circuit routes the rest to the CPU rung. The remaining
     # modes are chip-specific and keep the structured hard failure
     # (status=backend_unavailable).
-    cpu_fallback = args.scaling or not (
-        args.smoke or args.sweep or args.multichip or args.components
-        or args.roofline
+    cpu_fallback = args.scaling or args.sweep or not (
+        args.smoke or args.multichip or args.components or args.roofline
     )
     platform, backend_note = ensure_backend(
         metric=mode_metric, cpu_fallback=cpu_fallback
@@ -1518,7 +1567,7 @@ def main():
     if args.smoke:
         smoke()
     elif args.sweep:
-        sweep(resume=args.resume)
+        sweep(resume=args.resume, platform=platform)
     elif args.multichip:
         multichip()
     elif args.components:

@@ -1,0 +1,45 @@
+"""Seeded r02-class fixture entrypoint for the TC106 off-chip TPU
+lowering gate (analysis/contracts.py ``run_lowering_gate``).
+
+``build()`` matches the ``Contract.build`` protocol: an entrypoint whose
+program smuggles an explicit ``convert_element_type`` to float64 into the
+graph — the exact op class BENCH_r02 died under at first dispatch. Under
+``jax.experimental.enable_x64`` (the configuration in which such a bug
+actually survives canonicalization to the lowered program) the TPU-target
+StableHLO contains f64 tensor types and TC106 must fail; the ``build_ok``
+twin is the clean control. tests/test_jaxlint.py drives both, proving
+r02-class bugs are now caught off-chip, on a CPU-only host, in tier-1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build():
+    """A small 'controller step' whose accumulator is silently promoted to
+    f64 through an explicit convert_element_type (the seeded bug)."""
+
+    def fn(x):
+        acc = jax.lax.convert_element_type(x, np.dtype("float64"))
+        return jax.lax.convert_element_type(acc * 2.0 + 1.0,
+                                            jnp.float32)
+
+    def make_args():
+        return (jnp.ones((4,), jnp.float32),)
+
+    return fn, make_args
+
+
+def build_ok():
+    """Clean twin: the same computation held in f32 end to end."""
+
+    def fn(x):
+        return x * 2.0 + 1.0
+
+    def make_args():
+        return (jnp.ones((4,), jnp.float32),)
+
+    return fn, make_args

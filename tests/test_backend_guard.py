@@ -1,0 +1,480 @@
+"""Backend guard (resilience/backend.py): error-taxonomy classification,
+backoff policy, circuit-breaker state machine, deadline watchdogs,
+process-group kill, the TAT_BACKEND_FAULTS fake backend, and the
+end-to-end contract the whole PR exists for — a fault-injected
+``bench.py --sweep`` completes with exit 0, every cell tagged with the
+rung it actually ran at, a journaled ``backend_event`` trail that
+validates against the bumped metrics schema, and bounded wall time."""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_aerial_transport.obs import export as export_mod
+from tpu_aerial_transport.resilience import backend as b
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ----------------------------- taxonomy --------------------------------
+
+
+def test_classify_r02_tail_is_init_not_dtype():
+    """The BENCH_r02 tail contains BOTH convert_element_type and the
+    backend-init UNAVAILABLE; the root cause is init failure surfacing
+    lazily at first dispatch, so init patterns must win over dtype."""
+    tail = (
+        "jaxlib.xla_extension.XlaRuntimeError: UNAVAILABLE: "
+        "Unable to initialize backend 'tpu': ... (raised while executing "
+        "convert_element_type)"
+    )
+    assert b.classify(tail) == "init_unavailable"
+
+
+def test_classify_each_kind():
+    assert b.classify("watchdog: timed out waiting") == "wedge_timeout"
+    assert b.classify("RESOURCE_EXHAUSTED: failed to allocate 8G") == "oom"
+    assert b.classify("unsupported element type f64 in op") \
+        == "dtype_lowering"
+    assert b.classify("Mosaic lowering failed for fusion.3") \
+        == "compile_error"
+    assert b.classify("INTERNAL: device halt detected") == "device_crash"
+    assert b.classify(ValueError("plain code bug")) == "unknown"
+
+
+def test_classify_lowercase_status_words_are_code_bugs():
+    """Regression: device_crash anchors to the XLA/gRPC STATUS forms
+    (INTERNAL/ABORTED/DATA_LOSS, case-sensitive) — an ordinary exception
+    whose message happens to contain lowercase 'aborted'/'internal' is a
+    code bug and must classify unknown (re-raised, never degraded)."""
+    assert b.classify(
+        ValueError("aborted: plan has internal inconsistency")
+    ) == "unknown"
+
+
+def test_classify_backend_error_keeps_kind():
+    e = b.BackendError("oom", "whatever text says timed out")
+    assert b.classify(e) == "oom"
+
+
+def test_classify_unmatched_xla_runtime_error_is_device_crash():
+    """The runtime itself raising is a device problem whatever the
+    message text says."""
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert b.classify(XlaRuntimeError("gibberish nobody patterned")) \
+        == "device_crash"
+
+
+def test_backend_error_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown BackendError kind"):
+        b.BackendError("typo_kind", "detail")
+
+
+# ------------------------------ backoff --------------------------------
+
+
+def test_backoff_growth_and_cap():
+    p = b.BackoffPolicy(initial_s=10.0, factor=2.0, max_s=35.0, jitter=0.0)
+    assert [p.delay(k) for k in range(4)] == [10.0, 20.0, 35.0, 35.0]
+
+
+def test_backoff_jitter_bounded_and_seeded():
+    p = b.BackoffPolicy(initial_s=10.0, factor=2.0, max_s=600.0, jitter=0.2)
+    rng = random.Random(0)
+    ds = [p.delay(0, rng) for _ in range(100)]
+    assert all(8.0 <= d <= 12.0 for d in ds)
+    # Seeded rng => deterministic draws (tests can pin the cadence).
+    rng2 = random.Random(0)
+    assert ds == [p.delay(0, rng2) for _ in range(100)]
+
+
+# --------------------------- circuit breaker ---------------------------
+
+
+def _breaker(threshold=3, initial_s=10.0):
+    clock = [0.0]
+    cb = b.CircuitBreaker(
+        failure_threshold=threshold,
+        policy=b.BackoffPolicy(initial_s=initial_s, factor=2.0,
+                               max_s=600.0, jitter=0.0),
+        clock=lambda: clock[0],
+    )
+    return cb, clock
+
+
+def test_circuit_opens_after_k_consecutive_failures():
+    cb, _ = _breaker(threshold=3)
+    cb.record_failure("wedge_timeout")
+    cb.record_failure("wedge_timeout")
+    assert cb.state == b.CLOSED and cb.allow()
+    cb.record_failure("device_crash")
+    assert cb.state == b.OPEN and not cb.allow()
+    assert cb.cooldown_s == 10.0
+
+
+def test_circuit_success_resets_consecutive_count():
+    cb, _ = _breaker(threshold=2)
+    cb.record_failure("oom")
+    cb.record_success()
+    cb.record_failure("oom")
+    assert cb.state == b.CLOSED  # never 2 CONSECUTIVE failures.
+
+
+def test_circuit_half_open_probe_closes_on_success():
+    cb, clock = _breaker(threshold=1, initial_s=10.0)
+    cb.record_failure("wedge_timeout")
+    assert not cb.allow()
+    clock[0] = 10.0
+    assert cb.allow() and cb.state == b.HALF_OPEN
+    cb.record_success()
+    assert cb.state == b.CLOSED and cb.consecutive_failures == 0
+    assert [t["to"] for t in cb.transitions] \
+        == [b.OPEN, b.HALF_OPEN, b.CLOSED]
+
+
+def test_circuit_half_open_failure_reopens_with_longer_cooldown():
+    cb, clock = _breaker(threshold=1, initial_s=10.0)
+    cb.record_failure("wedge_timeout")
+    first_cooldown = cb.cooldown_s
+    clock[0] = 10.0
+    assert cb.allow() and cb.state == b.HALF_OPEN
+    cb.record_failure("wedge_timeout")
+    assert cb.state == b.OPEN
+    assert cb.cooldown_s == 2.0 * first_cooldown  # exponential backoff.
+    assert cb.seconds_until_half_open() == pytest.approx(20.0)
+
+
+def test_circuit_rejects_nonpositive_threshold():
+    with pytest.raises(ValueError):
+        b.CircuitBreaker(failure_threshold=0)
+
+
+# --------------------------- deadline watchdog -------------------------
+
+
+def test_deadline_passes_value_and_forwards_errors():
+    assert b.call_with_deadline(lambda: 41 + 1, 5.0) == 42
+    with pytest.raises(KeyError):
+        b.call_with_deadline(lambda: {}["missing"], 5.0)
+    # None / <=0 disables the watchdog entirely (plain call).
+    assert b.call_with_deadline(lambda: "plain", None) == "plain"
+    assert b.call_with_deadline(lambda: "plain", 0) == "plain"
+
+
+def test_deadline_converts_wedge_into_structured_timeout():
+    t0 = time.monotonic()
+    with pytest.raises(b.BackendError) as ei:
+        b.call_with_deadline(lambda: time.sleep(5.0), 0.2, label="wedged")
+    assert ei.value.kind == "wedge_timeout"
+    assert "wedged" in str(ei.value)
+    assert time.monotonic() - t0 < 3.0  # the deadline, not the sleep.
+
+
+# --------------------------- fault injector ----------------------------
+
+
+def test_fault_injector_parses_directives():
+    inj = b.FaultInjector.from_env("init_unavailable, wedge=1.5, crash@3")
+    assert inj.init_unavailable and inj.wedge_s == 1.5 and inj.crash_at == 3
+    assert inj.active
+    assert b.FaultInjector.from_env("crash@mycell").crash_label == "mycell"
+    assert not b.FaultInjector.from_env("").active
+
+
+def test_fault_injector_rejects_unknown_directive():
+    """A typo silently disabling fault injection would fake a green
+    test — parsing is strict."""
+    with pytest.raises(ValueError, match="unknown TAT_BACKEND_FAULTS"):
+        b.FaultInjector.from_env("wedg=5")
+
+
+def test_fault_injector_crash_at_nth_call():
+    inj = b.FaultInjector(crash_at=2)
+    inj.maybe_fault("a")  # call 1: clean.
+    with pytest.raises(RuntimeError, match="INTERNAL: device crashed"):
+        inj.maybe_fault("b")
+    inj.maybe_fault("c")  # call 3: clean again (one-shot crash).
+
+
+def test_fault_injector_crash_on_label():
+    inj = b.FaultInjector(crash_label="n64")
+    inj.maybe_fault("cadmm_n4_single")
+    with pytest.raises(RuntimeError, match="device crashed"):
+        inj.maybe_fault("cadmm_n64_single")
+
+
+def test_fault_injector_wedge_raises_structured_timeout():
+    inj = b.FaultInjector(wedge_s=0.01)
+    with pytest.raises(b.BackendError) as ei:
+        inj.maybe_fault("cell")
+    assert ei.value.kind == "wedge_timeout"
+
+
+# ------------------------------- guard ---------------------------------
+
+
+def _guard(**kw):
+    kw.setdefault("deadline_s", 5.0)
+    kw.setdefault("primary_rung", b.RUNG_ONCHIP)
+    kw.setdefault("faults", b.FaultInjector())
+    return b.BackendGuard(**kw)
+
+
+def test_guard_success_returns_primary_rung():
+    g = _guard()
+    value, rung = g.run("cell", lambda: 7, fallback_fn=lambda: -1)
+    assert (value, rung) == (7, b.RUNG_ONCHIP)
+    assert not g.last_fell_back and g.events == []
+
+
+def test_guard_classified_failure_falls_back_and_records():
+    g = _guard()
+
+    def dying():
+        raise RuntimeError("INTERNAL: device crashed mid-execution")
+
+    value, rung = g.run("cell", dying, fallback_fn=lambda: 42)
+    assert (value, rung) == (42, b.RUNG_CPU)
+    assert g.last_fell_back
+    kinds = [e["kind"] for e in g.events]
+    assert "device_crash" in kinds
+    assert g.breaker.consecutive_failures == 1
+
+
+def test_guard_program_bug_kinds_do_not_trip_the_breaker():
+    """compile_error / dtype_lowering indict the PROGRAM, not the chip:
+    the cell degrades but the circuit must not open (three Pallas compile
+    failures on a healthy chip must not route the sweep to CPU)."""
+    g = _guard(breaker=b.CircuitBreaker(failure_threshold=1))
+
+    def bad_program():
+        raise RuntimeError("Mosaic lowering failed for fused op")
+
+    value, rung = g.run("cell", bad_program, fallback_fn=lambda: 1)
+    assert rung == b.RUNG_CPU
+    assert g.breaker.state == b.CLOSED
+    assert g.breaker.consecutive_failures == 0
+
+
+def test_guard_unknown_error_reraises():
+    """An unclassified failure is a CODE bug — degrading to CPU would
+    only reproduce it more slowly."""
+    g = _guard()
+    with pytest.raises(ValueError, match="plain code bug"):
+        g.run("cell", lambda: (_ for _ in ()).throw(
+            ValueError("plain code bug")), fallback_fn=lambda: 0)
+    assert g.events == []
+
+
+def test_guard_open_circuit_routes_to_cpu_without_touching_primary():
+    clock = [0.0]
+    g = _guard(
+        breaker=b.CircuitBreaker(
+            failure_threshold=1,
+            policy=b.BackoffPolicy(initial_s=100.0, jitter=0.0),
+            clock=lambda: clock[0],
+        ),
+    )
+
+    def dying():
+        raise RuntimeError("INTERNAL: aborted")
+
+    g.run("c0", dying, fallback_fn=lambda: 0)
+    assert g.breaker.state == b.OPEN
+
+    touched = []
+
+    def primary():
+        touched.append(1)
+        return 1
+
+    value, rung = g.run("c1", primary, fallback_fn=lambda: 2)
+    assert (value, rung) == (2, b.RUNG_CPU) and not touched
+    assert any(e["kind"] == "circuit_routed_cpu" for e in g.events)
+    assert any(e["kind"] == "circuit_open" for e in g.events)
+    # Cooldown elapsed: the next run() is the half-open probe and a
+    # success closes the circuit again — journaled as transitions.
+    clock[0] = 100.0
+    value, rung = g.run("c2", primary, fallback_fn=lambda: 2)
+    assert (value, rung) == (1, b.RUNG_ONCHIP) and touched
+    assert g.breaker.state == b.CLOSED
+    kinds = [e["kind"] for e in g.events]
+    assert "circuit_half_open" in kinds and "circuit_closed" in kinds
+
+
+def test_guard_wedge_hits_deadline_then_falls_back_bounded():
+    g = _guard(deadline_s=0.2, faults=b.FaultInjector(wedge_s=30.0))
+    t0 = time.monotonic()
+    value, rung = g.run("cell", lambda: "never", fallback_fn=lambda: "cpu")
+    assert (value, rung) == ("cpu", b.RUNG_CPU)
+    assert time.monotonic() - t0 < 5.0  # deadline-bounded, not wedge-bound.
+    assert [e["kind"] for e in g.events
+            if not e["kind"].startswith("circuit_")] == ["wedge_timeout"]
+
+
+def test_guard_rung_resolution_is_deadline_bounded():
+    """Regression: resolving the primary rung touches
+    jax.default_backend() — the first in-process backend init, which can
+    wedge exactly like the work. It must happen INSIDE run()'s watchdog:
+    with no explicit primary_rung and a wedging primary, the guard still
+    returns within the deadline and tags the error rung 'unresolved'."""
+    g = b.BackendGuard(deadline_s=0.2,
+                       faults=b.FaultInjector(wedge_s=30.0))
+    assert g._primary_rung is None
+    t0 = time.monotonic()
+    value, rung = g.run("cell", lambda: "never", fallback_fn=lambda: "cpu")
+    assert (value, rung) == ("cpu", b.RUNG_CPU)
+    assert time.monotonic() - t0 < 5.0
+    assert g.events[0]["rung"] == "unresolved"
+    # On a healthy backend the success path resolves the real rung
+    # (inside the watchdog) — cpu-tagged on this CPU-only host.
+    g2 = b.BackendGuard(deadline_s=30.0, faults=b.FaultInjector())
+    value, rung = g2.run("cell", lambda: 1)
+    assert (value, rung) == (1, b.RUNG_CPU)
+
+
+def test_guard_no_fallback_raises_structured_backend_error():
+    g = _guard()
+    with pytest.raises(b.BackendError) as ei:
+        g.run("cell", lambda: (_ for _ in ()).throw(
+            RuntimeError("INTERNAL: aborted")))
+    assert ei.value.kind == "device_crash"
+
+
+def test_guard_emits_to_metrics_writer(tmp_path):
+    path = str(tmp_path / "g.metrics.jsonl")
+    g = _guard(metrics=export_mod.MetricsWriter(path))
+    g.run("cell", lambda: (_ for _ in ()).throw(
+        RuntimeError("INTERNAL: aborted")), fallback_fn=lambda: 0)
+    assert export_mod.validate_file(path) == []
+    events = export_mod.read_events(path)
+    be = [e for e in events if e["event"] == "backend_event"]
+    assert be and be[0]["kind"] == "device_crash" \
+        and be[0]["label"] == "cell"
+
+
+def test_default_deadline_env_parsing():
+    assert b.default_deadline_s({}) == b.DEFAULT_DEADLINE_S
+    assert b.default_deadline_s({b.DEADLINE_ENV: "12.5"}) == 12.5
+    with pytest.raises(ValueError, match="not a number"):
+        b.default_deadline_s({b.DEADLINE_ENV: "fast"})
+
+
+# --------------------------- process-group kill ------------------------
+
+
+def test_run_group_kills_whole_process_group_on_timeout(tmp_path):
+    """The r03-r05 orphan bug: a wedged child's OWN subprocess (the probe
+    it spawned, a runtime helper holding the chip) must die with it —
+    ``subprocess.run(timeout=)`` only kills the direct child."""
+    pid_file = str(tmp_path / "grandchild.pid")
+    child_code = (
+        "import subprocess, sys, time\n"
+        "p = subprocess.Popen([sys.executable, '-c',"
+        " 'import time; time.sleep(60)'])\n"
+        f"open({pid_file!r}, 'w').write(str(p.pid))\n"
+        "time.sleep(60)\n"
+    )
+    with pytest.raises(subprocess.TimeoutExpired):
+        b.run_group([sys.executable, "-c", child_code], timeout_s=10.0)
+    gpid = int(open(pid_file).read())
+    # SIGKILL is asynchronous; give the reaper a moment.
+    for _ in range(50):
+        try:
+            os.kill(gpid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        os.kill(gpid, signal.SIGKILL)  # don't leak it from the test.
+        pytest.fail("grandchild survived the group kill (orphaned)")
+
+
+def test_probe_fault_injected_init_unavailable_fails_fast():
+    t0 = time.monotonic()
+    ok, detail = b.probe_subprocess(
+        timeout_s=60.0,
+        env={**os.environ, b.FAULTS_ENV: "init_unavailable"},
+    )
+    assert not ok and "Unable to initialize backend" in detail
+    assert time.monotonic() - t0 < 2.0  # in-process, no subprocess spawned.
+
+
+def test_probe_real_cpu_backend_warms_first_dispatch():
+    """The probe must run a REAL device computation (matmul + an explicit
+    convert_element_type round-trip — the r02 op class), not just
+    enumerate devices: on this host it passes and reports the cpu
+    platform."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop(b.FAULTS_ENV, None)
+    ok, detail = b.probe_subprocess(timeout_s=120.0, env=env)
+    assert ok, detail
+    assert detail == "cpu"
+
+
+# ----------------------- end-to-end: fault-injected sweep --------------
+
+
+def test_sweep_survives_crash_and_wedge_with_tagged_cells(tmp_path):
+    """The acceptance contract: with the fake crashing+wedging backend
+    injected, ``bench.py --sweep`` exits 0, the sweep CONTINUES past the
+    faulted cells, every cell records the rung it actually ran at, the
+    ``backend_event`` trail validates against the bumped schema, and wall
+    time is bounded by the watchdog (the wedge costs one deadline, not a
+    hung round)."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # Two cheap cells: the crash fires on the first guarded call, the
+        # wedge applies to the second (crash wins before the sleep on
+        # call 1), so BOTH failure modes degrade in one sweep.
+        "TAT_SWEEP_CELLS": r"^centralized_n4_single$|^cadmm_n4_single$",
+        "TAT_BACKEND_FAULTS": "crash@1,wedge=30",
+        "TAT_BACKEND_DEADLINE_S": "0.5",
+    })
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--sweep"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        timeout=540,
+    )
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    # Bounded wall time: the wedge costs ONE 0.5 s deadline (not 30 s of
+    # sleep, not a hung round); the rest is probe + two CPU measures.
+    assert wall < 300, f"sweep took {wall:.0f}s — watchdog not bounding"
+
+    results = json.loads((tmp_path / "BENCH_SWEEP.json").read_text())
+    cells = {k: v for k, v in results.items() if not k.startswith("_")}
+    assert set(cells) == {"centralized_n4_single", "cadmm_n4_single"}
+    for key, value in cells.items():
+        assert value.get("rung") == b.RUNG_CPU, (key, value)
+        assert "error" not in value
+
+    metrics_path = tmp_path / "artifacts" / "bench_sweep.metrics.jsonl"
+    assert export_mod.validate_file(str(metrics_path)) == []
+    events = export_mod.read_events(str(metrics_path))
+    be = [e for e in events if e["event"] == "backend_event"]
+    assert sorted(e["kind"] for e in be) \
+        == ["device_crash", "wedge_timeout"]
+    assert all(e["schema"] == 2 for e in be)
+    # The resumable sweep journal (which carried the same backend_event
+    # trail mid-run) is cleaned up on success — the metrics file is the
+    # durable record.
+    assert not (tmp_path / "BENCH_SWEEP_JOURNAL.jsonl").exists()
+    assert not (tmp_path / "BENCH_SWEEP_PARTIAL.json").exists()
+
+    # run_health renders the backend-health table from the trail.
+    health = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_health.py"),
+         str(metrics_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert health.returncode == 0, health.stderr
+    assert "backend health" in health.stdout
+    assert "cpu-tagged" in health.stdout

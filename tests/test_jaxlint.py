@@ -312,3 +312,100 @@ def test_tc104_flags_long_misaligned_contraction():
     # -> exempt below MIN_ALIGNED_CONTRACT.
     c = contracts.Contract(name="test:short", build=lambda: build(12))
     assert not [f for f in contracts.check_entry(c) if f.rule == "TC104"]
+
+
+# ------------------- TC106: off-chip TPU lowering gate -----------------
+
+def test_tc106_seeded_f64_fixture_fails_offchip():
+    """The r02 acceptance contract: a seeded f64/convert_element_type
+    entrypoint must FAIL the TPU-target lowering gate on this CPU-only
+    host — the bug class that previously surfaced only at first dispatch
+    on a chip now fails tier-1 anywhere. The clean f32 twin passes."""
+    import jax
+
+    sys.path.insert(0, os.path.join(REPO, "tests", "fixtures"))
+    try:
+        import contracts_f64 as fx
+    finally:
+        sys.path.pop(0)
+
+    with jax.experimental.enable_x64():
+        seeded = contracts.Contract(name="fixture:f64_convert",
+                                    build=fx.build)
+        findings = contracts.check_entry_lowering(seeded, target="tpu")
+        assert [f.rule for f in findings] == ["TC106"]
+        assert "f64" in findings[0].message
+        clean = contracts.Contract(name="fixture:f32_clean",
+                                   build=fx.build_ok)
+        assert contracts.check_entry_lowering(clean, target="tpu") == []
+
+
+def test_tc106_lowering_failure_is_classified():
+    """A lowering EXCEPTION (not just an f64 type) is the other face of
+    the gate; the finding names the backend-error class a chip would
+    have hit at dispatch."""
+
+    def build():
+        def fn(x):
+            raise RuntimeError(
+                "Mosaic lowering failed: unsupported op"
+            )
+
+        def make_args():
+            import jax.numpy as jnp
+
+            return (jnp.ones((4,), jnp.float32),)
+
+        return fn, make_args
+
+    c = contracts.Contract(name="fixture:lowering_boom", build=build)
+    findings = contracts.check_entry_lowering(c, target="tpu")
+    assert [f.rule for f in findings] == ["TC106"]
+    assert "compile_error" in findings[0].message
+
+
+def test_tc106_fast_subset_lowers_clean_for_tpu():
+    """Solver core + consensus controller + rollout TPU-lower cleanly on
+    every tier-1 run (the full registry runs under -m slow and via
+    `tools/jaxlint.py --contracts --target tpu`)."""
+    findings = contracts.run_lowering_gate(
+        names=list(contracts.FAST_SUBSET), target="tpu"
+    )
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.slow
+def test_tc106_full_registry_lowers_for_tpu():
+    findings = contracts.run_lowering_gate(target="tpu")
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_tc106_disabled_and_waived_entries_skipped(monkeypatch):
+    boom = contracts.Contract(
+        name="fixture:waived",
+        build=lambda: (_ for _ in ()).throw(AssertionError("not built")),
+    )
+    assert contracts.check_entry_lowering(
+        boom, disabled=frozenset({"TC106"})) == []
+    monkeypatch.setitem(entrypoints.LOWERING_WAIVERS, "fixture:waived",
+                        "test waiver")
+    assert contracts.check_entry_lowering(boom) == []
+
+
+def test_lowering_waivers_reference_registered_entrypoints():
+    unknown = set(entrypoints.LOWERING_WAIVERS) - set(contracts.REGISTRY)
+    assert not unknown, f"LOWERING_WAIVERS for unknown entrypoints: {unknown}"
+
+
+def test_cli_target_tpu_mode(tmp_path):
+    """`jaxlint --target tpu --only <entry>` runs the lowering gate from
+    the CLI (tier B implied); an unknown --only name is a usage error."""
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    rc = linter.main(
+        ["--target", "tpu", "--only", "ops.socp:solve_socp", str(clean)]
+    )
+    assert rc == 0
+    rc = linter.main(["--target", "tpu", "--only", "no.such:entry",
+                      str(clean)])
+    assert rc == 1

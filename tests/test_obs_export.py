@@ -267,3 +267,43 @@ def test_run_health_validate_gate(chunked_metrics_run, tmp_path):
     )
     assert bad.returncode == 1
     assert "schema violation" in bad.stderr
+
+
+# ------------------- schema v2: backend_event vocabulary ---------------
+
+def test_backend_event_validates_at_schema_v2(tmp_path):
+    path = str(tmp_path / "be.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("backend_event", kind="wedge_timeout", label="cadmm_n64_single",
+           rung="cpu-tagged", detail="deadline exceeded")
+    assert export_mod.validate_file(path) == []
+    ev = export_mod.read_events(path)[-1]
+    assert ev["schema"] == export_mod.SCHEMA_VERSION >= 2
+
+
+def test_backend_event_requires_kind_and_label(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("backend_event", kind="oom")  # no label.
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "missing fields ['label']" in errs[0]
+
+
+def test_v1_files_remain_valid_but_not_for_backend_events(tmp_path):
+    """The bump is ADDITIVE: a v1 file written before this PR still
+    validates; a backend_event STAMPED v1 does not (the v1 reader
+    contract never defined it)."""
+    path = str(tmp_path / "old.metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "schema": 1, "event": "chunk", "ts": 0.0,
+            "chunk": 0, "wall_s": 0.1,
+        }) + "\n")
+    assert export_mod.validate_file(path) == []
+    with open(path, "a") as fh:
+        fh.write(json.dumps({
+            "schema": 1, "event": "backend_event", "ts": 0.0,
+            "kind": "oom", "label": "x",
+        }) + "\n")
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "requires schema >= 2" in errs[0]

@@ -432,3 +432,85 @@ def test_resilient_vmapped_batch_resume_with_quarantined_lane(tmp_path):
     quar = np.asarray(final_rc[3])
     assert quar[1] and not quar[[0, 2, 3]].any(), \
         "sticky quarantine flag must survive the resume bit-exactly"
+
+
+def test_run_chunks_guard_degrades_to_cpu_and_continues(tmp_path):
+    """Backend guard wired into the chunk loop: a classified device error
+    on one chunk's primary execution re-runs THAT chunk on the CPU rung
+    from the last boundary's host carry — the run CONTINUES (no host-level
+    retry consumed), every boundary records the rung it ran at, a
+    ``backend_event`` lands in both the journal and the metrics file, and
+    the completed trajectory is bit-identical to the unguarded one."""
+    from tpu_aerial_transport.resilience import backend as backend_mod
+
+    params, cfg, state0, cs0, ll, hl, acc_des_fn = _problem()
+    fs, fc, flog = _reference(params, state0, cs0, ll, hl, acc_des_fn)
+    runner = _runner(params, ll, hl, acc_des_fn)
+    d = str(tmp_path)
+    plan = recovery.RunPlan(run_dir=d, n_hl_steps=N_HL, n_chunks=CHUNKS)
+    metrics_path = str(tmp_path / "run.metrics.jsonl")
+    guard = backend_mod.BackendGuard(
+        deadline_s=300.0,
+        faults=backend_mod.FaultInjector(crash_at=2),  # 2nd chunk crashes.
+    )
+    res = recovery.run_chunks(
+        plan, runner.chunk_jit, _fresh_carry(runner, state0, cs0),
+        metrics=metrics_path, guard=guard,
+    )
+    assert res.status == "done" and res.retries == 0
+    s2, c2 = res.carry
+    _assert_trees_equal((fs, fc, flog), (s2, c2, res.logs),
+                        "after guard degradation")
+
+    events = recovery.RunJournal(d).read()
+    be = [e for e in events if e.get("event") == "backend_event"]
+    assert [e["kind"] for e in be] == ["device_crash"]
+    assert be[0]["label"] == "chunk1"
+    chunk_rungs = [e.get("rung") for e in events
+                   if e.get("event") == "chunk"]
+    # Every boundary records its rung; chunk 1 (and everything after the
+    # one-way degradation) ran on the CPU rung.
+    assert len(chunk_rungs) == CHUNKS
+    assert all(r is not None for r in chunk_rungs)
+    assert chunk_rungs[1:] == [backend_mod.RUNG_CPU] * (CHUNKS - 1)
+
+    from tpu_aerial_transport.obs import export as export_mod
+
+    assert export_mod.validate_file(metrics_path) == []
+    mev = export_mod.read_events(metrics_path)
+    assert [e["kind"] for e in mev if e["event"] == "backend_event"] \
+        == ["device_crash"]
+    assert [e.get("rung") for e in mev if e["event"] == "chunk"] \
+        == chunk_rungs
+
+
+def test_run_chunks_guard_unknown_error_still_host_retries(tmp_path):
+    """An UNCLASSIFIED chunk failure is a code bug: the guard re-raises
+    it and the pre-existing host-level retry machinery (max_retries)
+    handles it exactly as before — guard and retry tiers compose."""
+    from tpu_aerial_transport.resilience import backend as backend_mod
+
+    params, cfg, state0, cs0, ll, hl, acc_des_fn = _problem()
+    fs, fc, flog = _reference(params, state0, cs0, ll, hl, acc_des_fn)
+    runner = _runner(params, ll, hl, acc_des_fn)
+    plan = recovery.RunPlan(run_dir=str(tmp_path), n_hl_steps=N_HL,
+                            n_chunks=CHUNKS)
+    calls = {"n": 0}
+
+    def flaky_chunk(carry, i0):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("simulated transient code-path error")
+        return runner.chunk_jit(carry, i0)
+
+    guard = backend_mod.BackendGuard(
+        deadline_s=300.0, faults=backend_mod.FaultInjector()
+    )
+    res = recovery.run_chunks(
+        plan, flaky_chunk, _fresh_carry(runner, state0, cs0),
+        max_retries=1, guard=guard,
+    )
+    assert res.status == "done" and res.retries == 1
+    s2, c2 = res.carry
+    _assert_trees_equal((fs, fc, flog), (s2, c2, res.logs),
+                        "retry under guard")

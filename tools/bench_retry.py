@@ -40,7 +40,12 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
-from probe_chip import probe  # noqa: E402
+from probe_chip import _backend_mod, probe  # noqa: E402
+
+# resilience/backend.py loaded by FILE PATH (no package/jax import): the
+# group-kill subprocess runner and the circuit breaker's backoff policy —
+# ONE retry cadence for the whole stack.
+_backend = _backend_mod()
 
 
 def _utc() -> str:
@@ -79,6 +84,7 @@ def run_with_retries(
     probe_timeout_s: int = 60,
     probe_fn=probe,
     cwd: str = REPO,
+    backoff_jitter: float = 0.1,
 ) -> dict:
     """Run ``cmd`` with per-attempt chip probes, timeouts, and exponential
     backoff. Returns the structured record described in the module
@@ -100,7 +106,14 @@ def run_with_retries(
         "last_error": None,
         "result": None,
     }
-    delay = backoff_s
+    # Same backoff+jitter policy as the circuit breaker
+    # (resilience.backend.BackoffPolicy): exponential from ``backoff_s``,
+    # jittered so a fleet of retriers sharing one wedged chip
+    # decorrelates instead of thundering back in lockstep.
+    policy = _backend.BackoffPolicy(
+        initial_s=backoff_s, factor=2.0, max_s=max(backoff_s * 16, 600.0),
+        jitter=backoff_jitter,
+    )
     use_resume = False
     is_sweep = "--sweep" in cmd
 
@@ -140,10 +153,12 @@ def run_with_retries(
             t0 = time.monotonic()
             cmd_k = cmd + ["--resume"] if use_resume else cmd
             try:
-                proc = subprocess.run(
-                    cmd_k, capture_output=True, text=True, timeout=timeout_s,
-                    env=dict(os.environ), cwd=cwd,
-                )
+                # Own-session child + group SIGKILL on timeout: a wedged
+                # bench's own subprocesses (probe children, runtime
+                # helpers holding the chip) must not survive as orphans
+                # wedging every later attempt (resilience.backend
+                # run_group).
+                proc = _backend.run_group(cmd_k, timeout_s, cwd=cwd)
                 att["duration_s"] = round(time.monotonic() - t0, 1)
                 att["rc"] = proc.returncode
                 if proc.returncode == 0:
@@ -172,8 +187,7 @@ def run_with_retries(
             record["last_error"] = att["error"]
             _queue_resume()
         if k + 1 < attempts:
-            time.sleep(delay)
-            delay *= 2.0
+            time.sleep(policy.delay(k))
     return _finalize(record)
 
 

@@ -4,47 +4,48 @@ attempt record to ``artifacts/tpu_probe_log_r5.txt``.
 VERDICT r4 item 1: when the chip is wedged, the round must carry an explicit
 timestamped attempt log instead of a silent absence of numbers. Exit 0 iff
 the accelerator responded (platform != cpu).
+
+The probe body is ``resilience.backend.probe_subprocess`` (loaded by FILE
+PATH — this tool must work on hosts where importing jax is the hazard):
+subprocess-isolated cold backend init warming a REAL device computation,
+matmul + ``convert_element_type``, so a probe "pass" implies the first
+real dispatch cannot raise the lazy-init ``UNAVAILABLE`` that ate round 2
+(BENCH_r02.json).
 """
 
 from __future__ import annotations
 
 import datetime
+import importlib.util
 import os
-import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "artifacts", "tpu_probe_log_r5.txt")
 
-PROBE_CODE = (
-    "import os, jax\n"
-    "envp = os.environ.get('JAX_PLATFORMS')\n"
-    "if envp: jax.config.update('jax_platforms', envp)\n"
-    "d = jax.devices()\n"
-    "import jax.numpy as jnp\n"
-    "x = jnp.ones((128, 128)); s = float((x @ x).sum())\n"
-    "print('BACKEND_OK', d[0].platform, len(d), s)"
+_BACKEND_PY = os.path.join(
+    REPO, "tpu_aerial_transport", "resilience", "backend.py"
 )
 
 
+def _backend_mod():
+    """Load resilience/backend.py WITHOUT importing the package (which
+    would import jax); the module itself has no module-scope jax import."""
+    name = "_tat_backend_pathload"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, _BACKEND_PY)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def probe(timeout_s: int = 60) -> tuple[bool, str]:
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", PROBE_CODE],
-            capture_output=True, text=True, timeout=timeout_s,
-            env=dict(os.environ),
-        )
-    except subprocess.TimeoutExpired:
-        return False, f"timeout after {timeout_s}s (chip unreachable/wedged)"
-    out = proc.stdout.strip().splitlines()
-    ok_line = next((l for l in out if l.startswith("BACKEND_OK")), None)
-    if proc.returncode != 0 or ok_line is None:
-        tail = (proc.stderr or proc.stdout).strip().splitlines()[-1:]
-        return False, f"probe rc={proc.returncode}: {' '.join(tail)[:200]}"
-    platform = ok_line.split()[1]
-    if platform == "cpu":
-        return False, f"silent CPU fallback ({ok_line})"
-    return True, ok_line
+    ok, detail = _backend_mod().probe_subprocess(timeout_s=timeout_s)
+    if ok and detail == "cpu":
+        return False, "silent CPU fallback (platform=cpu)"
+    return ok, detail
 
 
 def main() -> int:

@@ -107,6 +107,42 @@ def summarize(events: list[dict]) -> dict:
     cells = [e for e in events if e.get("event") == "bench_cell"]
     if cells:
         out["bench_cells"] = {e["cell"]: e["value"] for e in cells}
+
+    # Backend guard (schema v2): error/circuit events from
+    # resilience.backend.BackendGuard, plus the rung each cell/chunk
+    # ACTUALLY ran at (bench cells carry it in their value dict, chunk
+    # events as a top-level field).
+    bevents = [e for e in events if e.get("event") == "backend_event"]
+    rungs: list[tuple[str, str]] = []
+    for e in cells:
+        v = e.get("value")
+        if isinstance(v, dict) and "rung" in v:
+            rungs.append((e["cell"], v["rung"]))
+    for e in chunks:
+        if "rung" in e:
+            rungs.append((f"chunk {e['chunk']}", e["rung"]))
+    if bevents or rungs:
+        kinds: dict[str, int] = {}
+        for e in bevents:
+            k = e.get("kind", "?")
+            kinds[k] = kinds.get(k, 0) + 1
+        out["backend"] = {
+            "events": len(bevents),
+            "kinds": kinds,
+            "timeouts": kinds.get("wedge_timeout", 0),
+            "transitions": [
+                {k: e.get(k) for k in ("kind", "label", "reason", "detail")
+                 if k in e}
+                for e in bevents if e.get("kind", "").startswith("circuit_")
+            ],
+            "errors": [
+                {k: e.get(k) for k in ("kind", "label", "rung", "detail")
+                 if k in e}
+                for e in bevents
+                if not e.get("kind", "").startswith("circuit_")
+            ],
+            "rungs": rungs,
+        }
     return out
 
 
@@ -188,6 +224,30 @@ def render(summary: dict) -> None:
         print("|---|---|")
         for k, v in summary["bench_cells"].items():
             print(f"| {k} | {json.dumps(v)} |")
+
+    be = summary.get("backend")
+    if be:
+        print("\n## backend health (resilience.backend guard)")
+        kinds = ", ".join(f"{k}={v}" for k, v in sorted(be["kinds"].items())) \
+            or "none"
+        print(f"- guard events: {be['events']} ({kinds})")
+        print(f"- watchdog timeouts: {be['timeouts']}")
+        if be["transitions"]:
+            print("- circuit transitions:")
+            for t in be["transitions"]:
+                print(f"  - {t.get('kind')} at {t.get('label')}: "
+                      f"{t.get('reason', t.get('detail', ''))}")
+        if be["errors"]:
+            print("- classified backend errors:")
+            for e in be["errors"]:
+                print(f"  - [{e.get('kind')}] {e.get('label')} "
+                      f"(ran at {e.get('rung', '?')}): "
+                      f"{(e.get('detail') or '')[:120]}")
+        if be["rungs"]:
+            print("\n| unit | rung |")
+            print("|---|---|")
+            for unit, rung in be["rungs"]:
+                print(f"| {unit} | {rung} |")
 
 
 def _fmt(v) -> str:

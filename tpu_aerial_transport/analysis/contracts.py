@@ -39,6 +39,16 @@ and checks four contracts:
   rollout/step carry silently went copy-in/copy-out again (e.g. an
   output's shape/dtype diverged from its donated input), re-paying HBM
   round-trips on every control step.
+- **TC106 off-chip TPU lowering** (:func:`run_lowering_gate`; CLI
+  ``tools/jaxlint.py --contracts --target tpu``): AOT-lower every
+  registered entrypoint for the TPU *target* via ``jax.export`` — no
+  device required — and require (a) the lowering to succeed and (b) the
+  TPU-target StableHLO to contain no f64 tensor types. This is the
+  r02-class gate: BENCH_r02 died at the first real dispatch on the chip
+  (a ``convert_element_type`` surfacing a lazy backend-init failure),
+  and the ordinary contracts only ever lowered for the host CPU — a
+  TPU-only dtype/lowering bug could not fail tier-1 on a CPU box. Now it
+  can: the whole registry TPU-lowers in ~35 s on this host.
 
 Builders use deliberately tiny problem sizes: the contracts are about
 program STRUCTURE (dtypes, callbacks, cache keys, alignment of the
@@ -767,4 +777,77 @@ def run_contracts(names=None,
     out: list[Finding] = []
     for name in selected:
         out.extend(check_entry(REGISTRY[name], disabled))
+    return out
+
+
+# ----------------------------------------------------------------------
+# TC106: off-chip target lowering gate (jax.export, no device needed).
+# ----------------------------------------------------------------------
+
+def lower_for_target(fn, make_args, target: str = "tpu") -> str:
+    """AOT-lower an entrypoint for ``target`` and return the StableHLO
+    text. ``jax.export`` lowers with a platform *specification*, so a
+    CPU-only host can produce (and inspect) the TPU-target program;
+    lowering failures propagate to the caller."""
+    from jax import export as jax_export
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    return jax_export.export(jitted, platforms=[target])(
+        *make_args()
+    ).mlir_module()
+
+
+def check_entry_lowering(contract: Contract, target: str = "tpu",
+                         disabled: frozenset[str] = frozenset(),
+                         ) -> list[Finding]:
+    """TC106 for one entry: the ``target`` lowering must succeed off-chip
+    and must contain no f64 tensor types. A failure is classified through
+    the backend-error taxonomy (``resilience.backend.classify``) so the
+    finding names the failure class a chip would have hit at dispatch."""
+    if "TC106" in disabled:
+        return []
+    path = f"contracts:{contract.name}"
+    if jax.device_count() < contract.min_devices:
+        return []  # environment cannot build this entry; not a finding.
+    if entry_data.LOWERING_WAIVERS.get(contract.name) is not None:
+        return []
+    fn, make_args = contract.build()
+    try:
+        text = lower_for_target(fn, make_args, target)
+    except Exception as e:  # noqa: BLE001 — ANY lowering failure is the
+        # finding this gate exists for.
+        from tpu_aerial_transport.resilience import backend as backend_mod
+
+        kind = backend_mod.classify(e)
+        return [Finding(
+            rule="TC106", path=path, line=0, col=0,
+            message=(
+                f"AOT lowering for target '{target}' failed "
+                f"[{kind}]: {type(e).__name__}: {str(e)[:200]} — an "
+                "r02-class bug that would otherwise surface only at "
+                "first dispatch on a chip"
+            ),
+        )]
+    n = len(_F64_RE.findall(text))
+    if n:
+        return [Finding(
+            rule="TC106", path=path, line=0, col=0,
+            message=(
+                f"{target}-target StableHLO contains {n} f64 tensor "
+                "type(s): the program would pay convert_element_type "
+                "churn (or die) on the accelerator — the BENCH_r02 "
+                "failure class"
+            ),
+        )]
+    return []
+
+
+def run_lowering_gate(names=None, target: str = "tpu",
+                      disabled: frozenset[str] = frozenset(),
+                      ) -> list[Finding]:
+    """TC106 over ``names`` (default: the whole registry)."""
+    selected = names if names is not None else sorted(REGISTRY)
+    out: list[Finding] = []
+    for name in selected:
+        out.extend(check_entry_lowering(REGISTRY[name], target, disabled))
     return out

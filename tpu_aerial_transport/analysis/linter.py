@@ -149,6 +149,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--contracts", action="store_true",
                     help="also run Tier-B trace contracts (imports jax)")
+    ap.add_argument("--target", choices=("tpu", "cpu"), default=None,
+                    help="ALSO AOT-lower every registered entrypoint for "
+                    "this target (jax.export — no device needed) and run "
+                    "the TC106 lowering contract; catches r02-class "
+                    "dtype/lowering bugs on any host (implies Tier B)")
+    ap.add_argument("--only", default="",
+                    help="comma-separated entrypoint names restricting "
+                    "--contracts/--target to a subset of the registry")
     ap.add_argument("--assert-no-jax", action="store_true",
                     help="exit 2 if jax was imported by the Tier-A run "
                     "(self-check used by the test suite)")
@@ -168,11 +176,26 @@ def main(argv: list[str] | None = None) -> int:
     )
     findings = lint_paths(paths, disabled)
 
-    if args.contracts:
+    if args.contracts or args.target:
         sys.path.insert(0, os.path.dirname(pkg_root))
         from tpu_aerial_transport.analysis import contracts
 
-        findings.extend(contracts.run_contracts(disabled=disabled))
+        only = [s.strip() for s in args.only.split(",") if s.strip()] \
+            or None
+        if only:
+            unknown = [n for n in only if n not in contracts.REGISTRY]
+            if unknown:
+                print(f"jaxlint: unknown --only entrypoint(s) {unknown}",
+                      file=sys.stderr)
+                return 1
+        if args.contracts:
+            findings.extend(
+                contracts.run_contracts(names=only, disabled=disabled)
+            )
+        if args.target:
+            findings.extend(contracts.run_lowering_gate(
+                names=only, target=args.target, disabled=disabled
+            ))
 
     print(render_json(findings) if args.format == "json"
           else render_text(findings))

@@ -26,7 +26,11 @@ import numpy as np
 
 from tpu_aerial_transport.obs import telemetry as telemetry_mod
 
-SCHEMA_VERSION = 1
+# v2: adds the ``backend_event`` type (backend-guard error/circuit/rung
+# records from ``resilience.backend.BackendGuard``). Files written at v1
+# remain valid (see :data:`SUPPORTED_SCHEMAS`) — v2 only ADDS vocabulary.
+SCHEMA_VERSION = 2
+SUPPORTED_SCHEMAS = frozenset({1, 2})
 
 # Event vocabulary -> required fields (beyond schema/event/ts). The
 # validator rejects unknown event types and missing fields; extra fields
@@ -40,6 +44,14 @@ EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "done": ("chunks",),
     "bench_cell": ("cell", "value"),
     "rollout_summary": ("logs",),
+    "backend_event": ("kind", "label"),
+}
+
+# Events that did not exist before a given schema version: an event of
+# this type stamped with an OLDER schema is a violation (the reader
+# contract for that version never defined it).
+EVENT_MIN_SCHEMA: dict[str, int] = {
+    "backend_event": 2,
 }
 
 
@@ -101,13 +113,21 @@ def validate_event(obj, lineno: int = 0) -> list[str]:
     if not isinstance(obj, dict):
         return [f"{where}event is not a JSON object"]
     errs = []
-    if obj.get("schema") != SCHEMA_VERSION:
+    schema = obj.get("schema")
+    if schema not in SUPPORTED_SCHEMAS:
         errs.append(
-            f"{where}schema {obj.get('schema')!r} != {SCHEMA_VERSION}"
+            f"{where}schema {schema!r} not in supported "
+            f"{sorted(SUPPORTED_SCHEMAS)}"
         )
     event = obj.get("event")
     if event not in EVENT_FIELDS:
         errs.append(f"{where}unknown event type {event!r}")
+    elif (schema in SUPPORTED_SCHEMAS
+          and schema < EVENT_MIN_SCHEMA.get(event, 0)):
+        errs.append(
+            f"{where}event {event!r} requires schema >= "
+            f"{EVENT_MIN_SCHEMA[event]}, got {schema}"
+        )
     else:
         missing = [k for k in EVENT_FIELDS[event] if k not in obj]
         if missing:

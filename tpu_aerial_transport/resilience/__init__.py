@@ -18,6 +18,13 @@ a claim that survives stress:
   ``make_cadmm_hl_step`` / ``make_dd_hl_step`` controller adapters that
   recompute the equilibrium force distribution from the healthy-agent mask
   each step.
+- :mod:`backend` — the backend guard: structured :class:`backend.BackendError`
+  taxonomy, per-backend :class:`backend.CircuitBreaker` (closed → open →
+  half-open with exponential backoff + jitter), deadline watchdogs for
+  in-process dispatch and subprocess-isolated cold init, the
+  ``TAT_BACKEND_FAULTS`` fault-injection hook, and
+  :class:`backend.BackendGuard` — mid-run graceful degradation onto the
+  tagged XLA-CPU rung for bench cells and recovery chunks.
 - :mod:`recovery` — preemption-safe checkpointing and crash recovery:
   chunk-completion journal, :func:`recovery.run_chunks` /
   :func:`recovery.resume_run` over the one-compiled-chunk contract of
@@ -27,6 +34,19 @@ a claim that survives stress:
   for SIGTERM/SIGINT-graceful shutdown.
 """
 
+from tpu_aerial_transport.resilience.backend import (  # noqa: F401
+    RUNG_CPU,
+    RUNG_ONCHIP,
+    RUNG_ONCHIP_UNPADDED,
+    BackendError,
+    BackendGuard,
+    BackoffPolicy,
+    CircuitBreaker,
+    FaultInjector,
+    call_with_deadline,
+    classify,
+    probe_subprocess,
+)
 from tpu_aerial_transport.resilience.faults import (  # noqa: F401
     NEVER,
     FaultSchedule,

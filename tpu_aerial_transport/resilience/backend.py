@@ -1,0 +1,663 @@
+"""Backend guard: error taxonomy, circuit breaker, deadline watchdogs, and
+graceful CPU degradation for every accelerator interaction.
+
+The bench trajectory is the motivation: round 1 measured on-chip, round 2
+died at the *first real dispatch* with a backend-init ``UNAVAILABLE``
+surfacing under ``convert_element_type`` (BENCH_r02.json — the probe
+passed, the run did not), and rounds 3-5 wedged outright. Until this
+module, only the *probe* was watchdogged; the measured run, the sweep
+cells, and the recovery tier's chunk loop had no timeout, no retry, and no
+mid-run degradation. This module makes a flaky, wedged, or absent TPU
+runtime a STRUCTURED, survivable event everywhere:
+
+- :func:`classify` / :class:`BackendError` — the error taxonomy
+  (``init_unavailable`` / ``wedge_timeout`` / ``compile_error`` /
+  ``dtype_lowering`` / ``oom`` / ``device_crash`` / ``unknown``). Pattern
+  order matters: the r02 tail contains BOTH ``convert_element_type`` and
+  ``Unable to initialize backend … UNAVAILABLE`` — backend-init failure at
+  first dispatch, NOT a dtype bug — so init patterns win over dtype ones.
+- :class:`BackoffPolicy` — exponential backoff with jitter, shared by the
+  circuit breaker and ``tools/bench_retry.py`` (one retry cadence for the
+  whole stack; jitter decorrelates a fleet of retriers).
+- :class:`CircuitBreaker` — per-backend closed → open → half-open machine:
+  K consecutive classified failures open the circuit for a cooldown
+  (work routes to the tagged XLA-CPU rung without paying the deadline
+  again); after the cooldown a half-open probe either closes it or
+  re-opens with a longer cooldown.
+- :func:`call_with_deadline` — thread-deadline watchdog for in-process
+  dispatch: a wedged runtime becomes a structured
+  ``BackendError("wedge_timeout")`` instead of a hung round.
+- :func:`probe_subprocess` — subprocess isolation for COLD backend init.
+  The probe warms a real device computation (matmul + an explicit
+  ``convert_element_type`` round-trip, the exact op class r02 died under),
+  so a probe "pass" implies the first real dispatch cannot raise
+  ``UNAVAILABLE`` — closing the probe/dispatch gap that produced r02.
+- :class:`FaultInjector` — env-triggered fake-backend hook
+  (``TAT_BACKEND_FAULTS``) so wedge / init-failure / mid-sweep crash are
+  testable end-to-end on any host.
+- :class:`BackendGuard` — the orchestration: run work on the primary rung
+  under a deadline, classify failures, trip the breaker, journal a
+  ``backend_event``, and re-place the work on the CPU rung.
+
+Module contract: NO jax import at module scope (lazy inside the functions
+that need it) — ``tools/bench_retry.py`` and ``tools/probe_chip.py`` load
+this file by path on hosts where importing jax is exactly the hazard being
+watchdogged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+
+# ----------------------------------------------------------------------
+# Error taxonomy.
+# ----------------------------------------------------------------------
+
+ERROR_KINDS = (
+    "init_unavailable",   # backend setup/connect failed (r02's real cause)
+    "wedge_timeout",      # accepted work, never answered (rounds 3-5)
+    "compile_error",      # XLA/Mosaic rejected the program
+    "dtype_lowering",     # f64/convert_element_type-class lowering bug
+    "oom",                # device memory exhausted
+    "device_crash",       # runtime died mid-execution
+    "unknown",            # unclassified — treated as a CODE bug, not infra
+)
+
+# Ordered: first match wins. init_unavailable precedes dtype_lowering
+# deliberately — BENCH_r02's tail mentions convert_element_type only
+# because backend init surfaced lazily under the first dispatched op; the
+# root cause line is "Unable to initialize backend ... UNAVAILABLE".
+_CLASSIFIERS: tuple[tuple[str, re.Pattern], ...] = (
+    ("init_unavailable", re.compile(
+        r"(?i)unable to initialize backend|backend setup|"
+        r"failed to connect|\bUNAVAILABLE\b|no accelerator|"
+        r"backend '\w+' requested, but it failed")),
+    ("wedge_timeout", re.compile(
+        r"(?i)timed out|timeout after|deadline exceeded|watchdog|wedged")),
+    ("oom", re.compile(
+        r"(?i)resource[_ ]exhausted|out of memory|\boom\b|"
+        r"failed to allocate")),
+    ("dtype_lowering", re.compile(
+        r"(?i)convert_element_type|float64|\bf64\b|"
+        r"unsupported (element type|dtype)|dtype .* not supported")),
+    ("compile_error", re.compile(
+        r"(?i)mosaic|compilation (error|failure|failed)|"
+        r"compile (error|failed)|lowering (error|failed|rule)|"
+        r"invalid_argument.*hlo|xla.*compile")),
+    # Anchored to the XLA/gRPC STATUS-CODE forms (case-sensitive
+    # INTERNAL/ABORTED/DATA_LOSS) plus device-specific phrases: a
+    # lowercase "aborted"/"internal" in an ordinary exception message is
+    # a code bug that must classify as unknown and RE-RAISE, not degrade.
+    ("device_crash", re.compile(
+        r"\bINTERNAL\b|\bABORTED\b|\bDATA[_ ]LOSS\b|"
+        r"(?i:device (halt|reset)|device is (gone|dead)|"
+        r"execution failed)")),
+)
+
+
+class BackendError(RuntimeError):
+    """A classified backend failure. ``kind`` is one of
+    :data:`ERROR_KINDS`; ``detail`` keeps the original message (truncated
+    by emitters, not here)."""
+
+    def __init__(self, kind: str, detail: str, backend: str = "unknown"):
+        if kind not in ERROR_KINDS:
+            raise ValueError(f"unknown BackendError kind {kind!r}")
+        super().__init__(f"[{kind}] {detail}")
+        self.kind = kind
+        self.detail = detail
+        self.backend = backend
+
+
+def classify(exc_or_text) -> str:
+    """Classify an exception (or message text) into an error kind.
+
+    A :class:`BackendError` keeps its own kind. For anything else the
+    message is matched against the ordered pattern table; an unmatched
+    ``XlaRuntimeError`` still counts as ``device_crash`` (the runtime
+    itself raised — that is a device problem whatever the text says),
+    while an unmatched ordinary exception is ``unknown`` — a CODE bug the
+    guard must re-raise, not degrade around.
+    """
+    if isinstance(exc_or_text, BackendError):
+        return exc_or_text.kind
+    text = (str(exc_or_text) if not isinstance(exc_or_text, str)
+            else exc_or_text)
+    if not isinstance(exc_or_text, str):
+        text = f"{type(exc_or_text).__name__}: {text}"
+    for kind, pat in _CLASSIFIERS:
+        if pat.search(text):
+            return kind
+    if not isinstance(exc_or_text, str) and \
+            type(exc_or_text).__name__ == "XlaRuntimeError":
+        return "device_crash"
+    return "unknown"
+
+
+# ----------------------------------------------------------------------
+# Backoff policy (shared with tools/bench_retry.py).
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class BackoffPolicy:
+    """Exponential backoff with jitter: attempt k (0-based) waits
+    ``min(initial * factor**k, max) * (1 + jitter * U[-1, 1])``. Jitter
+    decorrelates retriers sharing one wedged chip; pass a seeded ``rng``
+    for deterministic tests."""
+
+    initial_s: float = 30.0
+    factor: float = 2.0
+    max_s: float = 600.0
+    jitter: float = 0.1
+
+    def delay(self, attempt: int, rng: random.Random | None = None) -> float:
+        base = min(self.initial_s * self.factor ** max(attempt, 0),
+                   self.max_s)
+        if not self.jitter:
+            return base
+        u = (rng or random).uniform(-1.0, 1.0)
+        return max(0.0, base * (1.0 + self.jitter * u))
+
+
+# ----------------------------------------------------------------------
+# Circuit breaker.
+# ----------------------------------------------------------------------
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitBreaker:
+    """Per-backend circuit breaker.
+
+    closed --(K consecutive classified failures)--> open: primary work is
+    refused (``allow()`` False) for a cooldown from the backoff policy.
+    open --(cooldown elapsed)--> half_open: ONE probe call is allowed.
+    half_open --success--> closed (failure count reset);
+    half_open --failure--> open again with the NEXT (longer) cooldown.
+
+    ``transitions`` records every state change (monotonic ts, from, to,
+    reason) — the guard journals them as ``backend_event`` rows.
+    """
+
+    def __init__(self, failure_threshold: int = 3,
+                 policy: BackoffPolicy | None = None,
+                 clock=time.monotonic,
+                 rng: random.Random | None = None):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.policy = policy or BackoffPolicy()
+        self._clock = clock
+        self._rng = rng or random.Random()
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.open_count = 0          # how many times the circuit opened.
+        self.opened_at: float | None = None
+        self.cooldown_s: float = 0.0
+        self.transitions: list[dict] = []
+
+    def _transition(self, to: str, reason: str) -> None:
+        if to == self.state:
+            return
+        self.transitions.append({
+            "ts": self._clock(), "from": self.state, "to": to,
+            "reason": reason,
+        })
+        self.state = to
+
+    def allow(self) -> bool:
+        """May primary work run now? OPEN + cooldown elapsed flips to
+        HALF_OPEN (the caller's next run() is the probe)."""
+        if self.state == OPEN:
+            if self._clock() - self.opened_at >= self.cooldown_s:
+                self._transition(HALF_OPEN, "cooldown elapsed")
+                return True
+            return False
+        return True
+
+    def seconds_until_half_open(self) -> float:
+        if self.state != OPEN:
+            return 0.0
+        return max(0.0, self.cooldown_s - (self._clock() - self.opened_at))
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.state in (HALF_OPEN, OPEN):
+            self._transition(CLOSED, "probe succeeded")
+
+    def record_failure(self, kind: str) -> None:
+        self.consecutive_failures += 1
+        if self.state == HALF_OPEN:
+            self._open(f"half-open probe failed ({kind})")
+        elif (self.state == CLOSED
+              and self.consecutive_failures >= self.failure_threshold):
+            self._open(
+                f"{self.consecutive_failures} consecutive failures "
+                f"(last: {kind})"
+            )
+
+    def _open(self, reason: str) -> None:
+        self.cooldown_s = self.policy.delay(self.open_count, self._rng)
+        self.open_count += 1
+        self.opened_at = self._clock()
+        self._transition(OPEN, reason)
+
+
+# ----------------------------------------------------------------------
+# Deadline watchdog (in-process dispatch).
+# ----------------------------------------------------------------------
+
+def call_with_deadline(fn, timeout_s: float | None, label: str = ""):
+    """Run ``fn()`` under a thread deadline: a wedged runtime becomes a
+    structured ``BackendError("wedge_timeout")`` after ``timeout_s``
+    instead of a hung process. ``fn`` must block until its device work is
+    done (``jax.block_until_ready``) or a wedge inside XLA would escape
+    the watchdog.
+
+    The worker thread cannot be killed — on timeout it is abandoned as a
+    daemon (the wedged runtime holds it anyway) and the CALLER must not
+    touch the backend that wedged except through the circuit breaker.
+    ``timeout_s`` None/<=0 disables the watchdog (plain call).
+    """
+    if not timeout_s or timeout_s <= 0:
+        return fn()
+    result: list = []
+    error: list = []
+
+    def worker():
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001 — forwarded to caller.
+            error.append(e)
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name=f"backend-guard-{label or 'call'}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise BackendError(
+            "wedge_timeout",
+            f"{label or 'call'} exceeded the {timeout_s:g}s deadline "
+            "(runtime wedged; worker thread abandoned)",
+        )
+    if error:
+        raise error[0]
+    return result[0]
+
+
+# ----------------------------------------------------------------------
+# Subprocess probe (cold backend init + first real dispatch).
+# ----------------------------------------------------------------------
+
+# The probe's device computation deliberately includes a matmul AND an
+# explicit convert_element_type round-trip: r02's probe passed on
+# `jax.devices()` alone while the first dispatched op (a convert) raised
+# the lazy backend-init UNAVAILABLE. A probe "pass" must mean the first
+# REAL dispatch succeeds.
+PROBE_CODE = (
+    "import os, jax\n"
+    "envp = os.environ.get('JAX_PLATFORMS')\n"
+    "if envp: jax.config.update('jax_platforms', envp)\n"
+    "d = jax.devices()\n"
+    "import jax.numpy as jnp\n"
+    "from jax import lax\n"
+    "x = jnp.ones((128, 128), jnp.float32)\n"
+    "y = lax.convert_element_type(x @ x, jnp.bfloat16)\n"
+    "s = float(lax.convert_element_type(y, jnp.float32).sum())\n"
+    "print('BACKEND_OK', d[0].platform, len(d), s)\n"
+)
+
+FAULTS_ENV = "TAT_BACKEND_FAULTS"
+DEADLINE_ENV = "TAT_BACKEND_DEADLINE_S"
+
+
+def run_group(cmd: list[str], timeout_s: float,
+              env: dict | None = None, cwd: str | None = None):
+    """Run ``cmd`` in its OWN session and, on timeout, SIGKILL the whole
+    process group before re-raising ``subprocess.TimeoutExpired``.
+
+    ``subprocess.run(timeout=...)`` kills only the direct child: a wedged
+    bench's own subprocesses (the backend probe it spawned, a TPU runtime
+    helper holding the chip lease) survive as orphans and keep the chip
+    wedged for every later attempt. ``start_new_session`` gives the child
+    a fresh process group rooted at its pid, so one ``killpg`` reaps the
+    whole tree. Returns a ``(returncode, stdout, stderr)`` namespace like
+    ``subprocess.run(capture_output=True, text=True)``.
+    """
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=dict(env or os.environ), cwd=cwd, start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        proc.wait()
+        raise
+    return types.SimpleNamespace(
+        returncode=proc.returncode, stdout=out, stderr=err
+    )
+
+
+def probe_subprocess(timeout_s: float = 60.0,
+                     env: dict | None = None) -> tuple[bool, str]:
+    """Watchdogged subprocess probe of cold backend init + first dispatch:
+    ``(True, platform)`` when the computation ran, ``(False, detail)``
+    otherwise. Subprocess isolation because a wedged BACKEND INIT cannot
+    be interrupted in-process (the thread watchdog can only abandon it —
+    fine for dispatch, fatal before any backend exists).
+
+    Honors the :class:`FaultInjector` env hook: an ``init_unavailable``
+    directive fails the probe in-process (fast), so end-to-end tests can
+    simulate the r02 failure mode without a chip.
+    """
+    inj = FaultInjector.from_env(
+        (env or os.environ).get(FAULTS_ENV, ""))
+    if inj.init_unavailable:
+        return False, (
+            "fault-injected: Unable to initialize backend "
+            "(TAT_BACKEND_FAULTS=init_unavailable)"
+        )
+    try:
+        proc = run_group(
+            [sys.executable, "-c", PROBE_CODE], timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        # Structured prefix contract: tools/bench_retry.py classifies a
+        # wedged (retryable) chip by detail.startswith("timeout after").
+        return False, (
+            f"timeout after {timeout_s:g}s (chip unreachable/wedged)"
+        )
+    token = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("BACKEND_OK")]
+    if proc.returncode == 0 and token:
+        return True, token[0].split()[1]
+    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+    return False, f"probe rc={proc.returncode}: " + " | ".join(tail)
+
+
+# ----------------------------------------------------------------------
+# Fault injection (test hook; env-triggered fake backend).
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FaultInjector:
+    """Parsed ``TAT_BACKEND_FAULTS`` directives. Comma-separated:
+
+    - ``init_unavailable`` — the subprocess probe fails fast, as if the
+      backend could not initialize (the r02 class);
+    - ``wedge=S`` — every guarded PRIMARY call sleeps ``S`` seconds
+      before running (exceeding the deadline ⇒ a ``wedge_timeout``);
+    - ``crash@N`` — the N-th (1-based) guarded primary call raises a fake
+      ``INTERNAL: device crashed`` runtime error (mid-sweep crash);
+    - ``crash@LABEL`` — primary calls whose label contains ``LABEL``
+      raise it instead.
+
+    Injection applies ONLY to the primary rung — the CPU fallback always
+    runs clean, so a fault-injected sweep still produces real (tagged)
+    numbers. Parsing is strict: an unknown directive raises, because a
+    typo silently disabling fault injection would fake a green test.
+    """
+
+    init_unavailable: bool = False
+    wedge_s: float = 0.0
+    crash_at: int = 0
+    crash_label: str = ""
+    calls: int = 0
+
+    @classmethod
+    def from_env(cls, spec: str | None = None) -> "FaultInjector":
+        if spec is None:
+            spec = os.environ.get(FAULTS_ENV, "")
+        inj = cls()
+        for raw in (spec or "").split(","):
+            d = raw.strip()
+            if not d:
+                continue
+            if d == "init_unavailable":
+                inj.init_unavailable = True
+            elif d.startswith("wedge="):
+                inj.wedge_s = float(d.split("=", 1)[1])
+            elif d.startswith("crash@"):
+                tag = d.split("@", 1)[1]
+                if tag.isdigit():
+                    inj.crash_at = int(tag)
+                else:
+                    inj.crash_label = tag
+            else:
+                raise ValueError(
+                    f"unknown {FAULTS_ENV} directive {d!r} (known: "
+                    "init_unavailable, wedge=S, crash@N, crash@LABEL)"
+                )
+        return inj
+
+    @property
+    def active(self) -> bool:
+        return bool(self.init_unavailable or self.wedge_s
+                    or self.crash_at or self.crash_label)
+
+    def maybe_fault(self, label: str = "") -> None:
+        """Called by the guard before every primary execution."""
+        self.calls += 1
+        if self.crash_at and self.calls == self.crash_at:
+            raise RuntimeError(
+                f"INTERNAL: device crashed (fault-injected at call "
+                f"{self.calls}, label {label!r})"
+            )
+        if self.crash_label and self.crash_label in label:
+            raise RuntimeError(
+                f"INTERNAL: device crashed (fault-injected on label "
+                f"{label!r})"
+            )
+        if self.wedge_s:
+            time.sleep(self.wedge_s)
+            # The watchdog abandoned this worker long ago (deadline <
+            # wedge); raising here makes the abandoned thread exit WITHOUT
+            # running real device work inside a dying interpreter (a C++
+            # abort at teardown). If the deadline was generous enough to
+            # outlast the sleep, the raise is the wedge surfacing.
+            raise BackendError(
+                "wedge_timeout",
+                f"fault-injected wedge ({self.wedge_s:g}s) on {label!r}",
+            )
+
+
+# ----------------------------------------------------------------------
+# The guard.
+# ----------------------------------------------------------------------
+
+# Rung vocabulary: where a cell/chunk ACTUALLY ran. "on-chip" is the
+# accelerator with the default (padded) operator layout, "on-chip-unpadded"
+# the deliberate pad_operators=False A/B twin, "cpu-tagged" the XLA-CPU
+# fallback rung (a valid measurement on the fallback backend, never
+# published as a TPU number).
+RUNG_ONCHIP = "on-chip"
+RUNG_ONCHIP_UNPADDED = "on-chip-unpadded"
+RUNG_CPU = "cpu-tagged"
+
+# Error kinds that indict the BACKEND (and therefore count toward opening
+# the circuit). compile_error / dtype_lowering are PROGRAM bugs: the
+# failing cell still degrades to the CPU rung, but three Pallas compile
+# failures on a healthy chip must not route the rest of the sweep to CPU.
+BREAKER_KINDS = frozenset(
+    {"init_unavailable", "wedge_timeout", "device_crash", "oom"}
+)
+
+# Default deadline for one guarded unit (a sweep cell's compile + measure,
+# one recovery chunk). Generous because FIRST execution includes XLA
+# compile time; override per-guard or with TAT_BACKEND_DEADLINE_S.
+DEFAULT_DEADLINE_S = 600.0
+
+
+def default_deadline_s(env: dict | None = None) -> float:
+    raw = (env or os.environ).get(DEADLINE_ENV, "")
+    try:
+        return float(raw) if raw else DEFAULT_DEADLINE_S
+    except ValueError:
+        raise ValueError(f"{DEADLINE_ENV}={raw!r} is not a number")
+
+
+class BackendGuard:
+    """Run units of accelerator work so that a flaky/wedged/absent runtime
+    degrades instead of killing the run.
+
+    ``run(label, primary_fn, fallback_fn)``:
+
+    1. circuit OPEN (cooldown pending) → skip the primary entirely, run
+       the fallback, tag the result ``cpu-tagged`` (one ``backend_event``
+       records the routing);
+    2. otherwise run ``primary_fn`` under the deadline watchdog (fault
+       injection applies here), ``record_success`` and return the primary
+       rung;
+    3. a CLASSIFIED failure (anything but ``unknown``) records into the
+       breaker, journals a ``backend_event``, and re-runs on the fallback;
+       an ``unknown`` failure re-raises — that is a code bug, and routing
+       it to CPU would only reproduce it more slowly.
+
+    ``emit`` duck-types over an ``obs.export.MetricsWriter`` (``metrics``)
+    and a ``resilience.recovery.RunJournal`` (``journal``) — either or
+    both may be None; ``events`` always records in-process.
+    """
+
+    def __init__(self, *,
+                 deadline_s: float | None = None,
+                 breaker: CircuitBreaker | None = None,
+                 faults: FaultInjector | None = None,
+                 metrics=None,
+                 journal=None,
+                 primary_rung: str | None = None,
+                 clock=time.monotonic):
+        self.deadline_s = (default_deadline_s() if deadline_s is None
+                           else deadline_s)
+        self.breaker = breaker or CircuitBreaker()
+        self.faults = faults if faults is not None \
+            else FaultInjector.from_env()
+        self.metrics = metrics
+        self.journal = journal
+        self._primary_rung = primary_rung
+        self._clock = clock
+        self.events: list[dict] = []
+        # Did the LAST run() return a fallback result? (Callers on a
+        # CPU-primary host cannot tell from the rung alone.)
+        self.last_fell_back = False
+        self._seen_transitions = 0
+
+    @property
+    def primary_rung(self) -> str:
+        """Lazy: "cpu-tagged" when the process default backend IS the
+        CPU (an explicit CPU run has no higher rung to fall from),
+        "on-chip" otherwise. Resolution touches ``jax.default_backend()``
+        — potentially the FIRST in-process backend init, which can wedge
+        on a sick runtime — so ``run()`` only resolves it INSIDE the
+        deadline watchdog; callers that already know the probed platform
+        (bench passes the subprocess-probe result) should construct the
+        guard with an explicit ``primary_rung`` and never pay it."""
+        if self._primary_rung is None:
+            import jax
+
+            self._primary_rung = (
+                RUNG_CPU if jax.default_backend() == "cpu" else RUNG_ONCHIP
+            )
+        return self._primary_rung
+
+    def emit(self, kind: str, label: str, **fields) -> dict:
+        event = {"kind": kind, "label": label, **fields}
+        self.events.append(event)
+        if self.journal is not None:
+            self.journal.append({"event": "backend_event", **event})
+        if self.metrics is not None:
+            self.metrics.emit("backend_event", **event)
+        return event
+
+    def _emit_transitions(self, label: str) -> None:
+        """Journal breaker transitions that happened since the last emit
+        (allow() can transition without a failure being recorded)."""
+        new = self.breaker.transitions[self._seen_transitions:]
+        self._seen_transitions = len(self.breaker.transitions)
+        for t in new:
+            self.emit("circuit_" + t["to"], label, reason=t["reason"])
+
+    def run(self, label: str, primary_fn, fallback_fn=None, *,
+            rung: str | None = None, deadline_s: float | None = None):
+        """Execute one unit. Returns ``(value, rung_it_ran_at)``."""
+        deadline = self.deadline_s if deadline_s is None else deadline_s
+        self.last_fell_back = False
+        allowed = self.breaker.allow()
+        self._emit_transitions(label)
+        if not allowed:
+            if fallback_fn is None:
+                raise BackendError(
+                    "wedge_timeout",
+                    f"circuit open ({self.breaker.seconds_until_half_open():.0f}s "
+                    f"to half-open) and no fallback for {label!r}",
+                )
+            self.emit(
+                "circuit_routed_cpu", label, rung=RUNG_CPU,
+                detail=(f"circuit open; "
+                        f"{self.breaker.seconds_until_half_open():.0f}s to "
+                        "half-open"),
+            )
+            self.last_fell_back = True
+            return fallback_fn(), RUNG_CPU
+
+        try:
+            def _primary():
+                self.faults.maybe_fault(label)
+                # Rung resolution INSIDE the watchdog: the first touch of
+                # jax.default_backend() is an in-process backend init and
+                # can wedge exactly like the work itself (the r02 "probe
+                # passed, run did not" window).
+                return primary_fn(), (rung or self.primary_rung)
+
+            value, primary_rung = call_with_deadline(
+                _primary, deadline, label=label
+            )
+        except Exception as e:  # noqa: BLE001 — classification decides.
+            kind = classify(e)
+            if kind == "unknown":
+                raise  # a code bug; degrading would only hide it.
+            if kind in BREAKER_KINDS:
+                self.breaker.record_failure(kind)
+            self.emit(
+                kind, label,
+                rung=rung or self._primary_rung or "unresolved",
+                detail=f"{type(e).__name__}: {e}"[:300],
+                circuit=self.breaker.state,
+            )
+            self._emit_transitions(label)
+            if fallback_fn is None:
+                if isinstance(e, BackendError):
+                    raise
+                raise BackendError(kind, f"{type(e).__name__}: {e}"[:300]) \
+                    from e
+            self.last_fell_back = True
+            return fallback_fn(), RUNG_CPU
+        self.breaker.record_success()
+        self._emit_transitions(label)
+        return value, primary_rung
+
+
+def run_on_cpu(fn):
+    """Build a fallback thunk executing ``fn`` with the host CPU as the
+    default device (uncommitted computations route there; freshly created
+    arrays land there). The standard ``fallback_fn`` for
+    :meth:`BackendGuard.run`."""
+    def thunk():
+        import jax
+
+        with jax.default_device(jax.devices("cpu")[0]):
+            return fn()
+
+    return thunk

@@ -53,6 +53,7 @@ from tpu_aerial_transport.harness.rollout import (
 )
 from tpu_aerial_transport.obs import export as export_mod
 from tpu_aerial_transport.obs import telemetry as telemetry_mod
+from tpu_aerial_transport.resilience import backend as backend_mod
 
 JOURNAL_SCHEMA = 1
 CARRY_PREFIX = "carry"
@@ -200,6 +201,7 @@ def run_chunks(
     max_retries: int = 0,
     resumed_from_chunk: int | None = None,
     metrics: "export_mod.MetricsWriter | str | None" = None,
+    guard: "backend_mod.BackendGuard | None" = None,
 ) -> RunResult:
     """Drive ``chunk_jit(carry, i0) -> (carry, logs)`` from ``start_chunk``
     to ``plan.n_chunks``, snapshotting the carry and the chunk's logs at
@@ -212,6 +214,18 @@ def run_chunks(
     surfacing as a runtime error) is requeued on the carry restored from
     the last boundary's HOST copy — donation may have consumed the device
     buffers of the failed call, the host copy survives.
+
+    ``guard`` (optional; a ``resilience.backend.BackendGuard``) turns on
+    mid-run graceful degradation: each chunk's compile+execute runs under
+    the guard's deadline watchdog, classified backend failures (wedge,
+    init, crash, oom) journal a ``backend_event`` and re-run the chunk on
+    the XLA-CPU rung from the last boundary's host carry — and the run
+    CONTINUES on CPU (the degradation is one-way; ``resume_run`` after the
+    process dies replays from the failed chunk, not from scratch). Every
+    chunk journal/metrics event then records the ``rung`` it actually ran
+    at. Degradation is for single-device chunk drivers; it is not applied
+    under a mesh ``place`` fn (sharded carries re-place via ``place``, and
+    a multi-chip run losing its mesh cannot shrink onto one host CPU).
 
     ``metrics`` (optional; an ``obs.export.MetricsWriter`` or a jsonl
     path) turns on the flight-recorder export: one schema-versioned
@@ -258,6 +272,20 @@ def run_chunks(
     retries_total = 0
     attempt = 0
     c = start_chunk
+    if guard is not None:
+        # The guard's backend_event rows land in THIS run's journal and
+        # metrics unless the caller pre-wired its own sinks.
+        if guard.journal is None:
+            guard.journal = journal
+        if guard.metrics is None:
+            guard.metrics = metrics
+    rung: str | None = None
+    degraded = False  # one-way: a guard fallback pins the run to CPU.
+
+    def _cpu_place(tree):
+        cpu = jax.devices("cpu")[0]
+        return jax.tree.map(lambda l: jax.device_put(np.asarray(l), cpu),
+                            tree)
     while c < plan.n_chunks:
         if interrupt is not None and interrupt.triggered:
             if c > 0:
@@ -288,18 +316,43 @@ def run_chunks(
             )
         try:
             t0 = time.perf_counter()
-            new_carry, logs = chunk_jit(
-                carry, chunk_index_offset(c, plan.chunk_len)
-            )
-            # The copy both syncs (device errors surface inside this try)
-            # and backs the carry up before the next donation consumes it
-            # (see the zero-copy-view note above). It stays a LOCAL until
-            # the boundary is fully published: rebinding carry_host here
-            # would make a snapshot IO failure retry chunk c from chunk
-            # c's own output — applying its dynamics twice.
-            new_carry_host = jax.tree.map(
-                lambda l: np.array(l, copy=True), new_carry
-            )
+            offset = chunk_index_offset(c, plan.chunk_len)
+
+            def _exec(chunk_carry):
+                out_carry, out_logs = chunk_jit(chunk_carry, offset)
+                # The copy both syncs (device errors surface inside this
+                # try — and, under the guard, inside the watchdogged
+                # primary call) and backs the carry up before the next
+                # donation consumes it (see the zero-copy-view note
+                # above). It stays a LOCAL until the boundary is fully
+                # published: rebinding carry_host here would make a
+                # snapshot IO failure retry chunk c from chunk c's own
+                # output — applying its dynamics twice.
+                out_host = jax.tree.map(
+                    lambda l: np.array(l, copy=True), out_carry
+                )
+                return out_carry, out_logs, out_host
+
+            if guard is None:
+                new_carry, logs, new_carry_host = _exec(carry)
+            elif degraded:
+                # Already re-placed on CPU: run there directly (paying the
+                # primary deadline per chunk against an open/flaky backend
+                # would re-wedge every boundary).
+                new_carry, logs, new_carry_host = _exec(_cpu_place(carry))
+                rung = backend_mod.RUNG_CPU
+            else:
+                # CPU degradation restores from the last BOUNDARY's host
+                # copy (the failed primary may have consumed/poisoned the
+                # device buffers); disabled under a mesh `place` fn — the
+                # guard then still provides deadline + classification and
+                # classified errors fall through to the host-level retry.
+                fallback = (None if place is not None
+                            else lambda: _exec(_cpu_place(carry_host)))
+                (new_carry, logs, new_carry_host), rung = guard.run(
+                    f"chunk{c}", lambda: _exec(carry), fallback_fn=fallback,
+                )
+                degraded = guard.last_fell_back
             wall_s = time.perf_counter() - t0  # host copy = device sync.
             checkpoint.save_snapshot(
                 plan.run_dir, c, new_carry_host, prefix=CARRY_PREFIX,
@@ -339,6 +392,8 @@ def run_chunks(
                 checkpoint.snapshot_path(plan.run_dir, c, CARRY_PREFIX)
             ),
             "retries": attempt,
+            # The rung this chunk ACTUALLY ran at (guard runs only).
+            **({"rung": rung} if rung is not None else {}),
         })
         if metrics is not None:
             # The telemetry accumulator (if the chunk carry threads one) is
@@ -350,6 +405,7 @@ def run_chunks(
                 step_end=(c + 1) * plan.chunk_len,
                 telemetry=export_mod.telemetry_event(tel),
                 logs=_logs_digest(logs),
+                **({"rung": rung} if rung is not None else {}),
             )
         logs_chunks.append(logs)
         carry = new_carry
@@ -392,6 +448,7 @@ def resume_run(
     place=None,
     max_retries: int = 0,
     metrics: "export_mod.MetricsWriter | str | None" = None,
+    guard: "backend_mod.BackendGuard | None" = None,
 ) -> RunResult:
     """Resume a journaled run from its newest fully-valid boundary.
 
@@ -466,5 +523,5 @@ def resume_run(
         plan, chunk_jit, carry, start_chunk=start_chunk,
         prior_logs=prior_logs, interrupt=interrupt, place=place,
         max_retries=max_retries, resumed_from_chunk=start_chunk,
-        metrics=metrics,
+        metrics=metrics, guard=guard,
     )
