@@ -746,6 +746,153 @@ def _measured_iter_ms(controller, n, k_lo=4, k_hi=24, n_steps=30):
     }
 
 
+def _sharded_ab_cell(controller, n, impl, n_steps=10, max_iter=8):
+    """Consensus-exchange A/B (parallel/ring.py): the agent-sharded MPC
+    step — full hot path: env CBFs, consensus solve, low-level + physics —
+    with the cross-shard exchange pinned to ``impl`` ("allreduce" psum
+    barriers / "ring" ppermute hops / "pallas_ring" async-DMA kernel),
+    scanned ``n_steps`` on a mesh over every available device that divides
+    ``n``. On one device the cell degenerates (axis_size 1 → no exchange)
+    but still measures the sharded program; the multi-device twins are the
+    A/B. ``pallas_ring`` downgrades to the XLA ring off-TPU at trace time
+    (``ring._resolve_impl``) — so a backend-guard CPU re-run of the pallas
+    cell measures the ring; the ``rung`` + ``impl_resolved`` fields keep
+    that legible."""
+    from tpu_aerial_transport.control import cadmm as cadmm_mod
+    from tpu_aerial_transport.control import dd as dd_mod
+    from tpu_aerial_transport.parallel import mesh as mesh_mod
+    from tpu_aerial_transport.parallel import ring as ring_mod
+
+    params, col, state0, forest, f_eq, ll, acc_des = _setup(n)
+    # Devices of the platform the cell EFFECTIVELY runs on: under the
+    # backend guard's CPU fallback (run_on_cpu's jax.default_device(cpu)
+    # context) jax.devices() would still enumerate the wedged chip and
+    # commit the shard_map right back to it.
+    devs = jax.devices(ring_mod.effective_platform())
+    ndev = len(devs)
+    n_shards = max(d for d in range(1, min(ndev, n) + 1) if n % d == 0)
+    m = mesh_mod.make_mesh({"agent": n_shards}, devices=devs)
+    if controller == "cadmm":
+        cfg = cadmm_mod.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=max_iter, inner_iters=20, consensus_impl=impl,
+        )
+        cs0 = cadmm_mod.init_cadmm_state(params, cfg)
+        step = mesh_mod.cadmm_control_sharded(params, cfg, f_eq, m, forest)
+    else:
+        cfg = dd_mod.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=max_iter, inner_iters=40, consensus_impl=impl,
+        )
+        cs0 = dd_mod.init_dd_state(params, cfg)
+        step = mesh_mod.dd_control_sharded(params, cfg, f_eq, m, forest)
+    state0 = state0.replace(vl=jnp.array([0.5, 0.0, 0.0], jnp.float32))
+
+    def roll(cs, state, n_steps):
+        def body(carry, _):
+            cs, s = carry
+            f, cs, _ = step(cs, s, acc_des)
+            return (cs, _substeps(params, ll, s, f)), None
+
+        return jax.lax.scan(body, (cs, state), None, length=n_steps)[0]
+
+    jitted = jax.jit(roll, static_argnames="n_steps")
+    out = jitted(cs0, state0, n_steps=n_steps)
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = jitted(cs0, state0, n_steps=n_steps)
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        times.append(time.perf_counter() - t0)
+    return {
+        "mpc_steps_per_sec": n_steps / float(np.median(times)),
+        "impl": impl,
+        "impl_resolved": ring_mod._resolve_impl(impl),
+        "devices": n_shards,
+        "n": n,
+    }
+
+
+def _donated_resume_cell(n=4, n_hl_steps=8, n_chunks=4):
+    """Donated-vs-undonated chunked-resume A/B — the bench side of the
+    PR-4 TC105 wart (ROADMAP "KNOWN WART"): the recovery tier defaults
+    ``donate=False`` because donated chunk carries on XLA-CPU under the
+    persistent compilation cache can flip low-order result bits with
+    allocation history, breaking bit-exact resume. This cell measures, on
+    whatever backend the sweep runs at, (a) the wall-time cost of that
+    default (donated vs undonated chunked rollout) and (b) whether the
+    donated arm IS bit-identical here — the next chip round reads this
+    cell to decide whether ``recovery`` can flip its default on TPU
+    (expected placement-stable)."""
+    from tpu_aerial_transport.control import cadmm as cadmm_mod
+    from tpu_aerial_transport.harness import rollout as ro
+
+    params, col, state0, forest, f_eq, ll, _ = _setup(n)
+    cfg = cadmm_mod.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=8, inner_iters=10,
+    )
+    plan = cadmm_mod.make_plan(params, cfg)
+    cs0 = cadmm_mod.init_cadmm_state(params, cfg)
+    state0 = state0.replace(vl=jnp.array([0.5, 0.0, 0.0], jnp.float32))
+    x0 = state0.xl
+
+    def acc_des_fn(state, t):
+        del t
+        dvl = -1.0 * state.vl - 1.0 * (state.xl - x0)
+        return (dvl, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
+
+    def hl(cs, s, a):
+        return cadmm_mod.control(
+            params, cfg, f_eq, cs, s, a, forest, plan=plan
+        )
+
+    def run_arm(donate):
+        runner = ro.make_chunked_rollout(
+            hl, ll.control, params, n_hl_steps=n_hl_steps,
+            n_chunks=n_chunks, acc_des_fn=acc_des_fn, donate=donate,
+        )
+
+        def once():
+            # Fresh decoupled copies per call: donated buffers are
+            # consumed (and constant-deduped leaves must not be donated
+            # twice — the jit_rollout shared-buffer caveat).
+            s0, c0 = jax.tree.map(jnp.copy, (state0, cs0))
+            fs, fc, _ = runner(s0, c0)
+            jax.block_until_ready(fs.xl)
+            return fs, fc
+
+        once()  # compile + warm.
+        times, finals = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            finals.append(once())
+            times.append(time.perf_counter() - t0)
+        # finals[-2:] are same-program replays with different allocation
+        # history — exactly the axis the XLA-CPU wart varies along.
+        return float(np.median(times)) / n_hl_steps * 1e3, finals
+
+    undonated_ms, finals_u = run_arm(False)
+    donated_ms, finals_d = run_arm(True)
+
+    def bitexact(a, b):
+        return bool(all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        ))
+
+    return {
+        "donated_ms_per_step": donated_ms,
+        "undonated_ms_per_step": undonated_ms,
+        "speedup": undonated_ms / donated_ms,
+        # THE wart question: can resume rely on donated chunk carries?
+        "donated_bitexact_vs_undonated": bitexact(finals_d[-1], finals_u[-1]),
+        "donated_replay_bitexact": bitexact(finals_d[-1], finals_d[-2]),
+        "n": n, "chunks": n_chunks,
+    }
+
+
 SWEEP_PARTIAL_PATH = "BENCH_SWEEP_PARTIAL.json"
 SWEEP_JOURNAL_PATH = "BENCH_SWEEP_JOURNAL.jsonl"
 SWEEP_METRICS_PATH = "artifacts/bench_sweep.metrics.jsonl"
@@ -930,9 +1077,43 @@ def sweep(resume: bool = False, platform: str | None = None):
         return {"scenario_mpc_steps_per_sec": rate,
                 "agent_mpc_steps_per_sec": rate * kw["n"]}
 
-    # The round-5 A/B cells run FIRST: if the tunnel dies mid-sweep,
-    # the checkpoint must already hold the cells that decide this
-    # round's default flips (fused/buckets/inner_tol/unroll), not
+    # Consensus-exchange A/B cells (parallel/ring.py) — run FIRST with the
+    # other decision cells: the next chip round reads the
+    # {cadmm,dd}_n*_sharded_{ring,pallas_ring} twins against their
+    # _allreduce baselines to decide the non-CPU default (flip criterion
+    # written at ring.resolve_consensus), and the donated-resume A/B to
+    # decide the recovery tier's TC105 donate default. Meaningful on ANY
+    # backend (the CPU mesh measures the XLA ring's bookkeeping cost;
+    # pallas cells are chip-only). TAT_SWEEP_SHARDED_N is a test/debug
+    # hook shrinking the agent count (the fault-injection e2e sweeps a
+    # cheap n=4 twin; keys carry the actual n).
+    ab_n = int(os.environ.get("TAT_SWEEP_SHARDED_N", "64"))
+    ring_impls = ["allreduce", "ring"]
+    if jax.devices()[0].platform != "cpu":
+        ring_impls.append("pallas_ring")
+    for ctrl in ("cadmm", "dd"):
+        for impl in ring_impls:
+            key = f"{ctrl}_n{ab_n}_sharded_{impl}"
+            if not want(key) or (key in results
+                                 and "error" not in results[key]):
+                continue
+            try:
+                record(key, guarded_cell(
+                    key, _sharded_ab_cell, ctrl, ab_n, impl,
+                ))
+            except Exception as e:
+                record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+    key = "chunked_resume_donate_ab"
+    if want(key) and not (key in results and "error" not in results[key]):
+        try:
+            record(key, guarded_cell(key, _donated_resume_cell))
+        except Exception as e:
+            record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    # The round-5 A/B cells run right after the ring/donate decision
+    # cells above: if the tunnel dies mid-sweep, the checkpoint must
+    # already hold the cells that decide default flips
+    # (consensus impl/donate/fused/buckets/inner_tol/unroll), not
     # just the long-standing matrix.
     # A/B cells for the round-4 switches (VERDICT r4 item 6): headline
     # config x {scan, pallas} x {0, 2 buckets}, plus the n=64 fused A/B.
@@ -1087,10 +1268,26 @@ def sweep(resume: bool = False, platform: str | None = None):
                   f"{per_iter_s} |")
     for key in [k for k in results
                 if "batch" in k or "swarm" in k or "fused" in k
-                or "innertol" in k]:
+                or "innertol" in k or "sharded" in k or "donate" in k]:
         r = results[key]
-        if "scenario_mpc_steps_per_sec" not in r:  # errored A/B cell.
-            print(f"| {key} | ERROR: {r.get('error', '?')} | — | — |")
+        if "error" in r:
+            print(f"| {key} | ERROR: {r['error']} | — | — |")
+            continue
+        if "donated_ms_per_step" in r:  # the donated-resume A/B cell.
+            print(f"| {key} | donated {r['donated_ms_per_step']:.2f} ms vs "
+                  f"{r['undonated_ms_per_step']:.2f} ms "
+                  f"({r['speedup']:.2f}x; bitexact="
+                  f"{r['donated_bitexact_vs_undonated']}) | — | — |")
+            continue
+        if "scenario_mpc_steps_per_sec" not in r:
+            if "mpc_steps_per_sec" in r:  # sharded consensus A/B cell.
+                impl_s = (f" [{r['impl']}@{r['devices']}dev"
+                          f" rung={r.get('rung', '?')}]"
+                          if "impl" in r else "")
+                print(f"| {key} | {r['mpc_steps_per_sec']:.1f} "
+                      f"MPC-steps/s{impl_s} | — | — |")
+            else:
+                print(f"| {key} | ERROR: {r.get('error', '?')} | — | — |")
             continue
         agent_s = (f" ({r['agent_mpc_steps_per_sec']:.0f} agent-steps/s)"
                    if "agent_mpc_steps_per_sec" in r else "")

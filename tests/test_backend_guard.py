@@ -478,3 +478,57 @@ def test_sweep_survives_crash_and_wedge_with_tagged_cells(tmp_path):
     assert health.returncode == 0, health.stderr
     assert "backend health" in health.stdout
     assert "cpu-tagged" in health.stdout
+
+
+@pytest.mark.slow
+def test_ring_ab_and_donate_cells_survive_injected_fault(tmp_path):
+    """ISSUE 7 acceptance: the consensus-exchange A/B cells and the
+    donated-resume A/B cell ride the same guard contract — with a crash
+    injected on the first guarded call, ``bench.py --sweep`` still exits
+    0, the faulted sharded-ring cell re-runs on the tagged CPU rung, the
+    donate cell completes, and the backend_event trail validates.
+    (TAT_SWEEP_SHARDED_N=4 shrinks the sharded cells to a CI-sized twin;
+    cell keys carry the actual n.)"""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "TAT_SWEEP_SHARDED_N": "4",
+        "TAT_SWEEP_CELLS": r"^cadmm_n4_sharded_ring$|^chunked_resume_donate_ab$",
+        "TAT_BACKEND_FAULTS": "crash@1",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--sweep"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+
+    results = json.loads((tmp_path / "BENCH_SWEEP.json").read_text())
+    cells = {k: v for k, v in results.items() if not k.startswith("_")}
+    assert set(cells) == {"cadmm_n4_sharded_ring", "chunked_resume_donate_ab"}
+    ring_cell = cells["cadmm_n4_sharded_ring"]
+    assert "error" not in ring_cell
+    assert ring_cell["rung"] == b.RUNG_CPU
+    assert ring_cell["impl"] == "ring"
+    assert ring_cell["mpc_steps_per_sec"] > 0
+    donate = cells["chunked_resume_donate_ab"]
+    assert "error" not in donate
+    assert {"donated_ms_per_step", "undonated_ms_per_step",
+            "donated_bitexact_vs_undonated",
+            "donated_replay_bitexact"} <= set(donate)
+
+    metrics_path = tmp_path / "artifacts" / "bench_sweep.metrics.jsonl"
+    assert export_mod.validate_file(str(metrics_path)) == []
+    events = export_mod.read_events(str(metrics_path))
+    be = [e for e in events if e["event"] == "backend_event"]
+    assert [e["kind"] for e in be] == ["device_crash"]
+
+    # run_health renders the (unit, exchange impl, rung) table.
+    health = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_health.py"),
+         str(metrics_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert health.returncode == 0, health.stderr
+    assert "exchange impl" in health.stdout
+    assert "| cadmm_n4_sharded_ring | ring | cpu-tagged |" in health.stdout

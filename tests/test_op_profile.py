@@ -75,6 +75,11 @@ def _synth_space():
            tf_op="jit(step)/tat.local_solve/dot_general")
     _event(dev, ops, "fusion.7", 300.0,
            tf_op="jit(step)/tat.consensus/reduce_sum")
+    # The cross-device exchange itself (parallel/ring.py) — scoped
+    # SEPARATELY from the local consensus arithmetic so the ring-vs-
+    # allreduce A/B can read the wire share off the phase table.
+    _event(dev, ops, "all-reduce.2", 200.0,
+           tf_op="jit(step)/tat.consensus/tat.consensus_exchange/psum")
     _event(dev, ops, "copy.3", 100.0)  # no scope: unattributed.
     host_frames = _line(dev, "python")
     _event(dev, host_frames, "should_not_count", 1e6)
@@ -103,10 +108,14 @@ def test_phase_rollup_from_tf_op_stats():
     rows, op_total, attributed = op_profile.rollup_phases(
         op_profile.op_aggregate([_synth_space()]), hlo_map=None
     )
-    assert op_total == pytest.approx(1400.0)
-    assert attributed == pytest.approx(1300.0)
+    assert op_total == pytest.approx(1600.0)
+    assert attributed == pytest.approx(1500.0)
     assert rows["local_solve"]["total_us"] == pytest.approx(1000.0)
     assert rows["consensus"]["total_us"] == pytest.approx(300.0)
+    # The exchange is its own row (innermost scope wins over the enclosing
+    # tat.consensus): a regression that drops the scope from
+    # parallel/ring.py would move this time to (unattributed).
+    assert rows["consensus_exchange"]["total_us"] == pytest.approx(200.0)
     assert rows["(unattributed)"]["total_us"] == pytest.approx(100.0)
 
 
@@ -162,8 +171,72 @@ def test_phase_of_uses_innermost_scope():
     assert op_profile.phase_of(
         "jit(f)/tat.sharded_step/while/tat.local_solve/dot"
     ) == "local_solve"
+    assert op_profile.phase_of(
+        "jit(f)/tat.consensus/tat.consensus_exchange/ppermute"
+    ) == "consensus_exchange"
     assert op_profile.phase_of("jit(f)/while/dot") is None
     assert op_profile.phase_of(None) is None
+
+
+def test_phase_vocabulary_covers_consensus_exchange():
+    """The obs.phases vocabulary (the rollup's row names) must carry the
+    exchange phase: every impl of parallel.ring.consensus_exchange runs
+    inside this scope, and bench A/Bs read the wire share off it."""
+    from tpu_aerial_transport.obs import phases
+
+    assert phases.CONSENSUS_EXCHANGE == "consensus_exchange"
+    assert phases.CONSENSUS_EXCHANGE in phases.PHASES
+
+
+def test_real_trace_ring_exchange_attribution(tmp_path):
+    """End-to-end on a real capture of the ppermute ring exchange under
+    shard_map (the sharded consensus hot path's communication shape): the
+    exchange ops attribute under tat.consensus_exchange — NOT
+    (unattributed) — via the compiled-HLO op_name source, so a dropped
+    scope in parallel/ring.py fails tier-1 on CPU instead of silently
+    degrading the on-chip attribution bar."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_aerial_transport.parallel import mesh as mesh_mod
+    from tpu_aerial_transport.parallel import ring
+    from tpu_aerial_transport.utils import compat
+
+    d = 4
+    m = mesh_mod.make_mesh({"agent": d})
+
+    @jax.jit
+    @functools.partial(
+        compat.shard_map, mesh=m, in_specs=P("agent"),
+        out_specs=P("agent"), check_vma=False,
+    )
+    def step(v):
+        x = v[0]
+        for _ in range(8):  # enough exchange work to show up in the trace.
+            x = ring.consensus_exchange(
+                x, "agent", axis_size=d, op="sum", impl="ring"
+            ) / d
+        return x[None]
+
+    x = jnp.ones((d, 256, 128), jnp.float32)
+    step(x).block_until_ready()
+    trace_dir = str(tmp_path / "trace")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(3):
+            step(x).block_until_ready()
+    with open(os.path.join(trace_dir, "headline.hlo.txt"), "w") as fh:
+        fh.write(jax.jit(step).lower(x).compile().as_text())
+
+    agg = op_profile.op_aggregate(op_profile.load_xplanes(trace_dir))
+    assert agg, "no op events captured"
+    hlo_map = op_profile.load_hlo_map(op_profile.find_hlo_dump(trace_dir))
+    rows, op_total, _ = op_profile.rollup_phases(agg, hlo_map)
+    assert op_total > 0
+    assert "consensus_exchange" in rows, rows.keys()
+    assert rows["consensus_exchange"]["total_us"] > 0
 
 
 def test_real_trace_attribution_meets_bar(tmp_path):

@@ -1,0 +1,325 @@
+"""Ring-collective consensus exchange (parallel/ring.py) on the virtual
+multi-device CPU mesh (conftest.py): the ppermute ring tier must reproduce
+the psum/allreduce tier to f32 rounding for the raw exchange (payload sizes
+that do NOT divide the ring included — the chunk-pad path) and for the full
+C-ADMM / DD sharded control steps, nominal AND alive-masked (fault-
+injected); gathers are bitwise. Plus the auto-resolution gate: "auto" is
+allreduce on CPU (the existing headline keeps its program) and the
+chip-only pallas_ring downgrades to the XLA ring off-TPU at trace time."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_aerial_transport.control import cadmm, centralized, dd
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.parallel import mesh as mesh_mod
+from tpu_aerial_transport.parallel import ring
+from tpu_aerial_transport.resilience import faults as faults_mod
+from tpu_aerial_transport.utils import compat
+
+D = 4  # ring size for the raw-exchange tests (mesh uses 4 of the 8 devices).
+
+
+# ----------------------------- resolution gate -------------------------
+
+
+def test_resolve_auto_is_allreduce_on_cpu(monkeypatch):
+    monkeypatch.delenv(ring.ENV_VAR, raising=False)
+    assert ring.resolve_consensus("auto") == "allreduce"
+    assert ring.resolve_consensus(None) == "allreduce"
+
+
+def test_resolve_env_force_and_validation(monkeypatch):
+    monkeypatch.setenv(ring.ENV_VAR, "ring")
+    assert ring.resolve_consensus("auto") == "ring"
+    # An explicit impl wins over the env var (the env only resolves "auto").
+    assert ring.resolve_consensus("allreduce") == "allreduce"
+    monkeypatch.setenv(ring.ENV_VAR, "bogus")
+    with pytest.raises(ValueError, match="TPU_AERIAL_CONSENSUS"):
+        ring.resolve_consensus("auto")
+    monkeypatch.delenv(ring.ENV_VAR)
+    with pytest.raises(ValueError, match="consensus_impl"):
+        ring.resolve_consensus("bogus")
+
+
+def test_pallas_ring_downgrades_off_tpu():
+    """Trace-time downgrade (the socp._resolve_fused idiom): a config
+    forced to pallas_ring still compiles — as the XLA ring — when the
+    program lands on a non-TPU backend (e.g. the backend guard's CPU
+    fallback rung)."""
+    assert ring._resolve_impl("pallas_ring") == "ring"
+    assert ring._resolve_impl("ring") == "ring"
+    assert ring._resolve_impl("allreduce") == "allreduce"
+
+
+def test_make_config_resolves_auto_at_build_time(monkeypatch):
+    monkeypatch.delenv(ring.ENV_VAR, raising=False)
+    params, col, _ = setup.rqp_setup(4)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration
+    )
+    assert cfg.consensus_impl == "allreduce"  # CPU default: no wire to hide.
+    monkeypatch.setenv(ring.ENV_VAR, "ring")
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration
+    )
+    assert cfg.consensus_impl == "ring"
+    cfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration
+    )
+    assert cfg.base.consensus_impl == "ring"
+
+
+# ----------------------------- raw exchange ----------------------------
+
+
+def _shmap(fn, mesh):
+    return functools.partial(
+        compat.shard_map, mesh=mesh, in_specs=P("agent"),
+        out_specs=P("agent"), check_vma=False,
+    )(fn)
+
+
+def _exchange(x, op, impl, d=D):
+    m = mesh_mod.make_mesh({"agent": d})
+
+    @functools.partial(_shmap, mesh=m)
+    def step(v):
+        return ring.consensus_exchange(
+            v[0], "agent", axis_size=d, op=op, impl=impl
+        )[None]
+
+    return np.asarray(jax.jit(step)(x))
+
+
+@pytest.mark.parametrize("op", ["sum", "max", "min"])
+def test_exchange_parity_payload_not_divisible_by_ring(op):
+    """18 elements over a 4-ring: the reduce-scatter chunk-pad path. Sum
+    agrees to f32 rounding (summation order differs); max/min are exact
+    under any schedule — bitwise."""
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((D, 18)), jnp.float32
+    )
+    ref = _exchange(x, op, "allreduce")
+    out = _exchange(x, op, "ring")
+    if op == "sum":
+        assert np.abs(out - ref).max() <= 1e-5
+    else:
+        assert (out == ref).all()
+    # The result must be identical on every shard (reduce-scatter computes
+    # each chunk once, then broadcasts).
+    assert (out == out[0][None]).all()
+
+
+def test_exchange_parity_scalar_payload():
+    """1 element over a 4-ring (the residual-max shape): pad-dominated."""
+    x = jnp.asarray([[1.5], [-2.25], [0.5], [3.0]], jnp.float32)
+    for op in ("sum", "max", "min"):
+        ref = _exchange(x, op, "allreduce")
+        out = _exchange(x, op, "ring")
+        assert (out == ref).all(), op  # exact: 4 f32 values, tiny sums.
+
+
+def test_gather_bitwise_matches_all_gather():
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((D, 18)), jnp.float32
+    )
+    m = mesh_mod.make_mesh({"agent": D})
+
+    def g(impl):
+        @functools.partial(_shmap, mesh=m)
+        def step(v):
+            return ring.consensus_gather(
+                v[0], "agent", axis_size=D, impl=impl
+            )[None]
+
+        return np.asarray(jax.jit(step)(x))
+
+    ref, out = g("allreduce"), g("ring")
+    assert out.shape == (D, D, 18)
+    assert (out == ref).all()
+
+
+def test_exchange_axis_size_one_is_identity():
+    x = jnp.asarray([[1.0, 2.0, 3.0]], jnp.float32)
+    m = mesh_mod.make_mesh({"agent": 1})
+
+    @functools.partial(_shmap, mesh=m)
+    def step(v):
+        s = ring.consensus_exchange(
+            v[0], "agent", axis_size=1, op="sum", impl="ring"
+        )
+        g = ring.consensus_gather(v[0], "agent", axis_size=1, impl="ring")
+        return (s + g[0])[None]
+
+    assert np.asarray(jax.jit(step)(x)) == pytest.approx(
+        2.0 * np.asarray(x)
+    )
+
+
+# ------------------------ full sharded controllers ---------------------
+
+# Small iteration budget: the property under test is ring == allreduce,
+# which holds at ANY fixed iteration count (convergence is asserted in
+# test_cadmm.py / test_dd_rp.py; sharded == single-program in
+# test_parallel.py). Forces are ~5 N; the two impls differ only in f32
+# summation order, compounding over 4 consensus iterations.
+_TOL = 2e-3
+
+
+def _cadmm_cfg(params, col, impl):
+    return cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=4, inner_iters=8, consensus_impl=impl,
+    )
+
+
+def _dd_cfg(params, col, impl):
+    return dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=4, inner_iters=8, consensus_impl=impl,
+    )
+
+
+def _run_sharded(ctrl, impl, n=8, n_shards=4):
+    """One sharded control step through parallel.mesh with the given
+    consensus impl; returns (f, consensus residual)."""
+    params, col, state = setup.rqp_setup(n)
+    state = state.replace(vl=jnp.array([0.2, 0.1, 0.0], jnp.float32))
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+    f_eq = centralized.equilibrium_forces(params)
+    m = mesh_mod.make_mesh({"agent": n_shards})
+    if ctrl == "cadmm":
+        cfg = _cadmm_cfg(params, col, impl)
+        cs0 = cadmm.init_cadmm_state(params, cfg)
+        step = mesh_mod.cadmm_control_sharded(params, cfg, f_eq, m)
+    else:
+        cfg = _dd_cfg(params, col, impl)
+        cs0 = dd.init_dd_state(params, cfg)
+        step = mesh_mod.dd_control_sharded(params, cfg, f_eq, m)
+    f, _, stats = jax.jit(step)(cs0, state, acc_des)
+    return np.asarray(f), float(stats.solve_res)
+
+
+@pytest.mark.parametrize("ctrl", ["cadmm", "dd"])
+def test_sharded_ring_matches_allreduce(ctrl):
+    """impl="ring" == impl="allreduce" to f32 rounding for the full
+    agent-sharded control step (2 agents/shard: the block case)."""
+    f_ref, res_ref = _run_sharded(ctrl, "allreduce")
+    f_ring, res_ring = _run_sharded(ctrl, "ring")
+    assert np.abs(f_ring - f_ref).max() < _TOL, (ctrl, f_ring - f_ref)
+    assert abs(res_ring - res_ref) < _TOL
+
+
+def _run_masked(ctrl, impl, n=4):
+    """Alive-masked (fault-injected) sharded step: agent 0 dead, agent 2's
+    consensus message dropped — exercises the masked sums, the
+    alive-count denominator exchange, and (DD) the masked gather."""
+    params, col, state = setup.rqp_setup(n)
+    state = state.replace(vl=jnp.array([0.2, 0.1, 0.0], jnp.float32))
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+    health = faults_mod.FaultStep(
+        alive=jnp.array([False, True, True, True]),
+        thrust_scale=jnp.array([0.0, 1.0, 1.0, 1.0], jnp.float32),
+        msg_ok=jnp.array([False, True, False, True]),
+    )
+    m = mesh_mod.make_mesh({"agent": n})
+    warm_spec = jax.tree.map(lambda _: P("agent"), mesh_mod._warm_structure())
+    if ctrl == "cadmm":
+        cfg = _cadmm_cfg(params, col, impl)
+        f_eq = centralized.equilibrium_forces(params, alive=health.alive)
+        # Seed the held (last-delivered) snapshots like the resilience
+        # rollout adapters do, so the in/out state pytrees match.
+        cs0 = cadmm.init_cadmm_state(params, cfg)
+        cs0 = cs0.replace(held=cs0.f)
+        plan = cadmm.make_plan(params, cfg)
+        state_spec = cadmm.CADMMState(
+            f=P("agent"), lam=P("agent"), f_mean=P(), warm=warm_spec,
+            held=P("agent"),
+        )
+
+        def fn(cs, s, a, h):
+            return cadmm.control(
+                params, cfg, f_eq, cs, s, a, None, axis_name="agent",
+                plan=plan, health=h,
+            )
+    else:
+        cfg = _dd_cfg(params, col, impl)
+        f_eq = centralized.equilibrium_forces(params, alive=health.alive)
+        cs0 = dd.init_dd_state(params, cfg)
+        cs0 = cs0.replace(
+            held_f=cs0.f, held_lam_F=cs0.lam_F, held_lam_M=cs0.lam_M
+        )
+        plan = dd.make_dd_plan(params, cfg)
+        state_spec = dd.DDState(
+            f=P("agent"), F=P("agent"), M=P("agent"), lam_F=P("agent"),
+            lam_M=P("agent"), warm=warm_spec, held_f=P("agent"),
+            held_lam_F=P("agent"), held_lam_M=P("agent"),
+        )
+
+        def fn(cs, s, a, h):
+            return dd.control(
+                params, cfg, f_eq, cs, s, a, None, axis_name="agent",
+                plan=plan, health=h,
+            )
+
+    step = functools.partial(
+        compat.shard_map, mesh=m,
+        in_specs=(state_spec, P(), (P(), P()), P()),
+        out_specs=(P("agent"), state_spec, P()),
+        check_vma=False,
+    )(fn)
+    f, _, stats = jax.jit(step)(cs0, state, acc_des, health)
+    return np.asarray(f), float(stats.solve_res)
+
+
+# --------------------------- registry coverage -------------------------
+
+
+def test_ring_entrypoints_registered():
+    """ring.py has no scan/while/fori (the ring is unrolled over the
+    static axis size), so the generic hot-function coverage test in
+    test_jaxlint.py cannot see it — this test is what makes dropping the
+    ring entrypoints from the contract registry fail tier-1. The pallas
+    entry must also keep its WRITTEN TC106 lowering waiver (jax.export
+    cannot AOT-lower the Mosaic remote-DMA kernel off-chip)."""
+    from tpu_aerial_transport.analysis import contracts, entrypoints
+
+    required = (
+        "parallel.ring:consensus_exchange",
+        "parallel.ring:consensus_exchange_pallas",
+        "parallel.mesh:cadmm_control_sharded_ring",
+    )
+    for name in required:
+        assert name in entrypoints.CONTRACT_ENTRYPOINTS, name
+        assert name in contracts.REGISTRY, name
+    waiver = entrypoints.LOWERING_WAIVERS.get(
+        "parallel.ring:consensus_exchange_pallas"
+    )
+    assert waiver and len(waiver) > 40, (
+        "the chip-only pallas ring needs a written TC106 waiver reason"
+    )
+    # Tier-A traced-context inference must know ring.py's traced surface
+    # (consensus_exchange & co run under shard_map/jit).
+    traced = entrypoints.TRACED_FUNCTIONS[
+        "tpu_aerial_transport/parallel/ring.py"
+    ]
+    assert "consensus_exchange" in traced and "consensus_gather" in traced
+
+
+@pytest.mark.parametrize("ctrl", ["cadmm", "dd"])
+def test_sharded_ring_matches_allreduce_masked(ctrl):
+    """Ring parity holds for the alive-masked consensus too: dead-agent
+    zeroing, the psum'd n_alive denominator, and message-dropout masking
+    all ride the exchange seam."""
+    f_ref, res_ref = _run_masked(ctrl, "allreduce")
+    f_ring, res_ring = _run_masked(ctrl, "ring")
+    assert np.isfinite(f_ring).all()
+    assert np.abs(f_ring[0]).max() == 0.0  # dead agent applies zero force.
+    assert np.abs(f_ring - f_ref).max() < _TOL, (ctrl, f_ring - f_ref)
+    assert abs(res_ring - res_ref) < _TOL

@@ -24,6 +24,18 @@ fail=0
 echo "== jaxlint (Tier A) =="
 python tools/jaxlint.py "${PATHS[@]}" || fail=1
 
+echo "== jaxlint --contracts --target tpu (ring consensus entrypoints) =="
+# TC106 off-chip TPU lowering gate + Tier-B trace contracts over the
+# ring-exchange entrypoints (PR 7). The ring entries need a >=4-device
+# mesh, so force a 4-virtual-device CPU host — the gate is designed to
+# run off-chip (JAX_PLATFORMS=cpu even on a TPU box). The full registry
+# runs under `tools/jaxlint.py --contracts` / -m slow.
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
+python tools/jaxlint.py --contracts --target tpu \
+    --only parallel.ring:consensus_exchange,parallel.ring:consensus_exchange_pallas,parallel.mesh:cadmm_control_sharded_ring \
+    tpu_aerial_transport/parallel/ring.py || fail=1
+
 echo "== metrics jsonl schema (obs.export) =="
 shopt -s nullglob
 metrics_files=(artifacts/*.metrics.jsonl)

@@ -113,14 +113,22 @@ def summarize(events: list[dict]) -> dict:
     # ACTUALLY ran at (bench cells carry it in their value dict, chunk
     # events as a top-level field).
     bevents = [e for e in events if e.get("event") == "backend_event"]
-    rungs: list[tuple[str, str]] = []
+    # (unit, impl, rung) rows: impl is the consensus-exchange impl the
+    # ring A/B cells (bench.py _sharded_ab_cell) carry in their value dict
+    # — "impl(resolved)" when a pallas_ring cell downgraded off-TPU. Plain
+    # v2 bench_cell fields; no schema change.
+    rungs: list[tuple[str, str, str]] = []
     for e in cells:
         v = e.get("value")
         if isinstance(v, dict) and "rung" in v:
-            rungs.append((e["cell"], v["rung"]))
+            impl = v.get("impl", "")
+            resolved = v.get("impl_resolved", impl)
+            if resolved and resolved != impl:
+                impl = f"{impl}({resolved})"
+            rungs.append((e["cell"], impl, v["rung"]))
     for e in chunks:
         if "rung" in e:
-            rungs.append((f"chunk {e['chunk']}", e["rung"]))
+            rungs.append((f"chunk {e['chunk']}", "", e["rung"]))
     if bevents or rungs:
         kinds: dict[str, int] = {}
         for e in bevents:
@@ -244,10 +252,10 @@ def render(summary: dict) -> None:
                       f"(ran at {e.get('rung', '?')}): "
                       f"{(e.get('detail') or '')[:120]}")
         if be["rungs"]:
-            print("\n| unit | rung |")
-            print("|---|---|")
-            for unit, rung in be["rungs"]:
-                print(f"| {unit} | {rung} |")
+            print("\n| unit | exchange impl | rung |")
+            print("|---|---|---|")
+            for unit, impl, rung in be["rungs"]:
+                print(f"| {unit} | {impl or '—'} | {rung} |")
 
 
 def _fmt(v) -> str:

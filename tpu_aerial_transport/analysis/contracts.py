@@ -115,6 +115,14 @@ class Contract:
     # Entries whose lowering legitimately contains the string "callback"
     # (none today) would set this with a reason.
     allow_callbacks: str = ""
+    # Non-empty reason => the entry is registered for the TC106 lowering
+    # gate ONLY: check_entry skips the execution-based contracts
+    # (TC101-TC105 all run or lower the program on the LOCAL backend,
+    # which a chip-only kernel — e.g. the Pallas remote-DMA ring — cannot
+    # do on a CPU lint host). The entry still counts toward registry
+    # coverage and still runs through run_lowering_gate unless it also
+    # carries an entrypoints.LOWERING_WAIVERS row.
+    lowering_only: str = ""
 
 
 REGISTRY: dict[str, Contract] = {}
@@ -571,6 +579,102 @@ def _build_mesh_cadmm():
     return step, make_args
 
 
+@_register("parallel.mesh:cadmm_control_sharded_ring", min_devices=4)
+def _build_mesh_cadmm_ring():
+    from tpu_aerial_transport.control import cadmm, centralized
+    from tpu_aerial_transport.parallel import mesh as mesh_mod
+
+    params, col, state = _rqp_bits(4)
+    # The full agent-sharded consensus hot path on the ppermute ring tier
+    # (consensus_impl pinned "ring" — the CPU lint host's make_config
+    # "auto" resolves to allreduce); pad_operators pinned True so TC104
+    # checks the tile-target program like the allreduce twin above.
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=2, inner_iters=4, pad_operators=True,
+        consensus_impl="ring",
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    m = mesh_mod.make_mesh({"agent": 4})
+    step = mesh_mod.cadmm_control_sharded(params, cfg, f_eq, m)
+
+    def make_args():
+        return (cadmm.init_cadmm_state(params, cfg), _rqp_bits(4)[2], _acc())
+
+    return step, make_args
+
+
+def _ring_mesh_bits():
+    from functools import partial
+
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_aerial_transport.parallel import mesh as mesh_mod
+    from tpu_aerial_transport.utils import compat
+
+    d = 4
+    m = mesh_mod.make_mesh({"agent": d})
+
+    def shmap(fn, n_out):
+        return partial(
+            compat.shard_map, mesh=m, in_specs=P("agent"),
+            out_specs=tuple(P("agent") for _ in range(n_out))
+            if n_out > 1 else P("agent"),
+            check_vma=False,
+        )(fn)
+
+    def make_args():
+        rng = np.random.default_rng(0)
+        return (jnp.asarray(rng.standard_normal((d, 6, 3)), jnp.float32),)
+
+    return d, shmap, make_args
+
+
+@_register("parallel.ring:consensus_exchange", min_devices=4)
+def _build_ring_exchange():
+    """The exchange's three faces (sum, max, gather) on the ppermute ring
+    under shard_map, with a payload whose size does NOT divide the ring
+    (18 elements over 4 shards — the chunk-pad path)."""
+    from tpu_aerial_transport.parallel import ring as ring_mod
+
+    d, shmap, make_args = _ring_mesh_bits()
+
+    def fn(x):
+        v = x[0]
+        s = ring_mod.consensus_exchange(
+            v, "agent", axis_size=d, op="sum", impl="ring"
+        )
+        mx = ring_mod.consensus_exchange(
+            jnp.max(v), "agent", axis_size=d, op="max", impl="ring"
+        )
+        g = ring_mod.consensus_gather(v, "agent", axis_size=d, impl="ring")
+        return s[None], mx[None, None], g[None]
+
+    return shmap(fn, 3), make_args
+
+
+@_register(
+    "parallel.ring:consensus_exchange_pallas", min_devices=4,
+    lowering_only="Mosaic remote-DMA kernel: no CPU execution or "
+    "lowering; off-chip jax.export also fails (see the matching "
+    "entrypoints.LOWERING_WAIVERS reason)",
+)
+def _build_ring_exchange_pallas():
+    """The REAL remote-DMA kernel (not the off-TPU trace-time downgrade
+    consensus_exchange would apply on this host): if the
+    LOWERING_WAIVERS row is ever removed — e.g. after a jax upgrade —
+    TC106 must attempt the genuine Mosaic program."""
+    from tpu_aerial_transport.parallel import ring as ring_mod
+
+    d, shmap, make_args = _ring_mesh_bits()
+
+    def fn(x):
+        return ring_mod._pallas_ring_allreduce(x[0], "agent", d)[None]
+
+    return shmap(fn, 1), make_args
+
+
 @_register("parallel.mesh:scenario_rollout", min_devices=2)
 def _build_mesh_scenarios():
     from tpu_aerial_transport.harness import rollout as h_rollout
@@ -665,6 +769,8 @@ def check_entry(contract: Contract,
     path = f"contracts:{contract.name}"
     if jax.device_count() < contract.min_devices:
         return out  # environment cannot host this entry; not a finding.
+    if contract.lowering_only:
+        return out  # chip-only program: TC106 territory (see the field).
     fn, make_args = contract.build()
     jitted = fn if hasattr(fn, "lower") and hasattr(fn, "_cache_size") \
         else jax.jit(fn)

@@ -47,6 +47,7 @@ from tpu_aerial_transport.harness.bucketing import bucket_dim as _bucket_dim
 from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
 from tpu_aerial_transport.obs import phases
 from tpu_aerial_transport.ops import lie, socp
+from tpu_aerial_transport.parallel import ring
 from tpu_aerial_transport.control.centralized import (
     equilibrium_forces,
     smooth_block,
@@ -164,6 +165,16 @@ class RQPCADMMConfig:
     # residual (all_gathered to the full (n,) table under shard_map).
     # STATIC and default-off: the nominal program is bit-identical.
     track_agent_stats: bool = struct.field(pytree_node=False, default=False)
+    # Consensus-exchange implementation under shard_map (parallel/ring.py:
+    # "allreduce" = global psum/pmax barriers, "ring" = ppermute
+    # reduce-scatter/all-gather hops, "pallas_ring" = async remote-DMA TPU
+    # kernel overlapping the transfer with the local solve). The
+    # make_config default is backend-resolved ("auto" -> allreduce on CPU,
+    # ring on tiled backends — ring.resolve_consensus, incl. the
+    # TPU_AERIAL_CONSENSUS env override); this field always holds the
+    # RESOLVED name. Single-program (axis_name=None) steps never exchange,
+    # so the field is inert there.
+    consensus_impl: str = struct.field(pytree_node=False, default="allreduce")
 
 
 def make_config(
@@ -187,6 +198,7 @@ def make_config(
     solve_retry_iters: int = 4,
     pad_operators: bool | None = None,
     track_agent_stats: bool = False,
+    consensus_impl: str = "auto",
 ) -> RQPCADMMConfig:
     """Defaults are reference-conservative (max_iter mirrors the reference's
     100-iteration cap). For warm-started receding-horizon use, the measured
@@ -250,6 +262,10 @@ def make_config(
         # like socp_fused above: tile-padded on tiled backends, raw on CPU.
         pad_operators=socp.resolve_pad_operators(pad_operators),
         track_agent_stats=track_agent_stats,
+        # "auto" resolved here (config build time, outside jit) like
+        # socp_fused/pad_operators above: allreduce on CPU, ring on tiled
+        # backends (parallel/ring.py resolve_consensus).
+        consensus_impl=ring.resolve_consensus(consensus_impl),
     )
 
 
@@ -956,9 +972,11 @@ def control(
     With ``axis_name=None`` all n agents run in one program (vmap; single chip).
     Inside ``shard_map`` over a mesh axis named ``axis_name``, each shard holds a
     block of agents (the leading axis of every ``CADMMState`` leaf) and the
-    consensus mean/residual become ``lax.psum``/``pmax`` collectives over ICI —
-    the all-reduce pattern SURVEY.md §2.10 prescribes. ``state``/``acc_des``/
-    ``f_eq`` are replicated."""
+    consensus mean/residual become cross-shard collectives over ICI — realized
+    through the ``parallel.ring.consensus_exchange`` seam as global psum/pmax
+    barriers, ppermute ring hops, or the async-DMA Pallas ring per
+    ``cfg.consensus_impl`` (the all-reduce pattern SURVEY.md §2.10 prescribes,
+    decomposed). ``state``/``acc_des``/``f_eq`` are replicated."""
     n = params.n
     dtype = state.xl.dtype
 
@@ -968,20 +986,31 @@ def control(
     else:
         agent_ids = lax.axis_index(axis_name) * n_local + jnp.arange(n_local)
 
+    # Consensus-exchange seam (parallel/ring.py): every cross-shard
+    # collective goes through ONE impl-selected exchange, attributed under
+    # tat.consensus_exchange. Ring size is static: shard_map requires
+    # n % n_shards == 0 (parallel.mesh._sharded_control).
+    n_shards = 1 if axis_name is None else n // n_local
+
+    def _exch(x, op):
+        return ring.consensus_exchange(
+            x, axis_name, axis_size=n_shards, op=op, impl=cfg.consensus_impl
+        )
+
     def _mean_over_agents(x):
         if axis_name is None:
             return jnp.mean(x, axis=0)
-        return lax.psum(jnp.sum(x, axis=0), axis_name) / n
+        return _exch(jnp.sum(x, axis=0), "sum") / n
 
     def _max_over_agents(x):
         if axis_name is None:
             return jnp.max(x)
-        return lax.pmax(jnp.max(x), axis_name)
+        return _exch(jnp.max(x), "max")
 
     def _min_over_agents(x):
         if axis_name is None:
             return jnp.min(x)
-        return lax.pmin(jnp.min(x), axis_name)
+        return _exch(jnp.min(x), "min")
 
     r_local = jnp.take(params.r, agent_ids, axis=0)
 
@@ -999,7 +1028,7 @@ def control(
         alive_cols = health.alive.astype(dtype)  # (n,) global column mask.
         n_alive = jnp.sum(w_alive)
         if axis_name is not None:
-            n_alive = lax.psum(n_alive, axis_name)
+            n_alive = _exch(n_alive, "sum")
         n_alive = jnp.maximum(n_alive, 1.0)
         # Dead agents anchor to zero force (callers typically already pass
         # the alive-masked equilibrium_forces; the mask is idempotent).
@@ -1217,7 +1246,7 @@ def control(
                 f_eff = jnp.where(msg_ok_l[:, None, None], f_new, f_stale)
                 s = jnp.sum(f_eff * w_alive[:, None, None], axis=0)
                 if axis_name is not None:
-                    s = lax.psum(s, axis_name)
+                    s = _exch(s, "sum")
                 f_mean_new = s / n_alive
                 res_new = _max_over_agents(jnp.where(
                     contrib[:, None, None],
@@ -1319,7 +1348,10 @@ def control(
         # all_gathered to the full (n,) table when agents are sharded.
         agent_res = warm.prim_res
         if axis_name is not None:
-            agent_res = lax.all_gather(agent_res, axis_name).reshape(n)
+            agent_res = ring.consensus_gather(
+                agent_res, axis_name, axis_size=n_shards,
+                impl=cfg.consensus_impl,
+            ).reshape(n)
         stats = stats.replace(agent_solve_res=agent_res)
     return f_app, new_state, stats
 

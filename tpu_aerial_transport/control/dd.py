@@ -41,6 +41,7 @@ from tpu_aerial_transport.envs import forest as forest_mod
 from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
 from tpu_aerial_transport.obs import phases
 from tpu_aerial_transport.ops import lie, socp
+from tpu_aerial_transport.parallel import ring
 
 
 @struct.dataclass
@@ -71,6 +72,7 @@ def make_config(
     solve_retry_iters: int = 4,
     pad_operators: bool | None = None,
     track_agent_stats: bool = False,
+    consensus_impl: str = "auto",
 ) -> RQPDDConfig:
     """Defaults are reference-conservative. For warm-started receding-horizon
     use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
@@ -95,6 +97,7 @@ def make_config(
         inner_tol=inner_tol, inner_check_every=inner_check_every,
         solve_retry_iters=solve_retry_iters, pad_operators=pad_operators,
         track_agent_stats=track_agent_stats,
+        consensus_impl=consensus_impl,
     )
     return RQPDDConfig(base=base, prim_inf_tol=prim_inf_tol)
 
@@ -502,23 +505,38 @@ def control(
     else:
         agent_ids = lax.axis_index(axis_name) * n_local + jnp.arange(n_local)
 
+    # Consensus-exchange seam (parallel/ring.py): the price sums,
+    # violation sums, and dual-gradient gather all ride one impl-selected
+    # exchange, attributed under tat.consensus_exchange (see the matching
+    # construction in cadmm.control). n % n_shards == 0 is a shard_map
+    # precondition (parallel.mesh._sharded_control).
+    n_shards = 1 if axis_name is None else n // n_local
+    impl = cfg.base.consensus_impl
+
+    def _exch(x, op):
+        return ring.consensus_exchange(
+            x, axis_name, axis_size=n_shards, op=op, impl=impl
+        )
+
     def _sum_over_agents(x):
         s = jnp.sum(x, axis=0)
-        return s if axis_name is None else lax.psum(s, axis_name)
+        return s if axis_name is None else _exch(s, "sum")
 
     def _max_over_agents(x):
         s = jnp.max(x)
-        return s if axis_name is None else lax.pmax(s, axis_name)
+        return s if axis_name is None else _exch(s, "max")
 
     def _min_over_agents(x):
         s = jnp.min(x)
-        return s if axis_name is None else lax.pmin(s, axis_name)
+        return s if axis_name is None else _exch(s, "min")
 
     def _gather_blocks(x):
         """(n_local, d) local blocks -> (n, d) full table, shard-ordered."""
         if axis_name is None:
             return x
-        return lax.all_gather(x, axis_name).reshape(n, x.shape[-1])
+        return ring.consensus_gather(
+            x, axis_name, axis_size=n_shards, impl=impl
+        ).reshape(n, x.shape[-1])
 
     if health is not None:
         # Graceful-degradation masks (see the docstring; cadmm.control has

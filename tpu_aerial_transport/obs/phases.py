@@ -23,6 +23,8 @@ QP_BUILD = "qp_build"          # per-agent QP matrix assembly + KKT ops.
 CBF_ROWS = "cbf_rows"          # env CBF row construction (forest sweep).
 LOCAL_SOLVE = "local_solve"    # per-agent conic QP solves (inner ADMM).
 CONSENSUS = "consensus"        # consensus mean/residual all-reduce.
+CONSENSUS_EXCHANGE = "consensus_exchange"  # the cross-device exchange itself
+#                                (psum/ppermute/ring kernel; parallel/ring.py).
 DUAL_UPDATE = "dual_update"    # dual / price ascent step.
 DYNAMICS = "dynamics"          # physics substeps (integrate scan).
 PAD = "pad"                    # tile pad/unpad of operators & warm starts.
@@ -32,8 +34,8 @@ TELEMETRY = "telemetry"        # in-jit telemetry accumulation.
 SHARDED_STEP = "sharded_step"  # shard_map plumbing outside finer scopes.
 
 PHASES = (
-    QP_BUILD, CBF_ROWS, LOCAL_SOLVE, CONSENSUS, DUAL_UPDATE, DYNAMICS,
-    PAD, FAULTS, FALLBACK, TELEMETRY, SHARDED_STEP,
+    QP_BUILD, CBF_ROWS, LOCAL_SOLVE, CONSENSUS, CONSENSUS_EXCHANGE,
+    DUAL_UPDATE, DYNAMICS, PAD, FAULTS, FALLBACK, TELEMETRY, SHARDED_STEP,
 )
 
 

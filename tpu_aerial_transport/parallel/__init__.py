@@ -1,4 +1,22 @@
-"""Mesh parallelism: agent-sharded consensus (psum/pmax over ICI) and
-scenario-sharded Monte-Carlo batches."""
+"""Mesh parallelism: agent-sharded consensus (ring / psum collectives over
+ICI) and scenario-sharded Monte-Carlo batches.
 
-from tpu_aerial_transport.parallel import mesh  # noqa: F401
+``ring`` (the consensus-exchange tier) imports eagerly — the controllers
+import it at module load. ``mesh`` resolves LAZILY (PEP 562): it imports
+the controllers, so an eager import here would cycle through
+``control.cadmm -> parallel.ring -> parallel.__init__ -> mesh ->
+control.cadmm`` while cadmm is half-initialized. Every existing caller
+uses ``from tpu_aerial_transport.parallel import mesh`` (a submodule
+import, unaffected); attribute access ``parallel.mesh`` keeps working via
+``__getattr__``.
+"""
+
+from tpu_aerial_transport.parallel import ring  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "mesh":
+        import importlib
+
+        return importlib.import_module("tpu_aerial_transport.parallel.mesh")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,352 @@
+"""Consensus-exchange tier: the cross-device collective behind the C-ADMM
+consensus mean/residual and the DD price/violation sums, as a seam with
+three implementations behind one auto-resolved gate (the
+``socp.resolve_fused`` / ``resolve_pad_operators`` idiom):
+
+- ``"allreduce"`` — the historical realization: one global ``lax.psum`` /
+  ``pmax`` / ``pmin`` (or ``all_gather``) per exchange. XLA emits a fused
+  all-reduce that BLOCKS the program at a barrier: the consensus payload
+  cannot start moving until every shard reaches the collective, and no
+  shard resumes until the reduce completes.
+- ``"ring"`` — a pure-XLA ring decomposition into ``lax.ppermute`` hops:
+  sums run as reduce-scatter + all-gather over the ring (each complete
+  chunk is produced ONCE on one shard and broadcast, so the result is
+  bitwise-identical on every shard — unlike a per-shard accumulation
+  order); max/min/gather run as rotate-and-accumulate. Correct under
+  ``shard_map`` on ANY backend (the parity tier asserted on the virtual
+  multi-device CPU mesh, tests/test_ring.py), and the structural A/B twin
+  for the Pallas kernel: same neighbor-hop schedule, XLA-scheduled.
+- ``"pallas_ring"`` — the TPU-native tier (SNIPPETS.md [1] pattern): one
+  Pallas kernel whose per-hop neighbor transfer is an explicit
+  ``pltpu.make_async_remote_copy`` DMA. The kernel starts the DMA for hop
+  *i* and only then reduces the payload received at hop *i-1* on the VPU,
+  so the wire time hides under the reduce — and, because the exchange is
+  a kernel rather than an XLA collective barrier, the scheduler can
+  overlap it with the surrounding per-agent QP solve. Chip-only: the
+  remote-DMA primitives have no CPU lowering and (measured on jax 0.4.37)
+  no off-chip ``jax.export`` AOT lowering either — see
+  ``entrypoints.LOWERING_WAIVERS``; off-TPU the call degrades to the XLA
+  ring at trace time (``_resolve_impl``, the ``socp._resolve_fused``
+  idiom).
+
+Every exchange — whatever the impl — runs inside the
+``tat.consensus_exchange`` named scope (obs/phases.py), so
+``tools/op_profile.py --by-phase`` attributes the wire time separately
+from the local reduce arithmetic (``tat.consensus``) and the solve.
+
+The ring size is passed explicitly (``axis_size``): callers inside
+``shard_map`` know it statically (``n // n_local``), and threading it
+through avoids trace-time axis-env introspection.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_aerial_transport.obs import phases
+
+# Pallas/Mosaic imports live INSIDE the pallas_ring functions (the
+# ops/admm_kernel.py pattern): ring.py is imported at module scope by the
+# controllers, and a pure-CPU allreduce deployment must not need the
+# Pallas TPU extension just to import the control stack.
+
+IMPLS = ("allreduce", "ring", "pallas_ring")
+ENV_VAR = "TPU_AERIAL_CONSENSUS"
+
+# Mosaic collective id for the ring kernel's neighbor barrier (must agree
+# across all shards of one exchange; distinct from any future collective
+# kernel in the package).
+_COLLECTIVE_ID = 1
+
+_COMBINE = {"sum": jnp.add, "max": jnp.maximum, "min": jnp.minimum}
+_ALLREDUCE = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}
+
+
+def effective_platform() -> str:
+    """The platform computations actually land on: the ``jax.default_device``
+    config/context if set, else the default backend. The distinction matters
+    under the backend guard's CPU fallback (``resilience.backend.run_on_cpu``
+    wraps the re-run in ``jax.default_device(cpu)``): ``jax.default_backend()``
+    ignores that context and still reports the wedged chip's platform, so
+    keying the impl resolution (or a bench cell's mesh) on it would re-commit
+    the "CPU fallback" to the dead device."""
+    # Host-side query only (the socp._resolve_fused pattern), never traced.
+    dev = jax.config.jax_default_device  # jaxlint: disable=JL005
+    if dev is not None:
+        return dev.platform
+    return jax.default_backend()  # jaxlint: disable=JL005
+
+
+def resolve_consensus(impl: str | None = "auto") -> str:
+    """Resolve ``"auto"`` (or None) to the backend default, at CONFIG BUILD
+    time (the ``socp.resolve_fused`` idiom — resolving inside a jitted
+    function would bake the first backend seen into a trace cache keyed on
+    the "auto" string):
+
+    1. the ``TPU_AERIAL_CONSENSUS`` env var (``allreduce`` | ``ring`` |
+       ``pallas_ring`` | ``auto``/unset) — the per-process force switch;
+    2. else ``"allreduce"`` on CPU — a single-host psum is one fused
+       reduction with no wire to hide, so the ring's extra hops only add
+       scatter/gather bookkeeping (measured on the virtual 8-device CPU
+       mesh A/B, ``bench.py --sweep`` ``*_sharded_*`` cells at n=16:
+       ring 0.55x of allreduce for C-ADMM, 0.37x for DD — the hops
+       serialize on host) — and ``"ring"`` on tiled backends, where the
+       decomposed exchange is the tier the Pallas kernel A/Bs against.
+
+    **A/B criterion for flipping the non-CPU default to "pallas_ring"**
+    (kept here so the A/B and the flip live together): on a live chip the
+    checkpointed sweep's ``{cadmm,dd}_n64_sharded_pallas_ring`` cells must
+    beat their ``_sharded_ring`` twins by >= 10% with
+    ``tools/op_profile.py --by-phase`` showing the ``consensus_exchange``
+    share shrinking (the transfer actually hiding under the solve), and
+    the ring-vs-allreduce parity suite must pass on-chip. Until then,
+    deployments opt in per-process with ``TPU_AERIAL_CONSENSUS=pallas_ring``
+    (or per-config via ``consensus_impl="pallas_ring"``).
+    """
+    if impl is None:
+        impl = "auto"
+    if impl == "auto":
+        env = os.environ.get(ENV_VAR, "").strip().lower()
+        if env in IMPLS:
+            return env
+        if env not in ("", "auto"):
+            raise ValueError(
+                f"{ENV_VAR}={env!r}: expected one of {IMPLS} or 'auto'"
+            )
+        return "allreduce" if effective_platform() == "cpu" else "ring"
+    if impl not in IMPLS:
+        raise ValueError(
+            f"consensus_impl={impl!r}: expected one of {IMPLS} or 'auto'"
+        )
+    return impl
+
+
+def _resolve_impl(impl: str) -> str:
+    """Trace-time downgrade of ``pallas_ring`` off-TPU (the
+    ``socp._resolve_fused`` idiom): the remote-DMA kernel has no CPU/GPU
+    lowering, so a config forced to ``pallas_ring`` still compiles — and
+    stays a RING — when the program lands on a non-TPU backend (e.g. the
+    backend guard's CPU fallback rung re-running a sweep cell). Rejects
+    anything outside ``IMPLS`` — in particular an unresolved ``"auto"``
+    from a config built without ``make_config`` — instead of silently
+    taking the ring path."""
+    if impl not in IMPLS:
+        raise ValueError(
+            f"impl={impl!r}: expected one of {IMPLS} — resolve 'auto' at "
+            "config build time with resolve_consensus()"
+        )
+    # Host-side strings only (impl is static config; effective_platform is
+    # a trace-time host query — the socp._resolve_fused pattern), never a
+    # traced value.
+    if impl == "pallas_ring" and effective_platform() != "tpu":  # jaxlint: disable=JL005
+        return "ring"
+    return impl
+
+
+def _right_perm(d: int) -> list[tuple[int, int]]:
+    return [(i, (i + 1) % d) for i in range(d)]
+
+
+def consensus_exchange(x, axis_name: str, *, axis_size: int, op: str = "sum",
+                       impl: str = "allreduce"):
+    """All-reduce ``x`` (any shape, every shard holding a same-shaped
+    value) over the ``shard_map`` axis ``axis_name``, with ``op`` in
+    ``{"sum", "max", "min"}`` and the implementation selected by ``impl``
+    (see the module docstring; resolve ``"auto"`` with
+    :func:`resolve_consensus` at config build time).
+
+    Numerics: ``max``/``min`` are exact under any schedule. ``sum`` under
+    ``"ring"`` differs from ``psum`` only in summation order (f32
+    rounding) and is bitwise-identical ACROSS shards (reduce-scatter
+    computes each chunk once); under ``"pallas_ring"`` the per-shard
+    accumulation order differs per shard, so shards may disagree in the
+    last bits — exchange consumers that gate loop conditions use exact
+    reductions (max of residuals, sums of 0/1 flags), which stay uniform.
+    """
+    if op not in _COMBINE:
+        raise ValueError(f"op={op!r}: expected one of {tuple(_COMBINE)}")
+    with phases.scope(phases.CONSENSUS_EXCHANGE):
+        impl = _resolve_impl(impl)
+        if impl == "allreduce":
+            return _ALLREDUCE[op](x, axis_name)
+        if axis_size == 1:
+            return x
+        if impl == "pallas_ring" and op == "sum":
+            return _pallas_ring_allreduce(x, axis_name, axis_size)
+        if op == "sum":
+            return _ring_allreduce_sum(x, axis_name, axis_size)
+        # max/min: rotate-and-accumulate (exact; the residual payloads are
+        # scalars, so chunked reduce-scatter has nothing to amortize).
+        return _rotate_allreduce(x, axis_name, axis_size, _COMBINE[op])
+
+
+def consensus_gather(x, axis_name: str, *, axis_size: int,
+                     impl: str = "allreduce"):
+    """``lax.all_gather`` twin through the exchange seam: returns the
+    ``(axis_size, *x.shape)`` stack of every shard's ``x``, shard-ordered,
+    identical on every shard. The ring realization rotates each shard's
+    block around the ring (d-1 hops), scattering into the output by source
+    index — bitwise-identical to ``all_gather``, hop-for-hop the same
+    schedule as the ring reduce."""
+    with phases.scope(phases.CONSENSUS_EXCHANGE):
+        impl = _resolve_impl(impl)
+        # pallas_ring: gathers ride the XLA ring — the gathered payloads
+        # (DD's per-agent violation blocks) feed a replicated solve right
+        # after the hop, so there is no local reduce to hide a DMA under.
+        if impl == "allreduce" or axis_size == 1:
+            return lax.all_gather(x, axis_name)
+        return _ring_gather(x, axis_name, axis_size)
+
+
+def _ring_allreduce_sum(x, axis_name: str, d: int):
+    """Ring reduce-scatter + all-gather sum (2(d-1) ``ppermute`` hops of
+    1/d of the payload). Each shard accumulates running chunk sums from
+    its left neighbor and forwards them right; after d-1 hops shard *i*
+    owns the COMPLETE chunk ``(i+1) % d``, which the all-gather phase then
+    rotates to everyone. Payloads smaller than ``d`` pad (the "n not
+    divisible by the device count" case — pad chunks are zeros and sliced
+    off)."""
+    shape = x.shape
+    flat = x.reshape(-1)
+    size = flat.size
+    chunk = -(-size // d)
+    pad = chunk * d - size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunks = flat.reshape(d, chunk)
+    i = lax.axis_index(axis_name)
+    perm = _right_perm(d)
+    # Reduce-scatter: at hop s, shard i forwards its running sum of chunk
+    # (i - s) % d and folds the incoming one into chunk (i - s - 1) % d.
+    for s in range(d - 1):
+        buf = jnp.take(chunks, (i - s) % d, axis=0)
+        buf = lax.ppermute(buf, axis_name, perm)
+        chunks = chunks.at[(i - s - 1) % d].add(buf)
+    # All-gather: rotate the complete chunks around the ring.
+    for s in range(d - 1):
+        buf = jnp.take(chunks, (i + 1 - s) % d, axis=0)
+        buf = lax.ppermute(buf, axis_name, perm)
+        chunks = chunks.at[(i - s) % d].set(buf)
+    return chunks.reshape(-1)[:size].reshape(shape)
+
+
+def _rotate_allreduce(x, axis_name: str, d: int, combine):
+    """Rotate-and-accumulate ring all-reduce (d-1 full-payload hops): each
+    shard's contribution travels the whole ring, folded in on arrival.
+    Used for max/min (exact under any order)."""
+    acc = x
+    buf = x
+    perm = _right_perm(d)
+    for _ in range(d - 1):
+        buf = lax.ppermute(buf, axis_name, perm)
+        acc = combine(acc, buf)
+    return acc
+
+
+def _ring_gather(x, axis_name: str, d: int):
+    """Ring all-gather: rotate each shard's block right d-1 times; after
+    ``s`` hops the in-flight block is shard ``(i - s) % d``'s, scattered
+    into the output at its source index."""
+    i = lax.axis_index(axis_name)
+    out = jnp.zeros((d,) + x.shape, x.dtype).at[i].set(x)
+    buf = x
+    perm = _right_perm(d)
+    for s in range(1, d):
+        buf = lax.ppermute(buf, axis_name, perm)
+        out = out.at[(i - s) % d].set(buf)
+    return out
+
+
+# ----------------------------------------------------------------------
+# Pallas TPU ring kernel (chip-only; see the module docstring).
+# ----------------------------------------------------------------------
+
+_LANE = 128
+_SUBLANE = 8
+
+
+def _ring_sum_kernel(x_ref, o_ref, comm, send_sem, recv_sem, *,
+                     axis_name: str, d: int):
+    """Rotate-and-accumulate ring sum with the hop DMA overlapped against
+    the VPU reduce (SNIPPETS.md [1] / pallas_guide ring pattern, with one
+    deliberate change: PER-HOP comm slots instead of a 2-slot double
+    buffer). With 2 reusable slots and d >= 3, the left neighbor may run
+    up to d-1 hops ahead (its progress is gated around the ring, not by
+    us), so its hop-(s+2) DMA could overwrite a slot our hop-s send is
+    still reading — avoiding that needs credit-based flow control. Per-hop
+    slots make every buffer write-once (left's hop-s DMA targets slot s+1,
+    which we touch only after waiting ``recv_sem[s+1]``), which deletes
+    the race outright and costs ``d * payload`` VMEM — trivial for the
+    consensus payloads (a few KB). The overlap the double buffer exists
+    for is kept: hop s STARTS its DMA, then reduces the hop-(s-1) payload
+    while the wire is busy, then waits."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, d)
+    left = lax.rem(my + d - 1, d)
+    # Neighbor barrier: nobody starts DMAing until both neighbors' kernels
+    # hold their scratch buffers (pallas_guide "Local Barrier" pattern).
+    barrier = pltpu.get_barrier_semaphore()
+    for neighbor in (left, right):
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id=(neighbor,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+    pltpu.semaphore_wait(barrier, 2)
+    o_ref[...] = x_ref[...]
+    comm[0] = x_ref[...]
+    for s in range(d - 1):
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm.at[s],
+            dst_ref=comm.at[s + 1],  # written on the RIGHT neighbor; ours
+            #                          is filled by the left's mirror copy.
+            send_sem=send_sem.at[s],
+            recv_sem=recv_sem.at[s + 1],
+            device_id=(right,),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        # Overlap: reduce the payload received at hop s-1 (or our own at
+        # s=0 — already accumulated, so skip) while the hop-s DMA flies.
+        if s:
+            o_ref[...] += comm[s]
+        rdma.wait()
+    o_ref[...] += comm[d - 1]
+
+
+def _pallas_ring_allreduce(x, axis_name: str, d: int):
+    """Run the ring-sum kernel over a tile-padded 2-D view of ``x``: the
+    flat payload lands in an (R, 128) f32 tile block (R a sublane-tile
+    multiple), zero-padded — pad lanes sum to zero and are sliced off."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    size = flat.size
+    rows = -(-size // _LANE)
+    rows = -(-rows // _SUBLANE) * _SUBLANE
+    buf = jnp.zeros((rows * _LANE,), dtype).at[:size].set(flat)
+    buf = buf.reshape(rows, _LANE)
+    kernel = functools.partial(_ring_sum_kernel, axis_name=axis_name, d=d)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((d, rows, _LANE), dtype),
+            pltpu.SemaphoreType.DMA((d,)),
+            pltpu.SemaphoreType.DMA((d,)),
+        ],
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=_COLLECTIVE_ID,
+        ),
+    )(buf)
+    return out.reshape(-1)[:size].reshape(shape)
