@@ -367,9 +367,16 @@ def build(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
 def measure(step, css, states, device, n_steps, n_scenarios, reps=3):
     css = jax.device_put(css, device)
     states = jax.device_put(states, device)
-    # Compile + warmup at the timed length so the timed calls hit the cache.
+    # Compile + warmup at the timed length so the timed calls hit the
+    # cache; its wall time is what THIS process paid before its first
+    # measured step (previously folded into nothing), returned as
+    # compile_wall_s. Under a warm persistent XLA cache that is a
+    # cache-load, not a compile — compare rows only under the same
+    # _meta.xla_cache_dir state (the sweep stamps it).
+    t0 = time.perf_counter()
     out = step(css, states, n_steps)
     jax.block_until_ready(out[1].xl)
+    compile_wall_s = time.perf_counter() - t0
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -378,7 +385,7 @@ def measure(step, css, states, device, n_steps, n_scenarios, reps=3):
         times.append(time.perf_counter() - t0)
     # Median over reps: one-off dispatch/timing glitches produced wildly
     # wrong single-sample readings through the device tunnel.
-    return n_scenarios * n_steps / float(np.median(times))
+    return (n_scenarios * n_steps / float(np.median(times)), compile_wall_s)
 
 
 def ref_arch_cpu_rate(n=N_AGENTS, max_iter=20, inner_iters=20, n_steps=5):
@@ -476,9 +483,12 @@ def headline(profile_dir: str | None = None, platform: str = "unknown",
     timed_steps = CPU_TIMED_STEPS if on_cpu else TIMED_STEPS
     step, css, states = build(socp_fused=socp_fused, buckets=buckets,
                               inner_tol=inner_tol)
+    compile_wall_s = None
     if profile_dir:
         # Warm up outside the trace so the profile shows steady-state execution.
-        measure(step, css, states, jax.devices()[0], timed_steps, N_SCENARIOS)
+        _, compile_wall_s = measure(
+            step, css, states, jax.devices()[0], timed_steps, N_SCENARIOS
+        )
         # Compiled-HLO dump next to the trace: op_name metadata maps each
         # instruction to its tat.* named scope, which op_profile.py
         # --by-phase rolls op self-time up to (CPU traces carry no per-
@@ -492,18 +502,18 @@ def headline(profile_dir: str | None = None, platform: str = "unknown",
         except Exception as e:  # profiling aid only — never sink the bench.
             print(f"# headline HLO dump failed: {e}", flush=True)
         with jax.profiler.trace(profile_dir):
-            tpu_rate = measure(
+            tpu_rate, _ = measure(
                 step, css, states, jax.devices()[0], timed_steps, N_SCENARIOS
             )
     else:
-        tpu_rate = measure(
+        tpu_rate, compile_wall_s = measure(
             step, css, states, jax.devices()[0], timed_steps, N_SCENARIOS
         )
     if on_cpu:
         vs_xla_cpu = 1.0  # the measurement IS the XLA-CPU rate.
     else:
         try:
-            cpu_rate = measure(
+            cpu_rate, _ = measure(
                 step, css, states, jax.devices("cpu")[0], CPU_TIMED_STEPS,
                 N_SCENARIOS,
             )
@@ -536,6 +546,11 @@ def headline(profile_dir: str | None = None, platform: str = "unknown",
         "vs_baseline": _finite_or_none(vs_ref),
         "vs_ref_arch_cpu": _finite_or_none(vs_ref),
         "vs_xla_cpu": _finite_or_none(vs_xla_cpu),
+        # First-call wall time (compile + warmup) — what a fresh process
+        # pays before its first measured step (previously folded into
+        # nothing; under --profile it comes from the pre-trace warmup).
+        "compile_wall_s": (None if compile_wall_s is None
+                           else round(compile_wall_s, 2)),
     }
     if backend_note:
         out["backend_note"] = backend_note
@@ -565,8 +580,10 @@ def _single_stream(controller, n, n_steps=50, pad_operators=None):
         return cs, s, iters
 
     jitted = jax.jit(roll)
+    t0 = time.perf_counter()
     cs, s, iters = jitted(cs0, state0)  # compile + warmup.
     jax.block_until_ready(s.xl)
+    compile_wall_s = time.perf_counter() - t0
     # Median-of-3 like measure(): a single timed call was the dominant
     # noise source on shared/cpu-share-throttled hosts (observed 2x
     # run-to-run swings on identical programs).
@@ -584,6 +601,7 @@ def _single_stream(controller, n, n_steps=50, pad_operators=None):
     out = {
         "mpc_steps_per_sec": 1.0 / per_step,
         "step_ms_mean": per_step * 1e3,
+        "compile_wall_s": compile_wall_s,
     }
     # Time per consensus/ADMM iteration — the BASELINE.json metric. Only
     # meaningful for the distributed solvers (centralized reports iters = -1,
@@ -617,15 +635,18 @@ def _single_stream_donated(controller, n, n_steps=50, reps=3):
     jitted = jax.jit(roll, donate_argnums=(0, 1))
     # Decouple constant-deduped leaves before donating (see
     # harness.rollout.jit_rollout's shared-buffer caveat).
+    t0 = time.perf_counter()
     cs, s = jitted(*jax.tree.map(jnp.copy, (cs0, state0)))
     jax.block_until_ready(s.xl)
+    compile_wall_s = time.perf_counter() - t0
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         cs, s = jitted(cs, s)
         jax.block_until_ready(s.xl)
         times.append(time.perf_counter() - t0)
-    return {"step_ms_donated": float(np.median(times)) / n_steps * 1e3}
+    return {"step_ms_donated": float(np.median(times)) / n_steps * 1e3,
+            "compile_wall_s": compile_wall_s}
 
 
 SCALING_PATH = "BENCH_SCALING.json"
@@ -698,7 +719,8 @@ def _batched(controller, n, n_scenarios, n_steps=10, socp_fused="auto",
                               inner_tol=inner_tol,
                               substep_unroll=substep_unroll,
                               pad_operators=pad_operators)
-    return measure(step, css, states, jax.devices()[0], n_steps, n_scenarios)
+    return measure(step, css, states, jax.devices()[0], n_steps,
+                   n_scenarios)  # -> (rate, compile_wall_s)
 
 
 def _measured_iter_ms(controller, n, k_lo=4, k_hi=24, n_steps=30):
@@ -715,6 +737,7 @@ def _measured_iter_ms(controller, n, k_lo=4, k_hi=24, n_steps=30):
     batched iteration's wall time IS the slowest agent's — the same
     statistic by construction."""
     per_step = {}
+    compile_wall_s = 0.0
     for k in (k_lo, k_hi):
         mpc_step, cs0, state0 = make_mpc_step(
             controller, n, max_iter=k, force_fixed_iters=True
@@ -730,8 +753,10 @@ def _measured_iter_ms(controller, n, k_lo=4, k_hi=24, n_steps=30):
             return jax.lax.scan(body, (cs, state), None, length=n_steps)[0]
 
         jitted = jax.jit(roll)
+        t0 = time.perf_counter()
         cs, s = jitted(cs0, state0)
         jax.block_until_ready(s.xl)
+        compile_wall_s += time.perf_counter() - t0
         times = []
         for _ in range(3):
             t0 = time.perf_counter()
@@ -743,6 +768,7 @@ def _measured_iter_ms(controller, n, k_lo=4, k_hi=24, n_steps=30):
         "ms_per_consensus_iter_measured":
             (per_step[k_hi] - per_step[k_lo]) / (k_hi - k_lo) * 1e3,
         "fixed_iter_step_ms": {str(k): v * 1e3 for k, v in per_step.items()},
+        "compile_wall_s": compile_wall_s,  # both fixed-iter arms summed.
     }
 
 
@@ -797,8 +823,10 @@ def _sharded_ab_cell(controller, n, impl, n_steps=10, max_iter=8):
         return jax.lax.scan(body, (cs, state), None, length=n_steps)[0]
 
     jitted = jax.jit(roll, static_argnames="n_steps")
+    t0 = time.perf_counter()
     out = jitted(cs0, state0, n_steps=n_steps)
     jax.block_until_ready(jax.tree.leaves(out)[0])
+    compile_wall_s = time.perf_counter() - t0
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -811,6 +839,7 @@ def _sharded_ab_cell(controller, n, impl, n_steps=10, max_iter=8):
         "impl_resolved": ring_mod._resolve_impl(impl),
         "devices": n_shards,
         "n": n,
+        "compile_wall_s": compile_wall_s,
     }
 
 
@@ -863,7 +892,9 @@ def _donated_resume_cell(n=4, n_hl_steps=8, n_chunks=4):
             jax.block_until_ready(fs.xl)
             return fs, fc
 
+        t0 = time.perf_counter()
         once()  # compile + warm.
+        compile_wall_s = time.perf_counter() - t0
         times, finals = [], []
         for _ in range(3):
             t0 = time.perf_counter()
@@ -871,10 +902,11 @@ def _donated_resume_cell(n=4, n_hl_steps=8, n_chunks=4):
             times.append(time.perf_counter() - t0)
         # finals[-2:] are same-program replays with different allocation
         # history — exactly the axis the XLA-CPU wart varies along.
-        return float(np.median(times)) / n_hl_steps * 1e3, finals
+        return (float(np.median(times)) / n_hl_steps * 1e3, finals,
+                compile_wall_s)
 
-    undonated_ms, finals_u = run_arm(False)
-    donated_ms, finals_d = run_arm(True)
+    undonated_ms, finals_u, compile_u = run_arm(False)
+    donated_ms, finals_d, compile_d = run_arm(True)
 
     def bitexact(a, b):
         return bool(all(
@@ -890,7 +922,92 @@ def _donated_resume_cell(n=4, n_hl_steps=8, n_chunks=4):
         "donated_bitexact_vs_undonated": bitexact(finals_d[-1], finals_u[-1]),
         "donated_replay_bitexact": bitexact(finals_d[-1], finals_d[-2]),
         "n": n, "chunks": n_chunks,
+        "compile_wall_s": compile_u + compile_d,  # both arms summed.
     }
+
+
+# Cold-start ladder A/B (tpu_aerial_transport/aot/): what a FRESH process
+# pays to serve its first registered control step, one cell per
+# fallback-ladder rung. The entry is the registered C-ADMM control step —
+# the program every serving replica dispatches first.
+COLDSTART_ENTRY = "control.cadmm:control"
+COLDSTART_SERVE_TIMEOUT_S = 420.0
+COLDSTART_BUILD_TIMEOUT_S = 600.0
+
+
+def _coldstart_cell(mode: str, platform: str) -> dict:
+    """Time-to-first-step of a fresh subprocess serving
+    :data:`COLDSTART_ENTRY` through ``tools/aot_bundle.py serve``:
+
+    - ``bundled``: from the AOT bundle's precompiled executable — the
+      zero-compile acceptance row (``--expect-zero-compile``: the child
+      exits 3 if it traced/lowered/compiled ANYTHING);
+    - ``cached``: ordinary jit under a WARM persistent XLA cache (the
+      cell clears a cell-private cache dir, pays one unmeasured populate
+      run, then measures — a fleet's steady state, not first-populate);
+    - ``cold``: ordinary jit, no cache — the pre-bundle world.
+
+    Self-contained: the bundled arm (re)builds ``artifacts/aot/<platform>``
+    first — exec artifacts bind to the exact jaxlib/XLA fingerprint, so
+    serving a stale cached bundle would silently measure the export rung
+    instead. Build/populate run OUTSIDE the measured window (separate
+    subprocesses); every subprocess runs group-killable under its own
+    timeout (resilience.backend.run_group). The child's ladder rung is
+    returned as ``serve_rung`` — the ``rung`` key belongs to the backend
+    guard."""
+    from tpu_aerial_transport.resilience import backend as backend_mod
+    from tpu_aerial_transport.utils.platform import XLA_CACHE_ENV
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "aot_bundle.py")
+    env = dict(os.environ, JAX_PLATFORMS=platform)
+    # The rung under test is the ONLY warm state the child sees: the
+    # parent's cache knob must not leak into the bundled/cold arms.
+    env[XLA_CACHE_ENV] = ""
+    bundle_dir = os.path.join("artifacts", "aot", platform)
+    cache_dir = os.path.join("artifacts", "aot", f"xla-cache-{platform}")
+
+    def run(cmd, timeout_s):
+        proc = backend_mod.run_group(cmd, timeout_s, env=env)
+        if proc.returncode != 0:
+            tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+            raise RuntimeError(
+                f"coldstart_{mode} child rc={proc.returncode}: "
+                + " | ".join(tail)
+            )
+        return proc
+
+    serve_cmd = [sys.executable, tool, "serve",
+                 "--entry", COLDSTART_ENTRY, "--mode", mode]
+    if mode == "bundled":
+        run([sys.executable, tool, "build", "--out", bundle_dir,
+             "--entry", COLDSTART_ENTRY], COLDSTART_BUILD_TIMEOUT_S)
+        serve_cmd += ["--bundle", bundle_dir, "--expect-zero-compile"]
+    elif mode == "cached":
+        import shutil
+
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        run(serve_cmd + ["--cache-dir", cache_dir],
+            COLDSTART_SERVE_TIMEOUT_S)  # populate, unmeasured.
+        serve_cmd += ["--cache-dir", cache_dir]
+
+    t0 = time.monotonic()
+    proc = run(serve_cmd, COLDSTART_SERVE_TIMEOUT_S)
+    wall = round(time.monotonic() - t0, 2)
+    row = None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            row = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if not isinstance(row, dict) or "ttfs_s" not in row:
+        raise RuntimeError(
+            f"coldstart_{mode}: no JSON row in serve output"
+        )
+    row["serve_rung"] = row.pop("rung")
+    row["process_wall_s"] = wall
+    return row
 
 
 SWEEP_PARTIAL_PATH = "BENCH_SWEEP_PARTIAL.json"
@@ -952,7 +1069,13 @@ def sweep(resume: bool = False, platform: str | None = None):
 
     head = _git_head()
     journal = RunJournal(".", filename=SWEEP_JOURNAL_PATH)
-    results = {"_meta": {"git_head": head}}
+    results = {"_meta": {
+        "git_head": head,
+        # compile_wall_s provenance: under a warm persistent cache the
+        # first call is a cache-load, not a compile — rows are only
+        # comparable across rounds under the same cache state.
+        "xla_cache_dir": jax.config.jax_compilation_cache_dir or None,
+    }}
     if os.path.exists(SWEEP_PARTIAL_PATH) and not resume:
         raise SystemExit(
             f"{SWEEP_PARTIAL_PATH} exists (a crashed sweep's checkpoint, "
@@ -1054,6 +1177,26 @@ def sweep(resume: bool = False, platform: str | None = None):
     def want(key: str) -> bool:
         return cells_pat is None or bool(cells_pat.search(key))
 
+    # A cell-filtered run re-measures ONLY the matching cells: carry the
+    # existing BENCH_SWEEP.json's other cells forward instead of silently
+    # replacing hours of prior measurements with a near-empty record. The
+    # mixed provenance is stamped, never silent: _meta lists the carried
+    # cells and the head they were measured at.
+    if cells_pat is not None and os.path.exists("BENCH_SWEEP.json"):
+        try:
+            with open("BENCH_SWEEP.json") as fh:
+                prior = json.load(fh)
+        except ValueError:
+            prior = {}
+        carried = {k: v for k, v in prior.items()
+                   if k != "_meta" and not cells_pat.search(k)
+                   and k not in results}
+        if carried:
+            results.update(carried)
+            results["_meta"]["carried_cells"] = sorted(carried)
+            results["_meta"]["carried_from_head"] = (
+                prior.get("_meta", {}).get("git_head", "unknown"))
+
     def guarded_cell(key, fn, *args, unpadded=False, **kw):
         """Measure one cell through the guard; the returned value dict
         carries ``rung`` (on-chip / on-chip-unpadded / cpu-tagged)."""
@@ -1068,14 +1211,16 @@ def sweep(resume: bool = False, platform: str | None = None):
         return {**value, "rung": ran_at}
 
     def _batched_cell(kw) -> dict:
-        rate = _batched(kw["controller"], kw["n"], kw["n_scenarios"],
-                        socp_fused=kw.get("socp_fused", "auto"),
-                        buckets=kw.get("buckets", 0),
-                        inner_tol=kw.get("inner_tol", 0.0),
-                        substep_unroll=kw.get("substep_unroll", 1),
-                        pad_operators=kw.get("pad_operators"))
+        rate, compile_wall_s = _batched(
+            kw["controller"], kw["n"], kw["n_scenarios"],
+            socp_fused=kw.get("socp_fused", "auto"),
+            buckets=kw.get("buckets", 0),
+            inner_tol=kw.get("inner_tol", 0.0),
+            substep_unroll=kw.get("substep_unroll", 1),
+            pad_operators=kw.get("pad_operators"))
         return {"scenario_mpc_steps_per_sec": rate,
-                "agent_mpc_steps_per_sec": rate * kw["n"]}
+                "agent_mpc_steps_per_sec": rate * kw["n"],
+                "compile_wall_s": compile_wall_s}
 
     # Consensus-exchange A/B cells (parallel/ring.py) — run FIRST with the
     # other decision cells: the next chip round reads the
@@ -1087,9 +1232,15 @@ def sweep(resume: bool = False, platform: str | None = None):
     # pallas cells are chip-only). TAT_SWEEP_SHARDED_N is a test/debug
     # hook shrinking the agent count (the fault-injection e2e sweeps a
     # cheap n=4 twin; keys carry the actual n).
+    # Platform for cell-selection decisions: the (subprocess-watchdogged)
+    # probe's verdict when the caller passed one — touching
+    # jax.devices() here would be the first IN-PROCESS backend init,
+    # unwatchdogged on this thread (the guard only pays that inside
+    # run()'s deadline; see the guard comment above).
+    sweep_platform = platform or jax.devices()[0].platform
     ab_n = int(os.environ.get("TAT_SWEEP_SHARDED_N", "64"))
     ring_impls = ["allreduce", "ring"]
-    if jax.devices()[0].platform != "cpu":
+    if sweep_platform != "cpu":
         ring_impls.append("pallas_ring")
     for ctrl in ("cadmm", "dd"):
         for impl in ring_impls:
@@ -1110,6 +1261,59 @@ def sweep(resume: bool = False, platform: str | None = None):
         except Exception as e:
             record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
 
+    # Cold-start ladder A/B (tpu_aerial_transport/aot/): time-to-first-
+    # step of a FRESH process per fallback-ladder rung — the zero-compile
+    # acceptance row reads coldstart_bundled.ttfs_s against
+    # coldstart_cold.ttfs_s (≥5x on the CPU tier). Fresh subprocesses, so
+    # the parent's compile/cache state cannot leak into any arm; each
+    # cell's serve rung lands in the metrics file as an aot_serve event
+    # (schema v3) for tools/run_health.py. Meaningful on any backend; the
+    # guard's CPU fallback re-measures the ladder on the host.
+    cs_platform = sweep_platform
+    for cs_mode in ("bundled", "cached", "cold"):
+        key = f"coldstart_{cs_mode}"
+        if not want(key) or (key in results
+                             and "error" not in results[key]):
+            continue
+        try:
+            # The cell's own child timeouts legitimately allow build +
+            # serve (bundled) or populate + serve (cached) — the guard's
+            # default 600 s deadline would misclassify a healthy slow
+            # build as wedge_timeout (a breaker strike) AND leave the
+            # abandoned build child racing the CPU fallback's rebuild
+            # into the same bundle dir.
+            value, ran_at = guard.run(
+                key,
+                lambda m=cs_mode: _coldstart_cell(m, cs_platform),
+                fallback_fn=lambda m=cs_mode: _coldstart_cell(m, "cpu"),
+                deadline_s=(COLDSTART_BUILD_TIMEOUT_S
+                            + 2 * COLDSTART_SERVE_TIMEOUT_S + 60.0),
+            )
+            record(key, {**value, "rung": ran_at})
+            metrics.emit(
+                "aot_serve", entry=COLDSTART_ENTRY, label=key,
+                rung=value["serve_rung"], wall_s=value["ttfs_s"],
+            )
+        except Exception as e:
+            record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+    have = {m: results.get(f"coldstart_{m}") for m in ("bundled", "cold")}
+    if (want("coldstart_speedup")
+            and "coldstart_speedup" not in results
+            and all(v and "ttfs_s" in v for v in have.values())):
+        record("coldstart_speedup", {
+            # ttfs excludes interpreter + jax import (paid at deploy,
+            # before any request — see tools/aot_bundle.py cmd_serve);
+            # process_wall is the whole subprocess, import included.
+            "bundled_vs_cold_ttfs":
+                have["cold"]["ttfs_s"] / have["bundled"]["ttfs_s"],
+            "bundled_vs_cold_process_wall":
+                have["cold"]["process_wall_s"]
+                / have["bundled"]["process_wall_s"],
+            "bundled_compiles":
+                have["bundled"]["backend_compiles"],
+            "cold_compiles": have["cold"]["backend_compiles"],
+        })
+
     # The round-5 A/B cells run right after the ring/donate decision
     # cells above: if the tunnel dies mid-sweep, the checkpoint must
     # already hold the cells that decide default flips
@@ -1119,7 +1323,7 @@ def sweep(resume: bool = False, platform: str | None = None):
     # config x {scan, pallas} x {0, 2 buckets}, plus the n=64 fused A/B.
     # TPU-only — the Pallas kernel has no CPU lowering worth timing and the
     # bucketing question (worst-lane while_loop drag) is a device question.
-    if jax.devices()[0].platform != "cpu":
+    if sweep_platform != "cpu":
         ab_cells = [
             (f"headline_fused_{fused}_buckets{nb}",
              dict(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
@@ -1268,10 +1472,20 @@ def sweep(resume: bool = False, platform: str | None = None):
                   f"{per_iter_s} |")
     for key in [k for k in results
                 if "batch" in k or "swarm" in k or "fused" in k
-                or "innertol" in k or "sharded" in k or "donate" in k]:
+                or "innertol" in k or "sharded" in k or "donate" in k
+                or "coldstart" in k]:
         r = results[key]
         if "error" in r:
             print(f"| {key} | ERROR: {r['error']} | — | — |")
+            continue
+        if "ttfs_s" in r:  # cold-start ladder cell (aot/).
+            print(f"| {key} | TTFS {r['ttfs_s']:.2f} s "
+                  f"[{r['serve_rung']}, {r['backend_compiles']} compiles, "
+                  f"rung={r.get('rung', '?')}] | — | — |")
+            continue
+        if "bundled_vs_cold_ttfs" in r:  # derived cold-start ratio.
+            print(f"| {key} | bundled {r['bundled_vs_cold_ttfs']:.1f}x "
+                  f"faster than cold to first step | — | — |")
             continue
         if "donated_ms_per_step" in r:  # the donated-resume A/B cell.
             print(f"| {key} | donated {r['donated_ms_per_step']:.2f} ms vs "
@@ -1738,6 +1952,13 @@ def main():
                          "A/B switch, see BASELINE.md round 5)")
     args = ap.parse_args()
     _honor_jax_platforms_env()
+    # Persistent XLA compilation cache — the SAME knob as the test
+    # conftest and the AOT serve driver (TAT_XLA_CACHE_DIR; "" disables).
+    # Bench programs are identical run-to-run, so a bench_retry re-attempt
+    # or a --resume'd sweep skips the backend compiles the crashed attempt
+    # already paid instead of recompiling the matrix from scratch.
+    from tpu_aerial_transport.utils.platform import enable_persistent_cache
+    enable_persistent_cache()
     # Same precedence order as the dispatch chain below, so a backend-probe
     # failure is always labeled with the mode that would have run.
     mode_metric = ("bench_smoke" if args.smoke

@@ -25,16 +25,10 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent XLA compilation cache (works on CPU since jax 0.4.30s): the
 # suite is COMPILE-bound — the 8-virtual-device shard_map tests alone cost
 # ~7 min of XLA time per cold run — and programs are identical run-to-run,
-# so warm re-runs cut tier-1 wall time severalfold. Keyed by program HLO +
-# compile options + jax/XLA version, so config changes miss cleanly. The
-# dir is gitignored; override with TAT_XLA_CACHE_DIR, disable with
-# TAT_XLA_CACHE_DIR="".
-_cache_dir = os.environ.get(
-    "TAT_XLA_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".cache", "xla"),
-)
-if _cache_dir:
-    os.makedirs(_cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    # Only persist programs worth the disk round-trip.
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# so warm re-runs cut tier-1 wall time severalfold. One shared knob
+# (utils/platform.py): override with TAT_XLA_CACHE_DIR, disable with
+# TAT_XLA_CACHE_DIR=""; bench.py, the bench_retry children, and the AOT
+# serve driver route through the same helper.
+from tpu_aerial_transport.utils.platform import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()

@@ -1,0 +1,552 @@
+"""AOT artifact bundles (tpu_aerial_transport/aot/): round-trip parity
+(serve-from-bundle ≡ jit output bitwise for the cadmm/dd control steps and
+chunked_rollout on the CPU target), manifest refusals (stale exec
+fingerprint, treedef/signature mismatch, corrupt object), registry
+coverage drift, the serve fallback ladder + aot_serve metrics events, the
+bundle-warmed backend probe, and the acceptance proof: a FRESH subprocess
+serving a registered control step from the bundle with zero traces /
+lowerings / backend compiles (tools/aot_bundle.py serve
+--expect-zero-compile — the whole-process flavor of the TC101 cache-miss
+counting)."""
+
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.analysis import contracts
+from tpu_aerial_transport.aot import bundle as bundle_mod
+from tpu_aerial_transport.aot import loader as loader_mod
+from tpu_aerial_transport.aot.bundle import BundleError
+from tpu_aerial_transport.resilience import backend as backend_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The parity surface the issue names: both distributed control steps plus
+# the chunked rollout (the recovery tier's one compiled chunk).
+PARITY_ENTRIES = (
+    "control.cadmm:control",
+    "control.dd:control",
+    "harness.rollout:chunked_rollout",
+)
+
+
+def _load_aot_cli():
+    spec = importlib.util.spec_from_file_location(
+        "aot_bundle_cli", os.path.join(REPO, "tools", "aot_bundle.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="session")
+def cpu_bundle_dir(tmp_path_factory):
+    """One real CPU bundle for the session: the three parity entries plus
+    the probe entry every bundle carries."""
+    out = str(tmp_path_factory.mktemp("aot") / "cpu")
+    bundle_mod.build_bundle(out, platform="cpu", names=list(PARITY_ENTRIES))
+    return out
+
+
+@pytest.fixture(scope="session")
+def cpu_bundle(cpu_bundle_dir):
+    return loader_mod.load_bundle(cpu_bundle_dir)
+
+
+def _leaves_bitwise_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    if len(la) != len(lb):
+        return False
+    return all(
+        np.asarray(x).dtype == np.asarray(y).dtype
+        and np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+# ----------------------------------------------------------------------
+# Round-trip parity.
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("entry", PARITY_ENTRIES)
+def test_roundtrip_parity_exec(cpu_bundle, entry):
+    """Serving from the bundle's serialized executable is BITWISE the jit
+    output — same program, same backend, no re-lowering drift."""
+    fn, make_args = contracts.REGISTRY[entry].build()
+    args = make_args()
+    want = jax.jit(fn)(*args)
+    got, rung = cpu_bundle.call(entry, args)
+    assert rung == loader_mod.RUNG_EXEC
+    assert jax.tree.structure(got) == jax.tree.structure(want)
+    assert _leaves_bitwise_equal(got, want)
+
+
+def test_roundtrip_parity_export_rung(cpu_bundle):
+    """The export (StableHLO replay) rung serves the same bits too — the
+    ladder's downgrade path must not change results."""
+    entry = "control.cadmm:control"
+    fn, make_args = contracts.REGISTRY[entry].build()
+    args = make_args()
+    want = jax.jit(fn)(*args)
+    got, rung = cpu_bundle.call(entry, args, rung=loader_mod.RUNG_EXPORT)
+    assert rung == loader_mod.RUNG_EXPORT
+    assert _leaves_bitwise_equal(got, want)
+
+
+def test_probe_entry_runs(cpu_bundle):
+    out = loader_mod.call_probe(cpu_bundle)
+    assert np.isfinite(float(out))
+
+
+def test_exec_artifact_survives_warm_compilation_cache(tmp_path):
+    """REGRESSION: an executable the persistent compilation cache hands
+    back re-serializes WITHOUT its compiled object code ("Symbols not
+    found" at deserialize) — a bundle built on a warm cache (any test or
+    bench host) used to publish corrupt exec artifacts. The builder now
+    forces a real compile; the SECOND build below, whose backend compile
+    would otherwise be a cache hit, must still serve on the exec rung."""
+    cache_before = jax.config.jax_compilation_cache_dir
+    min_before = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        # Everything persists (min compile time 0), so even the small
+        # probe program reproduces the cache-hit build.
+        jax.config.update("jax_compilation_cache_dir",
+                          str(tmp_path / "xla-cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        bundle_mod.build_bundle(str(tmp_path / "b1"), platform="cpu",
+                                names=[])  # populates the cache.
+        bundle_mod.build_bundle(str(tmp_path / "b2"), platform="cpu",
+                                names=[])  # cache-hit build.
+        b2 = loader_mod.load_bundle(str(tmp_path / "b2"))
+        out, rung = b2.call(bundle_mod.PROBE_ENTRY, b2.probe_args())
+        assert rung == loader_mod.RUNG_EXEC
+        assert np.isfinite(float(jax.tree.leaves(out)[0]))
+    finally:
+        jax.config.update("jax_compilation_cache_dir", cache_before)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_before)
+
+
+# ----------------------------------------------------------------------
+# Refusals.
+# ----------------------------------------------------------------------
+
+def _tampered_copy(cpu_bundle_dir, tmp_path, mutate):
+    dst = str(tmp_path / "tampered")
+    shutil.copytree(cpu_bundle_dir, dst)
+    mpath = os.path.join(dst, bundle_mod.MANIFEST_NAME)
+    with open(mpath) as fh:
+        manifest = json.load(fh)
+    mutate(manifest, dst)
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh)
+    return loader_mod.load_bundle(dst)
+
+
+def test_stale_fingerprint_refusal(cpu_bundle_dir, tmp_path):
+    """An exec artifact built under a different jaxlib refuses with
+    ``bundle_stale`` — and the default ladder falls through to the export
+    rung instead of serving a possibly-ABI-incompatible executable."""
+    entry = "control.cadmm:control"
+
+    def mutate(manifest, _dst):
+        art = manifest["entries"][entry]["variants"][0]["artifacts"]["exec"]
+        art["fingerprint"]["jaxlib"] = "0.0.0-stale"
+
+    b = _tampered_copy(cpu_bundle_dir, tmp_path, mutate)
+    _, make_args = contracts.REGISTRY[entry].build()
+    args = make_args()
+    with pytest.raises(BundleError) as ei:
+        b.call(entry, args, rung=loader_mod.RUNG_EXEC)
+    assert ei.value.kind == "bundle_stale"
+    assert "rebuild" in str(ei.value)
+    # Default ladder: stale exec downgrades to the export rung, still
+    # serving without a retrace.
+    out, rung = b.call(entry, args)
+    assert rung == loader_mod.RUNG_EXPORT
+    fn, _ = contracts.REGISTRY[entry].build()
+    assert _leaves_bitwise_equal(out, jax.jit(fn)(*args))
+
+
+def test_bundle_stale_classified_not_breaker(tmp_path):
+    """The taxonomy files a stale bundle as a BUILD artifact problem: its
+    kind never indicts the chip (circuit breaker ignores it)."""
+    err = BundleError("bundle_stale", str(tmp_path), "fingerprint differs")
+    assert backend_mod.classify(str(err)) == "bundle_stale"
+    assert "bundle_stale" not in backend_mod.BREAKER_KINDS
+    assert "bundle_stale" in backend_mod.ERROR_KINDS
+
+
+def test_treedef_mismatch_refusal(cpu_bundle):
+    entry = "control.cadmm:control"
+    _, make_args = contracts.REGISTRY[entry].build()
+    args = make_args()
+    with pytest.raises(BundleError) as ei:
+        cpu_bundle.call(entry, list(args))  # tuple -> list: new structure.
+    assert ei.value.kind == "treedef_mismatch"
+
+
+def test_signature_mismatch_refusal(cpu_bundle):
+    """Same pytree structure, different leaf shape: no precompiled
+    variant — refuse rather than silently recompile."""
+    import jax.numpy as jnp
+
+    with pytest.raises(BundleError) as ei:
+        cpu_bundle.call(
+            bundle_mod.PROBE_ENTRY, (jnp.ones((64, 64), jnp.float32),)
+        )
+    assert ei.value.kind == "signature_mismatch"
+
+
+def test_corrupt_object_refusal(cpu_bundle_dir, tmp_path):
+    dst = str(tmp_path / "corrupt")
+    shutil.copytree(cpu_bundle_dir, dst)
+    objdir = os.path.join(dst, bundle_mod.OBJECTS_DIR)
+    for name in sorted(os.listdir(objdir)):
+        path = os.path.join(objdir, name)
+        with open(path, "r+b") as fh:
+            first = fh.read(1)
+            fh.seek(0)
+            fh.write(bytes([first[0] ^ 0xFF]))
+    b = loader_mod.load_bundle(dst)
+    _, make_args = contracts.REGISTRY["control.cadmm:control"].build()
+    with pytest.raises(BundleError) as ei:
+        b.call("control.cadmm:control", make_args())
+    assert ei.value.kind == "corrupt"
+
+
+def test_unreadable_and_newer_schema_refusal(tmp_path):
+    with pytest.raises(BundleError) as ei:
+        loader_mod.load_bundle(str(tmp_path / "nope"))
+    assert ei.value.kind == "unreadable"
+    d = tmp_path / "future"
+    d.mkdir()
+    (d / bundle_mod.MANIFEST_NAME).write_text(
+        json.dumps({"schema": bundle_mod.SCHEMA_VERSION + 1})
+    )
+    with pytest.raises(BundleError) as ei:
+        loader_mod.load_bundle(str(d))
+    assert ei.value.kind == "schema"
+
+
+# ----------------------------------------------------------------------
+# Coverage drift (the CI gate's core).
+# ----------------------------------------------------------------------
+
+def test_coverage_diff_missing_and_ok(tmp_path):
+    """A manifest-only bundle restricted to one entry reports every other
+    registered entrypoint as missing; the full record diffs clean."""
+    out = str(tmp_path / "subset")
+    manifest = bundle_mod.build_bundle(
+        out, platform="cpu", names=["control.cadmm:control"],
+        manifest_only=True,
+    )
+    diff = bundle_mod.coverage_diff(manifest)
+    assert not diff["ok"]
+    assert "control.dd:control" in diff["missing"]
+
+    full = str(tmp_path / "full")
+    manifest = bundle_mod.build_bundle(full, platform="cpu",
+                                       manifest_only=True)
+    diff = bundle_mod.coverage_diff(manifest)
+    assert diff["ok"], diff
+
+
+def test_coverage_diff_unregistered_entry_fails(tmp_path):
+    """A NEW registry entrypoint the bundle predates (simulated by
+    dropping it from the manifest) is drift — exactly what lands when an
+    entrypoint is registered without a bundle rebuild. The CLI check
+    exits 1 on it."""
+    out = str(tmp_path / "drift")
+    bundle_mod.build_bundle(out, platform="cpu", manifest_only=True)
+    mpath = os.path.join(out, bundle_mod.MANIFEST_NAME)
+    with open(mpath) as fh:
+        manifest = json.load(fh)
+    del manifest["entries"]["control.dd:control"]
+    manifest["entries"]["ops.retired:gone"] = {"variants": [{"sig": "x"}]}
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh)
+
+    diff = bundle_mod.coverage_diff(manifest)
+    assert not diff["ok"]
+    assert "control.dd:control" in diff["missing"]
+    assert "ops.retired:gone" in diff["stale"]
+
+    cli = _load_aot_cli()
+    ns = type("NS", (), {"bundle": out, "manifest_hint": True})
+    assert cli.cmd_check(ns) == 1
+
+
+def test_coverage_diff_changed_signature(tmp_path):
+    out = str(tmp_path / "changed")
+    bundle_mod.build_bundle(out, platform="cpu", manifest_only=True)
+    mpath = os.path.join(out, bundle_mod.MANIFEST_NAME)
+    with open(mpath) as fh:
+        manifest = json.load(fh)
+    manifest["entries"]["control.cadmm:control"]["variants"][0]["sig"] = \
+        "0" * 16
+    diff = bundle_mod.coverage_diff(manifest)
+    assert not diff["ok"]
+    assert any("control.cadmm:control" in c for c in diff["changed"])
+
+
+# ----------------------------------------------------------------------
+# Shape buckets.
+# ----------------------------------------------------------------------
+
+def test_bucketed_batch_rounds_to_tile():
+    import jax.numpy as jnp
+
+    args = (jnp.arange(3 * 5, dtype=jnp.float32).reshape(3, 5),)
+    bargs, b = bundle_mod.bucketed_batch(args, 0, 5)
+    assert b == 8 and bargs[0].shape == (8, 5)
+    # Tiled cyclically from the originals (values only seed compilation).
+    np.testing.assert_array_equal(
+        np.asarray(bargs[0][:3]), np.asarray(args[0])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bargs[0][3:6]), np.asarray(args[0])
+    )
+
+
+def test_variant_for_batch_selection(tmp_path):
+    manifest = {
+        "schema": bundle_mod.SCHEMA_VERSION,
+        "platform": "cpu",
+        "entries": {"e": {"variants": [
+            {"sig": "a", "artifacts": {}},
+            {"sig": "b", "artifacts": {}, "batch": 16},
+            {"sig": "c", "artifacts": {}, "batch": 8},
+        ]}},
+        "skipped": {},
+    }
+    b = loader_mod.Bundle(str(tmp_path), manifest)
+    assert b.variant_for_batch("e", 5)["batch"] == 8
+    assert b.variant_for_batch("e", 12)["batch"] == 16
+    assert b.variant_for_batch("e", 99)["batch"] == 16  # largest wins.
+    with pytest.raises(BundleError):
+        loader_mod.Bundle(str(tmp_path), {
+            "schema": 1, "platform": "cpu", "skipped": {},
+            "entries": {"e": {"variants": [{"sig": "a", "artifacts": {}}]}},
+        }).variant_for_batch("e", 5)
+
+
+def test_abstract_signature_shape_only():
+    """The signature keys on treedef + avals, not values — computable
+    from ShapeDtypeStructs without tracing."""
+    import jax.numpy as jnp
+
+    concrete = (jnp.ones((4, 3), jnp.float32), jnp.zeros((2,), jnp.int32))
+    structs = (jax.ShapeDtypeStruct((4, 3), jnp.float32),
+               jax.ShapeDtypeStruct((2,), jnp.int32))
+    assert (bundle_mod.abstract_signature(concrete)
+            == bundle_mod.abstract_signature(structs))
+    other = (jnp.ones((4, 4), jnp.float32), jnp.zeros((2,), jnp.int32))
+    assert (bundle_mod.abstract_signature(concrete)
+            != bundle_mod.abstract_signature(other))
+
+
+# ----------------------------------------------------------------------
+# The serve ladder + metrics events.
+# ----------------------------------------------------------------------
+
+def test_serve_ladder_rungs_and_metrics(cpu_bundle, tmp_path):
+    from tpu_aerial_transport.obs import export as export_mod
+
+    entry = "control.cadmm:control"
+    fn, make_args = contracts.REGISTRY[entry].build()
+    args = make_args()
+    path = str(tmp_path / "serve.metrics.jsonl")
+    metrics = export_mod.MetricsWriter(path, meta={"mode": "test"})
+
+    out_b, rung_b = loader_mod.serve_entry(
+        cpu_bundle, entry, args, metrics=metrics
+    )
+    assert rung_b == loader_mod.RUNG_EXEC
+    out_j, rung_j = loader_mod.serve_entry(
+        None, entry, args, jit_fallback=fn, metrics=metrics
+    )
+    # The suite's conftest configures the persistent cache, so the jit
+    # fallback lands on the cached rung here.
+    assert rung_j == (loader_mod.RUNG_JIT_CACHED
+                      if jax.config.jax_compilation_cache_dir
+                      else loader_mod.RUNG_JIT_COLD)
+    assert _leaves_bitwise_equal(out_b, out_j)
+
+    assert export_mod.validate_file(path) == []
+    events = [json.loads(ln) for ln in open(path)]
+    serves = [e for e in events if e.get("event") == "aot_serve"]
+    assert [e["rung"] for e in serves] == [rung_b, rung_j]
+    assert all(e["entry"] == entry and "wall_s" in e for e in serves)
+
+
+def test_serve_coverage_miss_falls_through_to_jit(cpu_bundle, tmp_path):
+    """A COVERAGE miss (signature_mismatch: no precompiled variant for
+    this shape) degrades to the jit fallback — the ladder's job."""
+    import jax.numpy as jnp
+
+    from tpu_aerial_transport.obs import export as export_mod
+
+    path = str(tmp_path / "miss.metrics.jsonl")
+    metrics = export_mod.MetricsWriter(path, meta={"mode": "test"})
+    args = (jnp.ones((64, 64), jnp.float32),)
+    out, rung = loader_mod.serve_entry(
+        cpu_bundle, bundle_mod.PROBE_ENTRY, args,
+        jit_fallback=lambda x: (x @ x).sum(), metrics=metrics,
+    )
+    assert rung in (loader_mod.RUNG_JIT_CACHED, loader_mod.RUNG_JIT_COLD)
+    ev = [json.loads(ln) for ln in open(path)][-1]
+    assert ev["tried"] == ["bundle[signature_mismatch]"]
+
+
+def test_serve_integrity_failure_raises_despite_fallback(
+        cpu_bundle_dir, tmp_path):
+    """An INTEGRITY failure (bitrotted object) re-raises even when a jit
+    fallback exists — a corrupt artifact must not silently become a cold
+    compile; the operator-visible error event is the contract."""
+    from tpu_aerial_transport.obs import export as export_mod
+
+    dst = str(tmp_path / "rot")
+    shutil.copytree(cpu_bundle_dir, dst)
+    objdir = os.path.join(dst, bundle_mod.OBJECTS_DIR)
+    for fname in sorted(os.listdir(objdir)):
+        with open(os.path.join(objdir, fname), "r+b") as fh:
+            first = fh.read(1)
+            fh.seek(0)
+            fh.write(bytes([first[0] ^ 0xFF]))
+    b = loader_mod.load_bundle(dst)
+    entry = "control.cadmm:control"
+    fn, make_args = contracts.REGISTRY[entry].build()
+    path = str(tmp_path / "rot.metrics.jsonl")
+    metrics = export_mod.MetricsWriter(path, meta={"mode": "test"})
+    with pytest.raises(BundleError) as ei:
+        loader_mod.serve_entry(b, entry, make_args(), jit_fallback=fn,
+                               metrics=metrics)
+    assert ei.value.kind == "corrupt"
+    assert ei.value.kind in loader_mod.INTEGRITY_KINDS
+    ev = [json.loads(ln) for ln in open(path)][-1]
+    assert ev["rung"] == "error" and "corrupt" in ev["error"]
+
+
+def test_cpu_kernel_binding_failure_downgrades_to_export(
+        cpu_bundle, monkeypatch):
+    """If the LAPACK custom-call binding is unavailable (jaxlib
+    reshuffled the private module), the exec rung REFUSES with
+    exec_unavailable — dispatching unbound kernels segfaults, it does not
+    raise — and the default ladder serves the export rung instead."""
+    monkeypatch.setattr(loader_mod, "_cpu_kernels_state",
+                        "ImportError: no jaxlib.cpu._lapack")
+    entry = "control.cadmm:control"
+    _, make_args = contracts.REGISTRY[entry].build()
+    args = make_args()
+    with pytest.raises(BundleError) as ei:
+        cpu_bundle.call(entry, args, rung=loader_mod.RUNG_EXEC)
+    assert ei.value.kind == "exec_unavailable"
+    out, rung = cpu_bundle.call(entry, args)
+    assert rung == loader_mod.RUNG_EXPORT
+    fn, _ = contracts.REGISTRY[entry].build()
+    assert _leaves_bitwise_equal(out, jax.jit(fn)(*args))
+
+
+def test_serve_error_journaled_then_raised(cpu_bundle, tmp_path):
+    """A bundle failure with NO fallback re-raises AFTER journaling — a
+    corrupt artifact must not become an invisible cold compile."""
+    from tpu_aerial_transport.obs import export as export_mod
+
+    path = str(tmp_path / "err.metrics.jsonl")
+    metrics = export_mod.MetricsWriter(path, meta={"mode": "test"})
+    import jax.numpy as jnp
+
+    with pytest.raises(BundleError):
+        loader_mod.serve_entry(
+            cpu_bundle, bundle_mod.PROBE_ENTRY,
+            (jnp.ones((64, 64), jnp.float32),), metrics=metrics,
+        )
+    events = [json.loads(ln) for ln in open(path)]
+    errs = [e for e in events if e.get("event") == "aot_serve"]
+    assert len(errs) == 1 and errs[0]["rung"] == "error"
+    assert "signature_mismatch" in errs[0]["error"]
+
+
+# ----------------------------------------------------------------------
+# Bundle-warmed backend probe.
+# ----------------------------------------------------------------------
+
+def test_probe_subprocess_prefers_bundle(cpu_bundle_dir):
+    notes: list = []
+    ok, detail = backend_mod.probe_subprocess(
+        timeout_s=120.0, bundle_dir=cpu_bundle_dir, notes=notes
+    )
+    assert ok and detail == "cpu"
+    assert notes == ["bundle"]
+
+
+def test_probe_subprocess_stale_bundle_surfaces_note(
+        cpu_bundle_dir, tmp_path):
+    """A STALE exec fingerprint surfaces in the probe notes (the rebuild
+    hint), instead of the ladder silently absorbing it into the export
+    rung's backend compile: call_probe pins the exec rung, so the stale
+    refusal falls back to the compile probe inside the subprocess."""
+    dst = str(tmp_path / "stale")
+    shutil.copytree(cpu_bundle_dir, dst)
+    mpath = os.path.join(dst, bundle_mod.MANIFEST_NAME)
+    with open(mpath) as fh:
+        manifest = json.load(fh)
+    art = manifest["entries"][bundle_mod.PROBE_ENTRY]["variants"][0][
+        "artifacts"]["exec"]
+    art["fingerprint"]["jaxlib"] = "0.0.0-stale"
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh)
+    notes: list = []
+    ok, detail = backend_mod.probe_subprocess(
+        timeout_s=120.0, bundle_dir=dst, notes=notes
+    )
+    assert ok and detail == "cpu"
+    assert len(notes) == 1 and notes[0].startswith("bundle_fallback:")
+    assert "bundle_stale" in notes[0]
+
+
+def test_probe_subprocess_bundle_fallback_note(tmp_path):
+    """A missing/stale bundle downgrades to the compile probe INSIDE the
+    subprocess: the chip still validates, the note carries the classified
+    bundle problem (a rebuild hint, never a probe failure)."""
+    notes: list = []
+    ok, detail = backend_mod.probe_subprocess(
+        timeout_s=120.0, bundle_dir=str(tmp_path / "absent"), notes=notes
+    )
+    assert ok and detail == "cpu"
+    assert len(notes) == 1 and notes[0].startswith("bundle_fallback:")
+
+
+# ----------------------------------------------------------------------
+# The acceptance proof: zero-compile cold start in a fresh process.
+# ----------------------------------------------------------------------
+
+def test_zero_compile_fresh_subprocess(cpu_bundle_dir):
+    """A FRESH subprocess loading the CPU bundle executes the registered
+    C-ADMM control step with 0 traces, 0 MLIR lowerings, and 0 XLA
+    backend compiles — counted by jax's monitoring events over the WHOLE
+    process (the process-level twin of TC101's per-function cache-miss
+    counting). ``--expect-zero-compile`` makes the child itself exit 3 on
+    any compile, so the proof cannot rot into a warning."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TAT_XLA_CACHE_DIR="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "aot_bundle.py"),
+         "serve", "--entry", "control.cadmm:control", "--mode", "bundled",
+         "--bundle", cpu_bundle_dir, "--expect-zero-compile"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["rung"] == loader_mod.RUNG_EXEC
+    assert (row["traces"], row["lowerings"], row["backend_compiles"]) \
+        == (0, 0, 0)
+    assert row["ttfs_s"] > 0

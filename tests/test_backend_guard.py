@@ -437,6 +437,13 @@ def test_sweep_survives_crash_and_wedge_with_tagged_cells(tmp_path):
         "TAT_BACKEND_FAULTS": "crash@1,wedge=30",
         "TAT_BACKEND_DEADLINE_S": "0.5",
     })
+    # A prior full record: the cell-filtered run must CARRY its
+    # non-matching cells forward (stamped in _meta), not replace hours of
+    # measurements with a two-cell file.
+    (tmp_path / "BENCH_SWEEP.json").write_text(json.dumps({
+        "_meta": {"git_head": "feedf00d"},
+        "legacy_cell": {"mpc_steps_per_sec": 123.0},
+    }))
     t0 = time.monotonic()
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--sweep"],
@@ -451,7 +458,11 @@ def test_sweep_survives_crash_and_wedge_with_tagged_cells(tmp_path):
 
     results = json.loads((tmp_path / "BENCH_SWEEP.json").read_text())
     cells = {k: v for k, v in results.items() if not k.startswith("_")}
-    assert set(cells) == {"centralized_n4_single", "cadmm_n4_single"}
+    assert set(cells) == {"centralized_n4_single", "cadmm_n4_single",
+                          "legacy_cell"}
+    assert cells.pop("legacy_cell") == {"mpc_steps_per_sec": 123.0}
+    assert results["_meta"]["carried_cells"] == ["legacy_cell"]
+    assert results["_meta"]["carried_from_head"] == "feedf00d"
     for key, value in cells.items():
         assert value.get("rung") == b.RUNG_CPU, (key, value)
         assert "error" not in value
@@ -462,7 +473,9 @@ def test_sweep_survives_crash_and_wedge_with_tagged_cells(tmp_path):
     be = [e for e in events if e["event"] == "backend_event"]
     assert sorted(e["kind"] for e in be) \
         == ["device_crash", "wedge_timeout"]
-    assert all(e["schema"] == 2 for e in be)
+    # Stamped at the writer's CURRENT schema (>= 2, the version that
+    # introduced backend_event; later additive bumps re-stamp).
+    assert all(e["schema"] == export_mod.SCHEMA_VERSION for e in be)
     # The resumable sweep journal (which carried the same backend_event
     # trail mid-run) is cleaned up on success — the metrics file is the
     # durable record.

@@ -307,3 +307,43 @@ def test_v1_files_remain_valid_but_not_for_backend_events(tmp_path):
         }) + "\n")
     errs = export_mod.validate_file(path)
     assert len(errs) == 1 and "requires schema >= 2" in errs[0]
+
+
+# ------------------- schema v3: aot_serve vocabulary -------------------
+
+def test_aot_serve_validates_at_schema_v3(tmp_path):
+    path = str(tmp_path / "aot.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("aot_serve", entry="control.cadmm:control", rung="bundle_exec",
+           label="coldstart_bundled", wall_s=1.5)
+    assert export_mod.validate_file(path) == []
+    ev = export_mod.read_events(path)[-1]
+    assert ev["schema"] == export_mod.SCHEMA_VERSION >= 3
+
+
+def test_aot_serve_requires_entry_and_rung(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("aot_serve", entry="control.cadmm:control")  # no rung.
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "missing fields ['rung']" in errs[0]
+
+
+def test_v2_files_remain_valid_but_not_for_aot_serve(tmp_path):
+    """Same additive contract as the v2 bump: a v2 file still validates;
+    an aot_serve event STAMPED v2 does not (the v2 reader contract never
+    defined it)."""
+    path = str(tmp_path / "old.metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "schema": 2, "event": "backend_event", "ts": 0.0,
+            "kind": "oom", "label": "x",
+        }) + "\n")
+    assert export_mod.validate_file(path) == []
+    with open(path, "a") as fh:
+        fh.write(json.dumps({
+            "schema": 2, "event": "aot_serve", "ts": 0.0,
+            "entry": "control.cadmm:control", "rung": "bundle_exec",
+        }) + "\n")
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "requires schema >= 3" in errs[0]

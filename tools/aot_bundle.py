@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""AOT bundle CLI: build / check / diff / serve over the entrypoint
+registry's compilation artifacts (``tpu_aerial_transport/aot/``).
+
+Usage::
+
+    python tools/aot_bundle.py build --out artifacts/aot/cpu \\
+        [--platform cpu|tpu] [--entry NAME ...] [--manifest-only] \\
+        [--no-exec] [--batch-buckets 8,64]
+    python tools/aot_bundle.py check BUNDLE_DIR      # CI drift gate
+    python tools/aot_bundle.py diff BUNDLE_DIR       # same, report-only
+    python tools/aot_bundle.py serve --entry NAME --mode bundled|cached|cold
+        [--bundle DIR] [--cache-dir D] [--expect-zero-compile]
+
+``check`` diffs the bundle's coverage (entry names + shape signatures)
+against the LIVE ``analysis.entrypoints`` registry and exits 1 on drift —
+a new/changed entrypoint cannot land without a bundle rebuild
+(``tools/ci_check.sh`` runs it against the in-tree CPU coverage manifest).
+Signatures come from ``make_args`` avals only, so the gate never lowers
+or compiles anything.
+
+``serve`` is the cold-start measurement/proof driver: a FRESH process
+executes one registered entrypoint end-to-end and reports
+time-to-first-step plus how many traces / MLIR lowerings / XLA backend
+compiles the process paid (counted via jax's monitoring events — the
+whole-process flavor of the TC101 cache-miss counting). ``--mode
+bundled`` with ``--expect-zero-compile`` exits 3 unless all three
+counters are zero; ``bench.py --sweep``'s ``coldstart_*`` A/B cells and
+tests/test_aot.py both drive it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _counters():
+    """Register jax monitoring listeners and return the live counter dict.
+    Must run before any compilation; jax import itself compiles nothing."""
+    from jax._src import monitoring
+
+    counts = {"traces": 0, "lowerings": 0, "backend_compiles": 0,
+              "cache_hits": 0}
+
+    def on_duration(event, duration, **kw):
+        del duration, kw
+        if event.endswith("jaxpr_trace_duration"):
+            counts["traces"] += 1
+        elif event.endswith("jaxpr_to_mlir_module_duration"):
+            counts["lowerings"] += 1
+        elif event.endswith("backend_compile_duration"):
+            counts["backend_compiles"] += 1
+        elif event.endswith("compile_time_saved_sec"):
+            counts["cache_hits"] += 1
+
+    monitoring.register_event_duration_secs_listener(on_duration)
+    return counts
+
+
+def cmd_build(args) -> int:
+    from tpu_aerial_transport.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    from tpu_aerial_transport.aot import bundle as bundle_mod
+
+    buckets = [int(b) for b in args.batch_buckets.split(",") if b]
+    t0 = time.perf_counter()
+    manifest = bundle_mod.build_bundle(
+        args.out,
+        platform=args.platform,
+        names=args.entry or None,
+        exec_artifacts=not args.no_exec,
+        manifest_only=args.manifest_only,
+        batch_buckets=buckets,
+        progress=lambda name: print(f"# building {name}", flush=True),
+    )
+    n_exec = sum(
+        1 for e in manifest["entries"].values()
+        for v in e["variants"] if "exec" in v.get("artifacts", {})
+    )
+    print(json.dumps({
+        "bundle": args.out,
+        "platform": manifest["platform"],
+        "entries": len(manifest["entries"]),
+        "skipped": len(manifest["skipped"]),
+        "exec_variants": n_exec,
+        "manifest_only": manifest["manifest_only"],
+        "build_s": round(time.perf_counter() - t0, 1),
+    }))
+    return 0
+
+
+def _diff(bundle_dir: str) -> dict:
+    from tpu_aerial_transport.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    from tpu_aerial_transport.aot import bundle as bundle_mod
+
+    manifest = bundle_mod.read_manifest(bundle_dir)
+    return bundle_mod.coverage_diff(manifest)
+
+
+def cmd_check(args) -> int:
+    diff = _diff(args.bundle)
+    if diff["ok"]:
+        print(f"aot_bundle check: OK ({args.bundle} covers the registry)")
+        return 0
+    for kind in ("missing", "stale", "changed", "uncovered_skips"):
+        for item in diff[kind]:
+            print(f"aot_bundle check [{kind}]: {item}")
+    print(
+        "aot_bundle check: DRIFT — the entrypoint registry and the bundle "
+        f"disagree; rebuild with: python tools/aot_bundle.py build --out "
+        f"{args.bundle}" + (" --manifest-only" if args.manifest_hint else "")
+    )
+    return 1
+
+
+def cmd_diff(args) -> int:
+    print(json.dumps(_diff(args.bundle), indent=1))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    counts = _counters()  # before anything can compile.
+
+    from tpu_aerial_transport.utils.platform import (
+        enable_persistent_cache,
+        honor_jax_platforms_env,
+    )
+
+    honor_jax_platforms_env()
+    if args.mode == "cached":
+        cache_dir = enable_persistent_cache(args.cache_dir or None)
+    else:
+        cache_dir = None  # bundled needs none; cold measures the pre-cache
+        # world even when TAT_XLA_CACHE_DIR is exported.
+
+    # Time-to-first-step clock starts HERE — AFTER the interpreter + jax
+    # import (a replica pays those once at deploy, before any request
+    # arrives, and _counters() must register against jax's monitoring
+    # before anything can compile), but before backend init, bundle load,
+    # input construction, and dispatch. A cold process's first step pays
+    # all of those (the registry's make_args alone runs hundreds of eager
+    # one-op compiles); the bundled path replaces every piece with
+    # deserialization. Timing only the final call would hide exactly the
+    # cost this subsystem removes; the bench cell's ``process_wall_s``
+    # records the whole-process wall time (import included) alongside.
+    t0 = time.perf_counter()
+
+    import jax
+
+    from tpu_aerial_transport.aot import loader as loader_mod
+
+    bundle = loader_mod.load_bundle(args.bundle) if args.bundle else None
+
+    # Inputs come from the manifest's recorded avals (host numpy, no
+    # compiles) so every mode sees identical data; without a bundle the
+    # registry's make_args builds them (jit modes only).
+    if bundle is not None:
+        call_args = bundle.probe_args(args.entry)
+    else:
+        from tpu_aerial_transport.analysis import contracts
+
+        _, make_args = contracts.REGISTRY[args.entry].build()
+        call_args = make_args()
+
+    out = {"entry": args.entry, "mode": args.mode,
+           "platform": jax.default_backend(),
+           **({"cache_dir": cache_dir} if cache_dir else {})}
+    t_serve = time.perf_counter()
+    if args.mode == "bundled":
+        result, rung = loader_mod.serve_entry(bundle, args.entry, call_args)
+    else:
+        from tpu_aerial_transport.analysis import contracts
+
+        fn, _ = contracts.REGISTRY[args.entry].build()
+        result, rung = loader_mod.serve_entry(
+            None, args.entry, call_args, jit_fallback=fn
+        )
+    jax.block_until_ready(result)
+    now = time.perf_counter()
+    out["ttfs_s"] = round(now - t0, 4)
+    out["serve_s"] = round(now - t_serve, 4)
+    out["rung"] = rung
+    out.update(counts)
+    print(json.dumps(out), flush=True)
+    if args.expect_zero_compile:
+        paid = {k: counts[k] for k in
+                ("traces", "lowerings", "backend_compiles") if counts[k]}
+        if paid:
+            print(f"aot_bundle serve: NOT zero-compile: {paid}",
+                  file=sys.stderr)
+            return 3
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    b = sub.add_parser("build", help="build a bundle from the registry")
+    b.add_argument("--out", required=True)
+    b.add_argument("--platform", default=None,
+                   help="target platform (default: this host's backend); "
+                        "a non-local platform builds export artifacts only")
+    b.add_argument("--entry", action="append", default=[],
+                   help="restrict to these registry entries (repeatable)")
+    b.add_argument("--manifest-only", action="store_true",
+                   help="record coverage (names + signatures) without "
+                        "lowering — the cheap in-tree CI artifact")
+    b.add_argument("--no-exec", action="store_true",
+                   help="skip the serialized-executable artifacts")
+    b.add_argument("--batch-buckets", default="",
+                   help="comma-separated scenario-batch bucket sizes for "
+                        "the batched entries (bucket_dim grid)")
+    b.set_defaults(fn=cmd_build)
+
+    c = sub.add_parser("check", help="fail on registry/bundle drift")
+    c.add_argument("bundle")
+    c.add_argument("--manifest-hint", action="store_true",
+                   help="phrase the rebuild hint for a manifest-only bundle")
+    c.set_defaults(fn=cmd_check)
+
+    d = sub.add_parser("diff", help="report registry/bundle drift as JSON")
+    d.add_argument("bundle")
+    d.set_defaults(fn=cmd_diff)
+
+    s = sub.add_parser("serve", help="cold-start measurement/proof driver")
+    s.add_argument("--entry", required=True)
+    s.add_argument("--mode", required=True,
+                   choices=["bundled", "cached", "cold"])
+    s.add_argument("--bundle", default="")
+    s.add_argument("--cache-dir", default="")
+    s.add_argument("--expect-zero-compile", action="store_true",
+                   help="exit 3 unless traces == lowerings == "
+                        "backend_compiles == 0")
+    s.set_defaults(fn=cmd_serve)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

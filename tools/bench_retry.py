@@ -56,6 +56,21 @@ def _utc() -> str:
 
 SWEEP_JOURNAL = "BENCH_SWEEP_JOURNAL.jsonl"
 
+# Persistent XLA compilation cache for the bench children — the same knob
+# the test conftest, bench.py, and the AOT serve driver share
+# (utils/platform.py; "" disables). Every retry attempt re-runs the SAME
+# programs: without a shared cache each attempt recompiled the full
+# matrix from scratch inside its own timeout. Set inline (not imported)
+# because this tool must not import the package — importing jax is the
+# hazard it exists to contain.
+XLA_CACHE_ENV = "TAT_XLA_CACHE_DIR"
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env.setdefault(XLA_CACHE_ENV, os.path.join(REPO, ".cache", "xla"))
+    return env
+
 
 def _journal_cells(cwd: str) -> int | None:
     """Completed-cell count from a crashed sweep's journal, ``None`` when
@@ -158,7 +173,8 @@ def run_with_retries(
                 # helpers holding the chip) must not survive as orphans
                 # wedging every later attempt (resilience.backend
                 # run_group).
-                proc = _backend.run_group(cmd_k, timeout_s, cwd=cwd)
+                proc = _backend.run_group(cmd_k, timeout_s, cwd=cwd,
+                                          env=_child_env())
                 att["duration_s"] = round(time.monotonic() - t0, 1)
                 att["rc"] = proc.returncode
                 if proc.returncode == 0:

@@ -36,6 +36,24 @@ python tools/jaxlint.py --contracts --target tpu \
     --only parallel.ring:consensus_exchange,parallel.ring:consensus_exchange_pallas,parallel.mesh:cadmm_control_sharded_ring \
     tpu_aerial_transport/parallel/ring.py || fail=1
 
+echo "== aot bundle coverage (tools/aot_bundle.py check) =="
+# Registry/bundle drift gate (PR 8): the in-tree manifest-only coverage
+# record must keep matching the live entrypoint registry — a new/changed
+# entrypoint cannot land without rebuilding it (python tools/aot_bundle.py
+# build --out artifacts/aot/coverage-cpu --manifest-only --platform cpu,
+# under the same forced 8-virtual-device CPU env used here: sharded
+# entries' arg shapes depend on the device count). Signatures come from
+# make_args avals only — the gate never lowers or compiles anything.
+if [ -f artifacts/aot/coverage-cpu/manifest.json ]; then
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python tools/aot_bundle.py check artifacts/aot/coverage-cpu \
+        --manifest-hint || fail=1
+else
+    echo "artifacts/aot/coverage-cpu/manifest.json MISSING (tracked file)"
+    fail=1
+fi
+
 echo "== metrics jsonl schema (obs.export) =="
 shopt -s nullglob
 metrics_files=(artifacts/*.metrics.jsonl)

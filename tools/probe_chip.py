@@ -41,22 +41,42 @@ def _backend_mod():
     return mod
 
 
-def probe(timeout_s: int = 60) -> tuple[bool, str]:
-    ok, detail = _backend_mod().probe_subprocess(timeout_s=timeout_s)
+def probe(timeout_s: int = 60, bundle_dir: str | None = None,
+          notes: list | None = None) -> tuple[bool, str]:
+    """``bundle_dir`` (or the ``TAT_AOT_BUNDLE_DIR`` env var) makes the
+    probed dispatch replay the AOT bundle's PRECOMPILED probe executable
+    instead of compiling one — a cold probe can no longer burn its
+    deadline inside XLA. A stale/corrupt bundle downgrades to the compile
+    probe and surfaces through ``notes`` (a ``bundle_stale`` rebuild hint,
+    never a chip indictment)."""
+    ok, detail = _backend_mod().probe_subprocess(
+        timeout_s=timeout_s, bundle_dir=bundle_dir, notes=notes
+    )
     if ok and detail == "cpu":
         return False, "silent CPU fallback (platform=cpu)"
     return ok, detail
 
 
-def main() -> int:
-    ok, detail = probe()
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=int, default=60)
+    ap.add_argument("--bundle-dir", default=None,
+                    help="AOT bundle whose precompiled probe executable "
+                         "the probe prefers (default: TAT_AOT_BUNDLE_DIR)")
+    args = ap.parse_args(argv)
+    notes: list = []
+    ok, detail = probe(timeout_s=args.timeout, bundle_dir=args.bundle_dir,
+                       notes=notes)
     stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M:%S UTC"
     )
+    note_s = ("  " + " ".join(notes)) if notes else ""
     os.makedirs(os.path.dirname(LOG), exist_ok=True)
     with open(LOG, "a") as fh:
-        fh.write(f"{stamp}  {'ALIVE' if ok else 'DOWN'}  {detail}\n")
-    print(f"{stamp}  {'ALIVE' if ok else 'DOWN'}  {detail}")
+        fh.write(f"{stamp}  {'ALIVE' if ok else 'DOWN'}  {detail}{note_s}\n")
+    print(f"{stamp}  {'ALIVE' if ok else 'DOWN'}  {detail}{note_s}")
     return 0 if ok else 1
 
 

@@ -107,6 +107,39 @@ def summarize(events: list[dict]) -> dict:
     cells = [e for e in events if e.get("event") == "bench_cell"]
     if cells:
         out["bench_cells"] = {e["cell"]: e["value"] for e in cells}
+        # Compile-cost column (plain v2 bench_cell fields, no schema
+        # bump): first-call wall time — compile + warmup — per cell.
+        compile_cost = {
+            e["cell"]: e["value"]["compile_wall_s"]
+            for e in cells
+            if isinstance(e.get("value"), dict)
+            and e["value"].get("compile_wall_s") is not None
+        }
+        if compile_cost:
+            out["compile_cost"] = compile_cost
+
+    # AOT serve ladder (schema v3): which rung every served entrypoint
+    # call landed on — bundle_exec/bundle_export are precompiled,
+    # jit_cached/jit_cold mean the process is still paying compiles.
+    aserves = [e for e in events if e.get("event") == "aot_serve"]
+    if aserves:
+        rungs_by_entry: dict[str, dict[str, int]] = {}
+        for e in aserves:
+            per = rungs_by_entry.setdefault(e.get("entry", "?"), {})
+            r = e.get("rung", "?")
+            per[r] = per.get(r, 0) + 1
+        out["aot"] = {
+            "serves": len(aserves),
+            "rungs_by_entry": rungs_by_entry,
+            "compiled_in_process": sum(
+                1 for e in aserves
+                if str(e.get("rung", "")).startswith("jit_")
+            ),
+            "wall_s_total": sum(
+                e.get("wall_s", 0.0) for e in aserves
+                if isinstance(e.get("wall_s"), (int, float))
+            ),
+        }
 
     # Backend guard (schema v2): error/circuit events from
     # resilience.backend.BackendGuard, plus the rung each cell/chunk
@@ -232,6 +265,27 @@ def render(summary: dict) -> None:
         print("|---|---|")
         for k, v in summary["bench_cells"].items():
             print(f"| {k} | {json.dumps(v)} |")
+
+    if summary.get("compile_cost"):
+        print("\n## compile cost (first-call wall time per cell)")
+        print("| cell | compile_wall_s |")
+        print("|---|---|")
+        for k, v in summary["compile_cost"].items():
+            print(f"| {k} | {v:.2f} |")
+        print(f"| **total** | "
+              f"{sum(summary['compile_cost'].values()):.2f} |")
+
+    ao = summary.get("aot")
+    if ao:
+        print("\n## AOT serve ladder (aot.loader)")
+        print(f"- serves: {ao['serves']} "
+              f"(in-process compiles: {ao['compiled_in_process']}, "
+              f"total wall {ao['wall_s_total']:.2f} s)")
+        print("| entry | rung | serves |")
+        print("|---|---|---|")
+        for entry, per in ao["rungs_by_entry"].items():
+            for rung, n in sorted(per.items()):
+                print(f"| {entry} | {rung} | {n} |")
 
     be = summary.get("backend")
     if be:

@@ -1,0 +1,23 @@
+"""AOT compilation-artifact bundles: compilation as a BUILD step.
+
+``bundle`` builds versioned, content-addressed executable bundles from the
+``analysis.entrypoints`` registry (one artifact set per entrypoint x shape
+signature); ``loader`` deserializes them and serves precompiled calls with
+a journaled fallback ladder (bundle-exec -> bundle-export -> persistent-
+cache jit -> cold jit). See README "AOT artifact bundles".
+"""
+
+from tpu_aerial_transport.aot.bundle import (  # noqa: F401
+    BundleError,
+    PROBE_ENTRY,
+    SCHEMA_VERSION,
+    abstract_signature,
+    build_bundle,
+    entry_specs,
+    runtime_fingerprint,
+)
+from tpu_aerial_transport.aot.loader import (  # noqa: F401
+    Bundle,
+    load_bundle,
+    serve_entry,
+)

@@ -1,0 +1,495 @@
+"""Build side of the AOT artifact bundles: turn the
+``analysis.entrypoints`` registry into versioned, content-addressed
+executable bundles so serving replicas, bench probes, and test drivers pay
+compilation at BUILD time, not at first dispatch.
+
+A bundle directory holds::
+
+    <bundle>/manifest.json        # schema version, runtime fingerprint,
+                                  # per-entry variants + artifact digests
+    <bundle>/objects/<sha>.bin    # content-addressed artifact payloads
+
+Per registered entrypoint x shape signature (a "variant"), two artifact
+flavors are built:
+
+- **export**: the ``jax.export`` StableHLO blob of the entry lowered for
+  the target platform. Portable across processes and jaxlib patch
+  versions; replaying it skips Python tracing entirely but still pays one
+  XLA backend compile at load (which the persistent compilation cache can
+  absorb). This is the only flavor buildable for a platform the build
+  host cannot execute (the TPU-target bundle built on a CPU box — the
+  same off-chip trick as the TC106 lowering gate).
+- **exec**: the serialized XLA executable itself
+  (``client.serialize_executable``) plus its ``CompileOptions`` proto and
+  the kept-argument index set. Loading it is a true **zero-compile** cold
+  start — no trace, no lowering, no backend compile — but it is only
+  valid for the exact jaxlib/XLA fingerprint and platform it was built
+  on, which is why the manifest pins :func:`runtime_fingerprint` and the
+  loader refuses a mismatch with a structured ``bundle_stale`` error
+  (a rebuild hint, never a chip indictment — see
+  ``resilience.backend.BREAKER_KINDS``).
+
+Entrypoints are serialized FLAT: the wrapper traced for export takes the
+flattened argument leaves and returns the flattened output leaves, and
+the bundle stores the pickled in/out treedefs next to the artifacts —
+``jax.export`` cannot serialize the package's custom pytree nodes
+(flax-struct states), and the manifest's treedef + per-leaf aval record
+is exactly the refusal surface the loader checks callers against (the
+same manifest discipline as ``harness/checkpoint.py``).
+
+Shape buckets: a variant's identity is :func:`abstract_signature` over
+the input avals + treedef. Batched entries can be built at several
+scenario-batch buckets (``harness.bucketing.bucket_dim`` rounds requested
+batch sizes onto the tile grid) so heterogeneous serving batches land on
+a precompiled variant — see :func:`bucketed_batch` and the loader's
+``variant_for_batch``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import warnings
+
+import numpy as np
+
+SCHEMA_VERSION = 1
+
+MANIFEST_NAME = "manifest.json"
+OBJECTS_DIR = "objects"
+
+# Synthetic probe entry built into every bundle: the exact device
+# computation ``resilience.backend.PROBE_CODE`` warms (matmul + an
+# explicit convert_element_type round-trip, the r02 failure class), as a
+# precompiled program — so a backend probe can validate first REAL
+# dispatch without burning its deadline on an XLA compile.
+PROBE_ENTRY = "aot:probe"
+
+# Scenario-batch tile for bucketed variants (bucket_dim grid). The f32
+# sublane tile; the lane axis comes from folding agents x scenarios.
+BATCH_BUCKET_TILE = 8
+
+# Entries with a leading Monte-Carlo scenario-batch axis that may be
+# built at several batch buckets (entry name -> batch axis).
+BUCKETED_ENTRIES: dict[str, int] = {
+    "parallel.mesh:scenario_rollout": 0,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class BundleError(Exception):
+    """Structured bundle failure (same shape as ``checkpoint.SnapshotError``).
+
+    kind: ``unreadable`` (missing/truncated manifest or object),
+    ``schema`` (newer bundle format), ``missing_entry`` (entry/variant not
+    in the bundle), ``signature_mismatch`` (caller avals differ from every
+    built variant), ``treedef_mismatch`` (caller pytree structure differs
+    from the recorded one), ``corrupt`` (object payload digest mismatch),
+    ``bundle_stale`` (exec artifact's jaxlib/XLA/platform fingerprint
+    differs from this process — rebuild the bundle), ``exec_unavailable``
+    (no exec artifact for this variant on this platform).
+    """
+
+    kind: str
+    path: str
+    detail: str = ""
+
+    def __str__(self) -> str:
+        msg = f"[{self.kind}] {self.path}: {self.detail}"
+        if self.kind == "bundle_stale":
+            msg += (" — rebuild hint: python tools/aot_bundle.py build "
+                    f"--out {os.path.dirname(self.path) or self.path}")
+        return msg
+
+
+# ----------------------------------------------------------------------
+# Fingerprints and signatures.
+# ----------------------------------------------------------------------
+
+def runtime_fingerprint(platform: str | None = None) -> dict:
+    """Identity of the compiling/serving runtime: jax + jaxlib versions,
+    target platform, and — when a live backend of that platform exists —
+    its ``platform_version`` (the XLA/runtime build). Exec artifacts are
+    valid only under an IDENTICAL fingerprint; export artifacts record it
+    for provenance but do not enforce it."""
+    import jax
+    import jaxlib
+
+    if platform is None:
+        platform = jax.default_backend()
+    version = None
+    try:
+        if platform == jax.default_backend():
+            version = jax.devices()[0].client.platform_version
+    except Exception:  # no live backend for the target: export-only build.
+        version = None
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": platform,
+        "platform_version": version,
+    }
+
+
+def abstract_signature(args) -> str:
+    """Shape signature of an argument pytree: treedef string + per-leaf
+    shape/dtype, hashed. Computed from concrete arrays or
+    ``ShapeDtypeStruct``s alike (no tracing) — the bundle keys variants on
+    it, the coverage gate diffs it, and the loader refuses callers whose
+    args hash differently."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(args)
+    spec = [str(treedef)] + [
+        f"{tuple(np.shape(l) if not hasattr(l, 'shape') else l.shape)}:"
+        f"{np.dtype(getattr(l, 'dtype', type(l))).str}"
+        for l in leaves
+    ]
+    return hashlib.sha256("\n".join(spec).encode()).hexdigest()[:16]
+
+
+def _avals_of(args) -> list[dict]:
+    import jax
+
+    return [
+        {"shape": list(l.shape), "dtype": np.dtype(l.dtype).str}
+        for l in jax.tree.leaves(args)
+    ]
+
+
+# ----------------------------------------------------------------------
+# Registry iteration (shared by build and the coverage gate).
+# ----------------------------------------------------------------------
+
+def _probe_build():
+    """The bundled probe program (see :data:`PROBE_ENTRY`)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fn(x):
+        y = lax.convert_element_type(x @ x, jnp.bfloat16)
+        return lax.convert_element_type(y, jnp.float32).sum()
+
+    def make_args():
+        return (jnp.ones((128, 128), jnp.float32),)
+
+    return fn, make_args
+
+
+def entry_specs(names=None) -> dict:
+    """``{name: spec}`` over the registry (+ :data:`PROBE_ENTRY`), where a
+    buildable spec is ``{"sig", "build"}`` and a skipped one is
+    ``{"skip": reason}``. Skips mirror the contract machinery: entries
+    needing more devices than the host has, ``lowering_only`` chip-only
+    programs, and ``entrypoints.LOWERING_WAIVERS`` rows (``jax.export``
+    cannot AOT-lower them off-chip by definition). Computing a signature
+    needs only ``make_args()`` — no tracing — so the tier-1 coverage gate
+    stays cheap."""
+    import jax
+
+    from tpu_aerial_transport.analysis import contracts
+    from tpu_aerial_transport.analysis import entrypoints as entry_data
+
+    out: dict = {}
+    selected = (sorted(contracts.REGISTRY) + [PROBE_ENTRY]
+                if names is None else list(names))
+    for name in selected:
+        if name == PROBE_ENTRY:
+            fn, make_args = _probe_build()
+            out[name] = {
+                "sig": abstract_signature(make_args()),
+                "build": (fn, make_args),
+            }
+            continue
+        contract = contracts.REGISTRY[name]
+        if jax.device_count() < contract.min_devices:
+            out[name] = {"skip": (
+                f"needs {contract.min_devices} devices, host has "
+                f"{jax.device_count()}"
+            )}
+            continue
+        if contract.lowering_only:
+            out[name] = {"skip": f"lowering_only: {contract.lowering_only}"}
+            continue
+        waiver = entry_data.LOWERING_WAIVERS.get(name)
+        if waiver is not None:
+            out[name] = {"skip": f"LOWERING_WAIVERS: {waiver[:120]}"}
+            continue
+        fn, make_args = contract.build()
+        args = make_args()
+        out[name] = {
+            "sig": abstract_signature(args),
+            "build": (fn, make_args),
+        }
+    return out
+
+
+def bucketed_batch(args, batch_axis: int, batch: int):
+    """Re-batch ``args`` along ``batch_axis`` to the bucket grid:
+    ``bucket_dim(batch, BATCH_BUCKET_TILE)`` lanes, tiled cyclically from
+    the originals (shape bucketing — the VALUES only seed compilation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_aerial_transport.harness.bucketing import bucket_dim
+
+    b = bucket_dim(batch, BATCH_BUCKET_TILE)
+
+    def retile(x):
+        cur = x.shape[batch_axis]
+        reps = [1] * x.ndim
+        reps[batch_axis] = -(-b // cur)
+        return jnp.moveaxis(
+            jnp.moveaxis(jnp.tile(x, reps), batch_axis, 0)[:b],
+            0, batch_axis,
+        )
+
+    return jax.tree.map(retile, args), b
+
+
+# ----------------------------------------------------------------------
+# Build.
+# ----------------------------------------------------------------------
+
+def _flat_fn(fn, in_treedef):
+    import jax
+
+    def flat(*leaves):
+        args = jax.tree.unflatten(in_treedef, list(leaves))
+        return tuple(jax.tree.leaves(fn(*args)))
+
+    return flat
+
+
+def _write_object(out_dir: str, payload: bytes) -> dict:
+    digest = hashlib.sha256(payload).hexdigest()
+    objdir = os.path.join(out_dir, OBJECTS_DIR)
+    os.makedirs(objdir, exist_ok=True)
+    path = os.path.join(objdir, digest[:32] + ".bin")
+    if not os.path.exists(path):
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    return {"object": os.path.basename(path), "sha256": digest}
+
+
+def _build_variant(name: str, fn, args, platform: str, out_dir: str,
+                   exec_artifacts: bool, meta: dict | None = None) -> dict:
+    """One entry x signature: export artifact always; exec artifact when
+    this host can compile for ``platform`` and the program is
+    single-device (the low-level replay path addresses one device; the
+    sharded tier serves through export + the serving-mesh jit)."""
+    import jax
+    from jax import export as jax_export
+
+    flat_args, in_treedef = jax.tree.flatten(args)
+    in_avals = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in flat_args]
+    out_treedef = jax.tree.structure(jax.eval_shape(fn, *args))
+    flat = _flat_fn(fn, in_treedef)
+    jitted = jax.jit(flat)
+    with warnings.catch_warnings():
+        # Entries that are already donation-clean jits (chunked_rollout)
+        # re-trace here inside a non-donating wrapper; the inner donation
+        # becoming unused is expected, not a bundle defect.
+        warnings.filterwarnings("ignore", message=".*donated.*")
+        exported = jax_export.export(jitted, platforms=[platform])(*in_avals)
+        variant: dict = {
+            "sig": abstract_signature(args),
+            "in_avals": _avals_of(args),
+            "out_avals": [
+                {"shape": list(a.shape), "dtype": np.dtype(a.dtype).str}
+                for a in exported.out_avals
+            ],
+            "nr_devices": int(exported.nr_devices),
+            "in_treedef": _write_object(out_dir, pickle.dumps(in_treedef)),
+            "out_treedef": _write_object(out_dir, pickle.dumps(out_treedef)),
+            "artifacts": {
+                "export": _write_object(out_dir, bytes(exported.serialize())),
+            },
+            **(meta or {}),
+        }
+        if (exec_artifacts and exported.nr_devices == 1
+                and platform == jax.default_backend()):
+            # Force a REAL backend compile: an executable the persistent
+            # compilation cache handed back re-serializes WITHOUT its
+            # compiled object code — the blob deserializes to "Symbols
+            # not found: [<fusion kernels>]" (measured on jaxlib 0.4.36,
+            # XLA:CPU). Builds on a warm cache (any test/bench host)
+            # would silently publish corrupt exec artifacts otherwise.
+            # Toggling the dir config alone is NOT enough:
+            # compilation_cache.is_cache_used() memoizes its verdict
+            # process-wide at first compile, so the toggle must be paired
+            # with reset_cache() on both edges.
+            from jax._src import compilation_cache as _cc
+
+            cache_dir = jax.config.jax_compilation_cache_dir
+            try:
+                if cache_dir:
+                    jax.config.update("jax_compilation_cache_dir", None)
+                    _cc.reset_cache()
+                compiled = jitted.lower(*in_avals).compile()
+            finally:
+                if cache_dir:
+                    jax.config.update("jax_compilation_cache_dir",
+                                      cache_dir)
+                    _cc.reset_cache()
+            exe = compiled._executable.xla_executable
+            kept = getattr(compiled._executable, "_kept_var_idx", None)
+            kept = sorted(kept) if kept is not None else list(
+                range(len(flat_args))
+            )
+            try:
+                exec_blob = exe.client.serialize_executable(exe)
+                opts_blob = exe.compile_options().SerializeAsString()
+                # Round-trip verification at BUILD time: a blob that
+                # cannot deserialize here would fail every replica at
+                # serve time instead.
+                from jax._src.lib import xla_client as _xc
+
+                exe.client.deserialize_executable(
+                    exec_blob,
+                    _xc.CompileOptions.ParseFromString(opts_blob),
+                )
+            except Exception as e:  # backend cannot serialize: export-only.
+                variant["exec_note"] = (
+                    f"exec artifact unavailable: {type(e).__name__}: {e}"
+                )[:200]
+            else:
+                variant["artifacts"]["exec"] = {
+                    **_write_object(out_dir, exec_blob),
+                    "options": _write_object(out_dir, opts_blob),
+                    "kept_var_idx": kept,
+                    "fingerprint": runtime_fingerprint(platform),
+                }
+    return variant
+
+
+def build_bundle(out_dir: str, *, platform: str | None = None,
+                 names=None, exec_artifacts: bool = True,
+                 manifest_only: bool = False,
+                 batch_buckets=(), progress=None) -> dict:
+    """Build (or re-build) a bundle for ``platform`` under ``out_dir`` and
+    return the manifest. ``manifest_only`` records coverage (names +
+    signatures + skip reasons) without lowering anything — the cheap
+    in-tree artifact the CI drift gate diffs against. ``batch_buckets``
+    adds bucketed scenario-batch variants for :data:`BUCKETED_ENTRIES`.
+    The manifest is published atomically (temp + ``os.replace``)."""
+    import jax
+
+    if platform is None:
+        platform = jax.default_backend()
+    if names is not None and PROBE_ENTRY not in names:
+        names = list(names) + [PROBE_ENTRY]  # every bundle carries the probe.
+    specs = entry_specs(names)
+    manifest: dict = {
+        "schema": SCHEMA_VERSION,
+        "platform": platform,
+        "fingerprint": runtime_fingerprint(platform),
+        "manifest_only": bool(manifest_only),
+        "entries": {},
+        "skipped": {},
+    }
+    for name, spec in specs.items():
+        if "skip" in spec:
+            manifest["skipped"][name] = spec["skip"]
+            continue
+        fn, make_args = spec["build"]
+        if manifest_only:
+            manifest["entries"][name] = {"variants": [{"sig": spec["sig"]}]}
+            continue
+        if progress:
+            progress(name)
+        args = make_args()
+        variants = [_build_variant(
+            name, fn, args, platform, out_dir, exec_artifacts
+        )]
+        axis = BUCKETED_ENTRIES.get(name)
+        if axis is not None:
+            for b in batch_buckets:
+                bargs, bb = bucketed_batch(args, axis, int(b))
+                variants.append(_build_variant(
+                    name, fn, bargs, platform, out_dir, exec_artifacts,
+                    meta={"batch": bb, "batch_axis": axis},
+                ))
+        manifest["entries"][name] = {"variants": variants}
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, MANIFEST_NAME)
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return manifest
+
+
+# ----------------------------------------------------------------------
+# Coverage diff (the CI drift gate's core).
+# ----------------------------------------------------------------------
+
+def coverage_diff(manifest: dict, names=None) -> dict:
+    """Diff a bundle manifest against the LIVE registry. Returns
+    ``{"missing", "stale", "changed", "uncovered_skips", "ok"}``:
+
+    - ``missing``: registry entries the bundle does not carry (a new
+      entrypoint landed without a bundle rebuild);
+    - ``stale``: bundle entries no longer in the registry;
+    - ``changed``: entries whose default-variant signature differs (arg
+      shapes/structure drifted since the bundle was built);
+    - ``uncovered_skips``: entries the bundle skipped that ARE buildable
+      on this host (the skip reason no longer holds).
+
+    Entries this host cannot build (device count) are not findings when
+    the bundle carries them — a bigger build host is allowed.
+    """
+    specs = entry_specs(names)
+    built = manifest.get("entries", {})
+    skipped = manifest.get("skipped", {})
+    diff = {"missing": [], "stale": [], "changed": [], "uncovered_skips": []}
+    for name, spec in specs.items():
+        if "skip" in spec:
+            continue  # host limitation or waiver; bundle may still carry it.
+        if name in built:
+            have = {v.get("sig") for v in built[name].get("variants", [])}
+            if spec["sig"] not in have:
+                diff["changed"].append(
+                    f"{name}: live sig {spec['sig']} not in built {sorted(have)}"
+                )
+        elif name in skipped:
+            diff["uncovered_skips"].append(
+                f"{name}: bundle skipped it ({skipped[name][:80]}) but it "
+                "builds on this host"
+            )
+        else:
+            diff["missing"].append(name)
+    live = set(specs)
+    for name in sorted(set(built) | set(skipped)):
+        if name not in live:
+            diff["stale"].append(name)
+    diff["ok"] = not any(diff[k] for k in
+                         ("missing", "stale", "changed", "uncovered_skips"))
+    return diff
+
+
+def read_manifest(bundle_dir: str) -> dict:
+    path = os.path.join(bundle_dir, MANIFEST_NAME)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+    except Exception as e:
+        raise BundleError(
+            "unreadable", path, f"{type(e).__name__}: {e}"
+        ) from e
+    if manifest.get("schema", -1) > SCHEMA_VERSION:
+        raise BundleError(
+            "schema", path,
+            f"written by schema {manifest.get('schema')} > supported "
+            f"{SCHEMA_VERSION}",
+        )
+    return manifest

@@ -1,0 +1,385 @@
+"""Serve side of the AOT artifact bundles: deserialize precompiled
+entrypoints and call them through a journaled fallback ladder.
+
+The ladder, per serve (:func:`serve_entry`)::
+
+    bundle_exec    zero-compile: the serialized XLA executable replays
+                   directly (no trace, no lowering, no backend compile).
+                   Refused with ``bundle_stale`` when the jaxlib/XLA/
+                   platform fingerprint differs from this process.
+    bundle_export  zero-lowering: the jax.export StableHLO blob replays
+                   (no Python retrace); pays ONE backend compile, which
+                   the persistent compilation cache can absorb.
+    jit_cached     ordinary jit of the caller-supplied fallback with the
+                   persistent XLA cache configured (trace + cache probe).
+    jit_cold       ordinary jit, no cache — the pre-bundle world.
+
+Every serve emits one ``aot_serve`` metrics event (schema v3,
+``obs.export``) carrying the rung it landed on and what the process paid,
+so ``tools/run_health.py`` shows exactly which replicas are still
+compiling.
+
+CPU custom-call note: XLA:CPU executables that call LAPACK kernels
+resolve them through handlers whose function pointers jax binds lazily
+inside the LOWERING rules — a zero-compile process never lowers, so the
+loader initializes the binding explicitly (:func:`_ensure_cpu_kernels`);
+without it a deserialized conic-solve executable segfaults at dispatch
+(measured on jaxlib 0.4.36).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import time
+
+import numpy as np
+
+from tpu_aerial_transport.aot.bundle import (
+    MANIFEST_NAME,
+    OBJECTS_DIR,
+    PROBE_ENTRY,
+    BundleError,
+    abstract_signature,
+    read_manifest,
+    runtime_fingerprint,
+)
+
+RUNG_EXEC = "bundle_exec"
+RUNG_EXPORT = "bundle_export"
+RUNG_JIT_CACHED = "jit_cached"
+RUNG_JIT_COLD = "jit_cold"
+
+# None until the first attempt; then "ok" or the sticky failure detail.
+_cpu_kernels_state: str | None = None
+
+
+def _ensure_cpu_kernels() -> str | None:
+    """Bind the CPU LAPACK custom-call kernels before replaying a
+    deserialized executable (see the module docstring). Returns ``None``
+    when bound, else the failure detail (sticky across calls): a jaxlib
+    that reshuffles the private module makes the exec rung REFUSE
+    (``exec_unavailable`` → the ladder serves the export rung) instead of
+    dispatching an executable whose LAPACK calls are unbound — that path
+    segfaults, it does not raise."""
+    global _cpu_kernels_state
+    if _cpu_kernels_state is None:
+        try:
+            from jaxlib.cpu import _lapack
+
+            _lapack.initialize()
+            _cpu_kernels_state = "ok"
+        except Exception as e:
+            _cpu_kernels_state = f"{type(e).__name__}: {e}"
+    return None if _cpu_kernels_state == "ok" else _cpu_kernels_state
+
+
+class Bundle:
+    """A loaded bundle directory. Objects are read lazily and verified
+    against their manifest sha256 when first read (``corrupt`` refusal);
+    deserialized artifacts — treedefs, the XLA executable, the jitted
+    export replay — are MEMOIZED per variant, so a serving replica pays
+    the read/verify/deserialize cost once per process, not per request
+    (the export rung's backend compile included: replays after the first
+    hit the jit cache)."""
+
+    def __init__(self, directory: str, manifest: dict):
+        self.directory = directory
+        self.manifest = manifest
+        self.platform = manifest.get("platform")
+        self._treedefs: dict = {}     # object name -> unpickled treedef
+        self._execs: dict = {}        # object name -> (executable, kept)
+        self._exports: dict = {}      # object name -> jitted replay fn
+
+    # -------------------------------------------------- object access --
+    def _read_object(self, ref: dict) -> bytes:
+        path = os.path.join(self.directory, OBJECTS_DIR, ref["object"])
+        try:
+            with open(path, "rb") as fh:
+                payload = fh.read()
+        except OSError as e:
+            raise BundleError(
+                "unreadable", path, f"{type(e).__name__}: {e}"
+            ) from e
+        digest = hashlib.sha256(payload).hexdigest()
+        if digest != ref["sha256"]:
+            raise BundleError(
+                "corrupt", path,
+                f"payload digest {digest[:12]} != manifest "
+                f"{ref['sha256'][:12]}",
+            )
+        return payload
+
+    def _treedef(self, ref: dict):
+        key = ref["object"]
+        if key not in self._treedefs:
+            self._treedefs[key] = pickle.loads(self._read_object(ref))
+        return self._treedefs[key]
+
+    # ------------------------------------------------ variant lookup ---
+    def entry_names(self) -> list[str]:
+        return sorted(self.manifest.get("entries", {}))
+
+    def variants(self, name: str) -> list[dict]:
+        entry = self.manifest.get("entries", {}).get(name)
+        if entry is None:
+            skipped = self.manifest.get("skipped", {}).get(name)
+            detail = (f"entry skipped at build time: {skipped}"
+                      if skipped else "entry not in bundle")
+            raise BundleError("missing_entry",
+                              os.path.join(self.directory, MANIFEST_NAME),
+                              f"{name}: {detail}")
+        variants = entry.get("variants", [])
+        if not variants or "artifacts" not in variants[0]:
+            raise BundleError(
+                "missing_entry",
+                os.path.join(self.directory, MANIFEST_NAME),
+                f"{name}: manifest-only bundle carries no artifacts "
+                "(coverage record; build without --manifest-only to serve)",
+            )
+        return variants
+
+    def variant_for(self, name: str, args) -> dict:
+        """The variant whose signature matches ``args`` exactly.
+        A structural mismatch refuses with ``treedef_mismatch``; a pure
+        shape/dtype mismatch with ``signature_mismatch``."""
+        import jax
+
+        sig = abstract_signature(args)
+        variants = self.variants(name)
+        for v in variants:
+            if v["sig"] == sig:
+                return v
+        v0 = variants[0]
+        in_treedef = self._treedef(v0["in_treedef"])
+        if jax.tree.structure(args) != in_treedef:
+            raise BundleError(
+                "treedef_mismatch", self.directory,
+                f"{name}: caller argument pytree structure differs from "
+                "the built one (controller/carry schema drifted since the "
+                "bundle was built)",
+            )
+        raise BundleError(
+            "signature_mismatch", self.directory,
+            f"{name}: caller avals hash {sig}, built "
+            f"{sorted(v['sig'] for v in variants)} — no precompiled "
+            "variant for this shape bucket",
+        )
+
+    def variant_for_batch(self, name: str, batch: int) -> dict:
+        """Smallest bucketed variant admitting ``batch`` lanes (callers
+        pad their batch up to the variant's ``batch``); falls back to the
+        largest when the request exceeds every bucket."""
+        vs = [v for v in self.variants(name) if "batch" in v]
+        if not vs:
+            raise BundleError(
+                "missing_entry", self.directory,
+                f"{name}: no bucketed variants (build with --batch-buckets)",
+            )
+        vs.sort(key=lambda v: v["batch"])
+        for v in vs:
+            if v["batch"] >= batch:
+                return v
+        return vs[-1]
+
+    # ------------------------------------------------------ calling ----
+    def _call_exec(self, name: str, variant: dict, flat_args):
+        import jax
+        from jax._src.lib import xla_client as xc
+
+        art = variant["artifacts"].get("exec")
+        if art is None:
+            raise BundleError(
+                "exec_unavailable", self.directory,
+                f"{name}: no exec artifact "
+                f"({variant.get('exec_note', 'built export-only')})",
+            )
+        fp = art["fingerprint"]
+        here = runtime_fingerprint(self.platform)
+        if fp != here:
+            drift = {k: (fp.get(k), here.get(k)) for k in set(fp) | set(here)
+                     if fp.get(k) != here.get(k)}
+            raise BundleError(
+                "bundle_stale", self.directory,
+                f"{name}: exec artifact fingerprint differs from this "
+                f"runtime: {drift}",
+            )
+        if self.platform == "cpu":
+            kerr = _ensure_cpu_kernels()
+            if kerr is not None:
+                raise BundleError(
+                    "exec_unavailable", self.directory,
+                    f"{name}: CPU LAPACK custom-call binding unavailable "
+                    f"({kerr}) — exec replay would dispatch unbound "
+                    "kernels (segfault, not an exception)",
+                )
+        if art["object"] not in self._execs:
+            backend = jax.devices(self.platform)[0].client
+            opts = xc.CompileOptions.ParseFromString(
+                self._read_object(art["options"])
+            )
+            self._execs[art["object"]] = backend.deserialize_executable(
+                self._read_object(art), opts
+            )
+        exe = self._execs[art["object"]]
+        kept = art["kept_var_idx"]
+
+        import jax.numpy as jnp
+
+        bufs = [jnp.asarray(flat_args[i]) for i in kept]
+        results = exe.execute_sharded(bufs)
+        return [o[0] for o in results.disassemble_into_single_device_arrays()]
+
+    def _call_export(self, name: str, variant: dict, flat_args):
+        import jax
+        from jax import export as jax_export
+
+        ref = variant["artifacts"]["export"]
+        if ref["object"] not in self._exports:
+            blob = self._read_object(ref)
+            exported = jax_export.deserialize(bytearray(blob))
+            # jit the replay so repeat serves hit the jit cache — a bare
+            # exported.call pays the backend compile on EVERY request.
+            self._exports[ref["object"]] = jax.jit(exported.call)
+        return list(self._exports[ref["object"]](*flat_args))
+
+    def call(self, name: str, args, *, rung: str | None = None):
+        """Execute ``name`` on ``args`` (the entry's ORIGINAL pytree
+        calling convention) from the bundle. Returns ``(out, rung)`` where
+        ``out`` is rebuilt with the recorded output treedef. ``rung``
+        pins a flavor (``bundle_exec``/``bundle_export``); default is
+        exec with a fall-through to export ONLY for ``exec_unavailable``/
+        ``bundle_stale`` (so a stale bundle still skips retracing)."""
+        import jax
+
+        variant = self.variant_for(name, args)
+        in_treedef = self._treedef(variant["in_treedef"])
+        if jax.tree.structure(args) != in_treedef:
+            raise BundleError(
+                "treedef_mismatch", self.directory,
+                f"{name}: caller argument pytree structure differs from "
+                "the built one",
+            )
+        flat_args = jax.tree.leaves(args)
+        out_treedef = self._treedef(variant["out_treedef"])
+        if rung == RUNG_EXPORT:
+            flat_out = self._call_export(name, variant, flat_args)
+            ran = RUNG_EXPORT
+        elif rung == RUNG_EXEC:
+            flat_out = self._call_exec(name, variant, flat_args)
+            ran = RUNG_EXEC
+        else:
+            try:
+                flat_out = self._call_exec(name, variant, flat_args)
+                ran = RUNG_EXEC
+            except BundleError as e:
+                if e.kind not in ("exec_unavailable", "bundle_stale"):
+                    raise
+                flat_out = self._call_export(name, variant, flat_args)
+                ran = RUNG_EXPORT
+        return jax.tree.unflatten(out_treedef, flat_out), ran
+
+    def probe_args(self, name: str = PROBE_ENTRY):
+        """Synthesize unit-valued arguments from a variant's recorded
+        avals (host numpy -> device_put; no compilation) — how the probe
+        and the zero-compile driver build inputs without the registry."""
+        import jax
+
+        variant = self.variants(name)[0]
+        in_treedef = self._treedef(variant["in_treedef"])
+        leaves = [
+            np.ones(tuple(a["shape"]), np.dtype(a["dtype"]))
+            for a in variant["in_avals"]
+        ]
+        return jax.tree.unflatten(in_treedef, leaves)
+
+
+def load_bundle(directory: str) -> Bundle:
+    """Open a bundle directory (manifest schema-checked; artifact objects
+    verified lazily per read)."""
+    return Bundle(directory, read_manifest(directory))
+
+
+def call_probe(bundle: Bundle, rung: str | None = RUNG_EXEC):
+    """Run the bundled probe program (matmul + convert_element_type round
+    trip) from its precompiled artifact; returns the scalar result. The
+    backend-probe integration point: first REAL dispatch validated with
+    zero in-process compiles — which is why the exec rung is PINNED by
+    default: letting the ladder absorb a stale/absent exec artifact would
+    silently pay the export rung's backend compile (the deadline-burning
+    cost the bundled probe exists to avoid) and hide the ``bundle_stale``
+    rebuild hint from the probe's notes."""
+    import jax
+
+    out, _ = bundle.call(PROBE_ENTRY, bundle.probe_args(), rung=rung)
+    jax.block_until_ready(out)
+    return out
+
+
+# ----------------------------------------------------------------------
+# The serve ladder.
+# ----------------------------------------------------------------------
+
+# BundleError kinds that mean the artifact store itself is damaged — a
+# bitrotted object, a truncated manifest, an unknown schema. These
+# re-raise from serve_entry even when a jit fallback is available:
+# coverage gaps degrade, integrity failures page an operator.
+INTEGRITY_KINDS = frozenset({"corrupt", "unreadable", "schema"})
+
+
+def serve_entry(bundle: Bundle | None, name: str, args, *,
+                jit_fallback=None, metrics=None, journal=None,
+                label: str | None = None):
+    """Serve one entrypoint call through the fallback ladder and journal
+    what this process paid. Returns ``(out, rung)``.
+
+    ``bundle`` None (or a bundle COVERAGE miss — ``missing_entry``,
+    ``signature_mismatch``, ``treedef_mismatch``, a stale/absent exec)
+    falls through to ``jit_fallback`` — an unjitted callable taking the
+    same args; its rung is ``jit_cached`` when a persistent compilation
+    cache is configured in this process, ``jit_cold`` otherwise. An
+    INTEGRITY failure (:data:`INTEGRITY_KINDS`: corrupt object,
+    unreadable/newer-schema manifest) re-raises after journaling even
+    when a fallback exists — a bitrotted artifact must not silently
+    become a cold compile on a serving replica's latency budget."""
+    import jax
+
+    label = label or name
+    t0 = time.perf_counter()
+    tried: list[str] = []
+
+    def emit(rung: str, error: str | None = None) -> None:
+        event = {
+            "entry": name, "rung": rung, "label": label,
+            "wall_s": time.perf_counter() - t0,
+            **({"tried": tried} if tried else {}),
+            **({"error": error} if error else {}),
+        }
+        if journal is not None:
+            journal.append({"event": "aot_serve", **event})
+        if metrics is not None:
+            metrics.emit("aot_serve", **event)
+
+    if bundle is not None:
+        try:
+            out, rung = bundle.call(name, args)
+            jax.block_until_ready(out)
+            emit(rung)
+            return out, rung
+        except BundleError as e:
+            tried.append(f"bundle[{e.kind}]")
+            if jit_fallback is None or e.kind in INTEGRITY_KINDS:
+                emit("error", error=str(e)[:300])
+                raise
+    if jit_fallback is None:
+        raise BundleError(
+            "missing_entry", getattr(bundle, "directory", "<no bundle>"),
+            f"{name}: no bundle artifact and no jit fallback",
+        )
+    rung = (RUNG_JIT_CACHED
+            if jax.config.jax_compilation_cache_dir else RUNG_JIT_COLD)
+    out = jax.jit(jit_fallback)(*args)
+    jax.block_until_ready(out)
+    emit(rung)
+    return out, rung

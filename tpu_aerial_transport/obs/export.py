@@ -27,10 +27,13 @@ import numpy as np
 from tpu_aerial_transport.obs import telemetry as telemetry_mod
 
 # v2: adds the ``backend_event`` type (backend-guard error/circuit/rung
-# records from ``resilience.backend.BackendGuard``). Files written at v1
-# remain valid (see :data:`SUPPORTED_SCHEMAS`) — v2 only ADDS vocabulary.
-SCHEMA_VERSION = 2
-SUPPORTED_SCHEMAS = frozenset({1, 2})
+# records from ``resilience.backend.BackendGuard``). v3: adds the
+# ``aot_serve`` type (fallback-ladder rung + wall time per served
+# entrypoint call, from ``aot.loader.serve_entry`` — which processes are
+# still paying compiles). Files written at older versions remain valid
+# (see :data:`SUPPORTED_SCHEMAS`) — each bump only ADDS vocabulary.
+SCHEMA_VERSION = 3
+SUPPORTED_SCHEMAS = frozenset({1, 2, 3})
 
 # Event vocabulary -> required fields (beyond schema/event/ts). The
 # validator rejects unknown event types and missing fields; extra fields
@@ -45,6 +48,7 @@ EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "bench_cell": ("cell", "value"),
     "rollout_summary": ("logs",),
     "backend_event": ("kind", "label"),
+    "aot_serve": ("entry", "rung"),
 }
 
 # Events that did not exist before a given schema version: an event of
@@ -52,6 +56,7 @@ EVENT_FIELDS: dict[str, tuple[str, ...]] = {
 # contract for that version never defined it).
 EVENT_MIN_SCHEMA: dict[str, int] = {
     "backend_event": 2,
+    "aot_serve": 3,
 }
 
 

@@ -69,14 +69,22 @@ ERROR_KINDS = (
     "dtype_lowering",     # f64/convert_element_type-class lowering bug
     "oom",                # device memory exhausted
     "device_crash",       # runtime died mid-execution
+    "bundle_stale",       # AOT bundle fingerprint mismatch: rebuild the
+                          # bundle (tools/aot_bundle.py build) — NOT a chip
+                          # problem, never trips the breaker
     "unknown",            # unclassified — treated as a CODE bug, not infra
 )
 
-# Ordered: first match wins. init_unavailable precedes dtype_lowering
-# deliberately — BENCH_r02's tail mentions convert_element_type only
-# because backend init surfaced lazily under the first dispatched op; the
-# root cause line is "Unable to initialize backend ... UNAVAILABLE".
+# Ordered: first match wins. bundle_stale leads — a stale-bundle refusal
+# names its artifact/fingerprint drift and must not be misread as an
+# infra failure by the looser patterns below. init_unavailable precedes
+# dtype_lowering deliberately — BENCH_r02's tail mentions
+# convert_element_type only because backend init surfaced lazily under
+# the first dispatched op; the root cause line is "Unable to initialize
+# backend ... UNAVAILABLE".
 _CLASSIFIERS: tuple[tuple[str, re.Pattern], ...] = (
+    ("bundle_stale", re.compile(
+        r"(?i)bundle[_ ]stale|stale bundle|bundle.*fingerprint")),
     ("init_unavailable", re.compile(
         r"(?i)unable to initialize backend|backend setup|"
         r"failed to connect|\bUNAVAILABLE\b|no accelerator|"
@@ -318,6 +326,43 @@ PROBE_CODE = (
 
 FAULTS_ENV = "TAT_BACKEND_FAULTS"
 DEADLINE_ENV = "TAT_BACKEND_DEADLINE_S"
+# AOT bundle the probe prefers: the probe computation loads from the
+# bundle's precompiled artifact instead of compiling, so a cold-init
+# probe cannot burn its deadline in XLA (tpu_aerial_transport/aot/).
+BUNDLE_ENV = "TAT_AOT_BUNDLE_DIR"
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+# Bundle-warmed probe: same contract as PROBE_CODE, but the device
+# computation replays the bundle's precompiled probe entry. A bundle
+# failure (stale fingerprint, missing dir, corrupt object) falls back to
+# the compile probe IN the subprocess — the chip still gets validated and
+# the BACKEND_OK line's trailing note carries the classified bundle
+# problem (a rebuild hint, never a probe failure: see BREAKER_KINDS).
+def _bundle_probe_code(bundle_dir: str) -> str:
+    return (
+        "import os, sys, jax\n"
+        "envp = os.environ.get('JAX_PLATFORMS')\n"
+        "if envp: jax.config.update('jax_platforms', envp)\n"
+        f"sys.path.insert(0, {_REPO_DIR!r})\n"
+        "d = jax.devices()\n"
+        "note = 'bundle'\n"
+        "try:\n"
+        "    from tpu_aerial_transport.aot import loader as _aot\n"
+        f"    b = _aot.load_bundle({bundle_dir!r})\n"
+        "    s = float(_aot.call_probe(b))\n"
+        "except Exception as e:\n"
+        "    note = ('bundle_fallback:' + type(e).__name__ + ':'\n"
+        "            + str(e)[:160].replace(' ', '_'))\n"
+        "    import jax.numpy as jnp\n"
+        "    from jax import lax\n"
+        "    x = jnp.ones((128, 128), jnp.float32)\n"
+        "    y = lax.convert_element_type(x @ x, jnp.bfloat16)\n"
+        "    s = float(lax.convert_element_type(y, jnp.float32).sum())\n"
+        "print('BACKEND_OK', d[0].platform, len(d), s, note)\n"
+    )
 
 
 def run_group(cmd: list[str], timeout_s: float,
@@ -352,12 +397,22 @@ def run_group(cmd: list[str], timeout_s: float,
 
 
 def probe_subprocess(timeout_s: float = 60.0,
-                     env: dict | None = None) -> tuple[bool, str]:
+                     env: dict | None = None,
+                     bundle_dir: str | None = None,
+                     notes: list | None = None) -> tuple[bool, str]:
     """Watchdogged subprocess probe of cold backend init + first dispatch:
     ``(True, platform)`` when the computation ran, ``(False, detail)``
     otherwise. Subprocess isolation because a wedged BACKEND INIT cannot
     be interrupted in-process (the thread watchdog can only abandon it —
     fine for dispatch, fatal before any backend exists).
+
+    ``bundle_dir`` (default: the :data:`BUNDLE_ENV` env var) makes the
+    probe prefer the AOT bundle's PRECOMPILED probe executable, so the
+    probed dispatch cannot spend the deadline inside an XLA compile; a
+    bundle problem (``bundle_stale`` fingerprint drift, missing/corrupt
+    artifact) downgrades to the compile probe inside the subprocess and
+    is reported through ``notes`` (appended strings) — a rebuild hint,
+    never a failed probe and never a circuit-breaker strike.
 
     Honors the :class:`FaultInjector` env hook: an ``init_unavailable``
     directive fails the probe in-process (fast), so end-to-end tests can
@@ -370,9 +425,12 @@ def probe_subprocess(timeout_s: float = 60.0,
             "fault-injected: Unable to initialize backend "
             "(TAT_BACKEND_FAULTS=init_unavailable)"
         )
+    if bundle_dir is None:
+        bundle_dir = (env or os.environ).get(BUNDLE_ENV, "")
+    code = _bundle_probe_code(bundle_dir) if bundle_dir else PROBE_CODE
     try:
         proc = run_group(
-            [sys.executable, "-c", PROBE_CODE], timeout_s, env=env,
+            [sys.executable, "-c", code], timeout_s, env=env,
         )
     except subprocess.TimeoutExpired:
         # Structured prefix contract: tools/bench_retry.py classifies a
@@ -383,7 +441,10 @@ def probe_subprocess(timeout_s: float = 60.0,
     token = [ln for ln in proc.stdout.splitlines()
              if ln.startswith("BACKEND_OK")]
     if proc.returncode == 0 and token:
-        return True, token[0].split()[1]
+        parts = token[0].split()
+        if notes is not None and len(parts) > 4:
+            notes.extend(parts[4:])
+        return True, parts[1]
     tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
     return False, f"probe rc={proc.returncode}: " + " | ".join(tail)
 
@@ -488,9 +549,11 @@ RUNG_ONCHIP_UNPADDED = "on-chip-unpadded"
 RUNG_CPU = "cpu-tagged"
 
 # Error kinds that indict the BACKEND (and therefore count toward opening
-# the circuit). compile_error / dtype_lowering are PROGRAM bugs: the
+# the circuit). compile_error / dtype_lowering are PROGRAM bugs and
+# bundle_stale is a BUILD-ARTIFACT bug (rebuild the AOT bundle): the
 # failing cell still degrades to the CPU rung, but three Pallas compile
-# failures on a healthy chip must not route the rest of the sweep to CPU.
+# failures — or a fleet serving from a bundle built under last week's
+# jaxlib — on a healthy chip must not route the rest of the work to CPU.
 BREAKER_KINDS = frozenset(
     {"init_unavailable", "wedge_timeout", "device_crash", "oom"}
 )

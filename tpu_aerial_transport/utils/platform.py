@@ -22,3 +22,42 @@ def honor_jax_platforms_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", envp)
+
+
+# The persistent-cache knob shared by the test conftest, bench.py, the
+# bench_retry child processes, and the AOT serve driver: override the
+# location with TAT_XLA_CACHE_DIR, disable with TAT_XLA_CACHE_DIR="".
+XLA_CACHE_ENV = "TAT_XLA_CACHE_DIR"
+
+
+def default_cache_dir() -> str:
+    """Repo-local default (gitignored): ``<repo>/.cache/xla``."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    return os.path.join(repo, ".cache", "xla")
+
+
+def enable_persistent_cache(cache_dir: str | None = None,
+                            min_compile_secs: float = 1.0) -> str | None:
+    """Point jax's persistent XLA compilation cache at ``cache_dir``
+    (default: :data:`XLA_CACHE_ENV`, falling back to
+    :func:`default_cache_dir`). The suite and the bench are COMPILE-bound
+    and programs are identical run-to-run, so warm processes skip the XLA
+    backend compile (keyed by program HLO + compile options + jax/XLA
+    version — config changes miss cleanly). Returns the directory in use,
+    or None when disabled (``TAT_XLA_CACHE_DIR=""``). Must run before the
+    first compilation to cover it."""
+    if cache_dir is None:
+        cache_dir = os.environ.get(XLA_CACHE_ENV, default_cache_dir())
+    if not cache_dir:
+        return None
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Only persist programs worth the disk round-trip.
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+    )
+    return cache_dir
