@@ -1010,6 +1010,77 @@ def _coldstart_cell(mode: str, platform: str) -> dict:
     return row
 
 
+def _serving_cell(families=("cadmm4",), n_requests: int = 64,
+                  buckets=(8, 16), seed: int = 0,
+                  rate_scale: float = 2.0) -> dict:
+    """Continuous-batching serving-tier cell (tpu_aerial_transport/
+    serving/): a Poisson request stream through the ScenarioServer on the
+    jit rung, reporting completed scenario-MPC-steps/s and mean batch
+    occupancy. The Poisson rate is calibrated from a warmup chunk so the
+    arrival load saturates the largest bucket (``rate_scale`` × one
+    bucket of arrivals per chunk wall) on any host — the acceptance bar
+    is mean occupancy >= 0.75 on the CPU tier. Compilation of every
+    (family, bucket) program happens in the warmup, OUTSIDE the timed
+    window, and is reported as compile_wall_s like every other cell."""
+    from tpu_aerial_transport.serving import batcher, server as server_mod
+    from tpu_aerial_transport.serving.queue import ScenarioRequest
+
+    fams = [batcher.make_family(f) for f in families]
+    buckets = tuple(sorted(buckets))
+
+    # Warm every (family, bucket) compiled program; time the warmup as
+    # the cell's compile cost and one warm chunk for rate calibration.
+    t0 = time.perf_counter()
+    for fam in fams:
+        for b in buckets:
+            carry = jax.tree.map(
+                lambda x: np.stack([np.asarray(x)] * b),
+                fam.template_carry_host(),
+            )
+            jax.block_until_ready(fam.batched_jit(carry, np.int32(0)))
+    compile_wall_s = time.perf_counter() - t0
+    fam0 = fams[0]
+    carry = jax.tree.map(
+        lambda x: np.stack([np.asarray(x)] * buckets[-1]),
+        fam0.template_carry_host(),
+    )
+    t0 = time.perf_counter()
+    jax.block_until_ready(fam0.batched_jit(carry, np.int32(0)))
+    chunk_wall_s = max(time.perf_counter() - t0, 1e-4)
+    rate_hz = rate_scale * buckets[-1] * len(fams) / chunk_wall_s
+
+    srv = server_mod.ScenarioServer(
+        families=fams, buckets=buckets, capacity=4 * n_requests,
+    )
+    rng = np.random.default_rng(seed)
+    stream = []
+    for _ in range(n_requests):
+        fam = fams[int(rng.integers(len(fams)))]
+        stream.append(ScenarioRequest(
+            family=fam.name,
+            horizon=int(rng.integers(1, 4)) * fam.chunk_len,
+            x0=tuple(float(v) for v in rng.normal(0, 1.0, 3)),
+        ))
+    t0 = time.perf_counter()
+    next_due = t0
+    while stream or srv.has_work():
+        now = time.perf_counter()
+        while stream and now >= next_due:
+            srv.submit(stream.pop(0))
+            next_due += rng.exponential(1.0 / rate_hz)
+        srv.pump()
+    wall_s = time.perf_counter() - t0
+    stats = srv.stats()
+    return {
+        "scenario_mpc_steps_per_sec": stats["scenario_steps"] / wall_s,
+        "mean_occupancy": stats["mean_occupancy"],
+        "completed": stats.get("completed", 0),
+        "requests": stats["requests"],
+        "poisson_rate_hz": round(rate_hz, 1),
+        "compile_wall_s": compile_wall_s,
+    }
+
+
 SWEEP_PARTIAL_PATH = "BENCH_SWEEP_PARTIAL.json"
 SWEEP_JOURNAL_PATH = "BENCH_SWEEP_JOURNAL.jsonl"
 SWEEP_METRICS_PATH = "artifacts/bench_sweep.metrics.jsonl"
@@ -1314,6 +1385,25 @@ def sweep(resume: bool = False, platform: str | None = None):
             "cold_compiles": have["cold"]["backend_compiles"],
         })
 
+    # Scenario-serving tier cells (tpu_aerial_transport/serving/): the
+    # continuous-batching throughput + soak workload the ROADMAP's
+    # serving item names — guard-wrapped like every cell, meaningful on
+    # any backend (the rung is recorded; CPU is the acceptance tier for
+    # mean occupancy >= 0.75 under the Poisson load).
+    for key, skw in (
+        ("serving_throughput_cadmm4",
+         dict(families=("cadmm4",), n_requests=64)),
+        ("serving_soak_mixed",
+         dict(families=("cadmm4", "centralized4"), n_requests=128)),
+    ):
+        if not want(key) or (key in results
+                             and "error" not in results[key]):
+            continue
+        try:
+            record(key, guarded_cell(key, _serving_cell, **skw))
+        except Exception as e:
+            record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+
     # The round-5 A/B cells run right after the ring/donate decision
     # cells above: if the tunnel dies mid-sweep, the checkpoint must
     # already hold the cells that decide default flips
@@ -1473,7 +1563,7 @@ def sweep(resume: bool = False, platform: str | None = None):
     for key in [k for k in results
                 if "batch" in k or "swarm" in k or "fused" in k
                 or "innertol" in k or "sharded" in k or "donate" in k
-                or "coldstart" in k]:
+                or "coldstart" in k or "serving" in k]:
         r = results[key]
         if "error" in r:
             print(f"| {key} | ERROR: {r['error']} | — | — |")
@@ -1486,6 +1576,14 @@ def sweep(resume: bool = False, platform: str | None = None):
         if "bundled_vs_cold_ttfs" in r:  # derived cold-start ratio.
             print(f"| {key} | bundled {r['bundled_vs_cold_ttfs']:.1f}x "
                   f"faster than cold to first step | — | — |")
+            continue
+        if "mean_occupancy" in r:  # serving-tier cell (serving/).
+            occ = r["mean_occupancy"]
+            print(f"| {key} | {r['scenario_mpc_steps_per_sec']:.1f} "
+                  f"scenario-steps/s [occupancy "
+                  f"{occ if occ is None else round(occ, 3)}, "
+                  f"{r['completed']}/{r['requests']} completed, "
+                  f"rung={r.get('rung', '?')}] | — | — |")
             continue
         if "donated_ms_per_step" in r:  # the donated-resume A/B cell.
             print(f"| {key} | donated {r['donated_ms_per_step']:.2f} ms vs "

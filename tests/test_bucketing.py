@@ -52,6 +52,60 @@ def test_bucketed_equals_vmapped():
     )
 
 
+def test_pick_bucket_exact_fit():
+    """An exactly-fitting bucket is chosen (no padding to the next one)."""
+    assert bucketing.pick_bucket(8, (8, 16, 32)) == 8
+    assert bucketing.pick_bucket(16, (8, 16, 32)) == 16
+
+
+def test_pick_bucket_smallest_admitting():
+    assert bucketing.pick_bucket(5, (8, 16, 32)) == 8
+    assert bucketing.pick_bucket(9, (32, 16, 8)) == 16  # order-free.
+    assert bucketing.pick_bucket(0, (8, 16)) == 8
+
+
+def test_pick_bucket_no_admitting_bucket():
+    """A size above every bucket returns None — admission control
+    rejects, the AOT loader falls back to its largest variant."""
+    assert bucketing.pick_bucket(33, (8, 16, 32)) is None
+
+
+def test_pick_bucket_tie_on_padded_size():
+    """Duplicate bucket values (two variants padding to the same size)
+    resolve to that value deterministically."""
+    assert bucketing.pick_bucket(7, (8, 8, 16)) == 8
+
+
+def test_pick_bucket_invalid_args():
+    import pytest
+
+    with pytest.raises(ValueError):
+        bucketing.pick_bucket(-1, (8,))
+    with pytest.raises(ValueError):
+        bucketing.pick_bucket(4, ())
+
+
+def test_loader_variant_for_batch_uses_pick_bucket():
+    """The AOT loader's smallest-admitting-bucket selection is the shared
+    rule (regression for the PR-8 private copy)."""
+    from tpu_aerial_transport.aot import bundle as bundle_mod
+    from tpu_aerial_transport.aot import loader as loader_mod
+
+    manifest = {
+        "schema": bundle_mod.SCHEMA_VERSION, "platform": "cpu",
+        "skipped": {},
+        "entries": {"e": {"variants": [
+            {"sig": "a", "artifacts": {}, "batch": 32},
+            {"sig": "b", "artifacts": {}, "batch": 8},
+            {"sig": "c", "artifacts": {}, "batch": 16},
+        ]}},
+    }
+    b = loader_mod.Bundle("/nonexistent", manifest)
+    assert b.variant_for_batch("e", 8)["batch"] == 8    # exact fit.
+    assert b.variant_for_batch("e", 9)["batch"] == 16   # smallest admitting.
+    assert b.variant_for_batch("e", 99)["batch"] == 32  # largest fallback.
+
+
 def test_metric_counts_nearby_trees():
     forest = forest_mod.make_forest(seed=0)
     metric = bucketing.env_congestion_metric(forest, vision_radius=8.0)

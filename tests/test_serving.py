@@ -1,0 +1,563 @@
+"""Scenario-serving tier (tpu_aerial_transport/serving/): admission
+control rejects with structured reasons (never an exception in the
+server loop), SLO accounting classifies deadline misses, continuous
+batching is composition-independent (a request's result is bitwise
+identical whether it runs alone, in a busy mixed batch, or joins late at
+a chunk boundary), preemption + resume reproduces the uninterrupted
+stream bit-exactly, and the bundled path serves with zero in-process
+compiles (slow e2e — the whole-process counter proof of
+tests/test_aot.py at serving scale)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.obs import export as export_mod
+from tpu_aerial_transport.serving import batcher, queue as queue_mod
+from tpu_aerial_transport.serving import server as server_mod
+from tpu_aerial_transport.serving.queue import ScenarioRequest
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeInterrupt:
+    triggered = None
+
+
+@pytest.fixture(scope="session")
+def cadmm_family():
+    """ONE family instance per session so its batched chunk compiles once
+    across every jit-path test."""
+    return batcher.make_family("cadmm4")
+
+
+def _mk_server(fam, tmp_path=None, **kw):
+    kw.setdefault("families", [fam])
+    kw.setdefault("buckets", (4, 8))
+    if tmp_path is not None:
+        kw.setdefault("metrics", str(tmp_path / "serving.metrics.jsonl"))
+    return server_mod.ScenarioServer(**kw)
+
+
+def _drain(srv):
+    while srv.pump():
+        pass
+
+
+def _req(i, horizon=4, family="cadmm4", **kw):
+    return ScenarioRequest(family=family, horizon=horizon,
+                           x0=(0.3 * i, 0.1, 1.0),
+                           request_id=f"t{i:03d}", **kw)
+
+
+# ----------------------------------------------------------------------
+# Admission control (no device work — queue only).
+# ----------------------------------------------------------------------
+
+def _stub_queue(tmp_path, capacity=2):
+    path = str(tmp_path / "adm.metrics.jsonl")
+    metrics = export_mod.MetricsWriter(path)
+    q = queue_mod.AdmissionQueue(
+        lambda fam: 2 if fam == "known" else None,
+        capacity=capacity,
+        emit=lambda **kw: metrics.emit("serving_event", **kw),
+    )
+    return q, path
+
+
+def test_admission_rejections_structured(tmp_path):
+    """Every rejection path resolves the ticket with a structured reason
+    and a schema-valid serving_event — no exception escapes."""
+    q, path = _stub_queue(tmp_path, capacity=2)
+
+    t = q.submit(ScenarioRequest(family="nope", horizon=4))
+    assert (t.status, t.reason) == (
+        queue_mod.REJECTED, queue_mod.REASON_NO_COVERAGE)
+    t = q.submit(ScenarioRequest(family="known", horizon=3))
+    assert (t.status, t.reason) == (
+        queue_mod.REJECTED, queue_mod.REASON_BAD_HORIZON)
+    t = q.submit(ScenarioRequest(family="known", horizon=4,
+                                 deadline_s=-1.0))
+    assert (t.status, t.reason) == (
+        queue_mod.REJECTED, queue_mod.REASON_DEADLINE_SPENT)
+    assert q.submit(ScenarioRequest(family="known", horizon=4)).status \
+        == queue_mod.PENDING
+    assert q.submit(ScenarioRequest(family="known", horizon=4)).status \
+        == queue_mod.PENDING
+    t = q.submit(ScenarioRequest(family="known", horizon=4))
+    assert (t.status, t.reason) == (
+        queue_mod.REJECTED, queue_mod.REASON_QUEUE_FULL)
+
+    assert export_mod.validate_file(path) == []
+    events = export_mod.read_events(path)
+    rejected = [e for e in events if e.get("kind") == "rejected"]
+    assert sorted(e["reason"] for e in rejected) == sorted([
+        queue_mod.REASON_NO_COVERAGE, queue_mod.REASON_BAD_HORIZON,
+        queue_mod.REASON_DEADLINE_SPENT, queue_mod.REASON_QUEUE_FULL,
+    ])
+
+
+def test_deadline_expires_in_queue(tmp_path):
+    """A queued request whose deadline passes before admission resolves
+    deadline_missed, classified in_queue."""
+    clock = [0.0]
+    path = str(tmp_path / "dl.metrics.jsonl")
+    metrics = export_mod.MetricsWriter(path)
+    q = queue_mod.AdmissionQueue(
+        lambda fam: 2, capacity=8, clock=lambda: clock[0],
+        emit=lambda **kw: metrics.emit("serving_event", **kw),
+    )
+    t = q.submit(ScenarioRequest(family="f", horizon=4, deadline_s=5.0))
+    assert t.status == queue_mod.PENDING
+    clock[0] = 4.0
+    assert q.expire_deadlines() == []
+    clock[0] = 6.0
+    missed = q.expire_deadlines()
+    assert missed == [t]
+    assert t.status == queue_mod.DEADLINE_MISSED
+    assert t.slo.missed == queue_mod.MISSED_IN_QUEUE
+    assert q.depth() == 0
+    assert export_mod.validate_file(path) == []
+
+
+def test_server_submit_never_raises(cadmm_family, tmp_path):
+    """Rejections through the full server (unknown family / bad horizon)
+    come back as resolved tickets, not exceptions."""
+    srv = _mk_server(cadmm_family, tmp_path)
+    bad = srv.submit(ScenarioRequest(family="martian", horizon=4))
+    assert (bad.status, bad.reason) == (
+        queue_mod.REJECTED, queue_mod.REASON_NO_COVERAGE)
+    odd = srv.submit(ScenarioRequest(family="cadmm4", horizon=3))
+    assert (odd.status, odd.reason) == (
+        queue_mod.REJECTED, queue_mod.REASON_BAD_HORIZON)
+    assert not srv.has_work()
+
+
+# ----------------------------------------------------------------------
+# Continuous batching (device work — shared compiled family).
+# ----------------------------------------------------------------------
+
+def test_composition_independent_results_and_late_join(
+        cadmm_family, tmp_path):
+    """THE serving-tier correctness claim: a request's result does not
+    depend on its batch composition. The same request served (a) alone
+    (filler-padded small bucket), (b) in a busy batch, and (c) as a LATE
+    arrival joining a running batch at a chunk boundary produces bitwise
+    identical final states."""
+    probe = ScenarioRequest(family="cadmm4", horizon=4, x0=(1.2, -0.4, 0.8),
+                            request_id="probe_a")
+
+    srv_alone = _mk_server(cadmm_family)
+    t_alone = srv_alone.submit(probe)
+    _drain(srv_alone)
+    assert t_alone.status == queue_mod.COMPLETED
+
+    srv_busy = _mk_server(
+        cadmm_family, tmp_path,
+        metrics=str(tmp_path / "busy.metrics.jsonl"),
+    )
+    tickets = [srv_busy.submit(_req(i, horizon=(4 if i % 2 else 8)))
+               for i in range(6)]
+    t_busy = srv_busy.submit(ScenarioRequest(
+        family="cadmm4", horizon=4, x0=(1.2, -0.4, 0.8),
+        request_id="probe_b",
+    ))
+    srv_busy.pump()  # chunk 1 in flight batch.
+    late = srv_busy.submit(ScenarioRequest(
+        family="cadmm4", horizon=4, x0=(1.2, -0.4, 0.8),
+        request_id="probe_late",
+    ))
+    launched_batch = t_busy.batch_id
+    _drain(srv_busy)
+
+    for t in tickets + [t_busy, late]:
+        assert t.status == queue_mod.COMPLETED, t
+    # The late arrival JOINED the running batch at a boundary — same
+    # batch, admitted after the first chunk launched.
+    assert late.batch_id == launched_batch
+    assert late.slo.t_admit > t_busy.slo.t_launch
+
+    leaves_a = jax.tree.leaves(t_alone.result)
+    for other in (t_busy, late):
+        leaves_o = jax.tree.leaves(other.result)
+        assert len(leaves_a) == len(leaves_o)
+        for x, y in zip(leaves_a, leaves_o):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    assert export_mod.validate_file(
+        str(tmp_path / "busy.metrics.jsonl")) == []
+    stats = srv_busy.stats()
+    assert stats["completed"] == 8
+    assert stats["mean_occupancy"] is not None
+    assert stats["scenario_steps"] == sum(
+        t.request.horizon for t in tickets + [t_busy, late]
+    )
+
+
+def test_deadline_missed_in_flight(cadmm_family, tmp_path):
+    """A request admitted in time but finishing after its deadline
+    resolves deadline_missed classified in_flight (result attached — it
+    finished, just late)."""
+    now = [0.0]
+    srv = _mk_server(cadmm_family, tmp_path, clock=lambda: now[0])
+    t = srv.submit(ScenarioRequest(family="cadmm4", horizon=4,
+                                   deadline_s=5.0))
+    now[0] = 1.0
+    srv.pump()  # admitted + chunk 1 of 2 — still inside the deadline.
+    assert t.status == queue_mod.PENDING
+    now[0] = 10.0  # deadline passes while the request is IN FLIGHT.
+    _drain(srv)
+    assert t.status == queue_mod.DEADLINE_MISSED
+    assert t.slo.missed == queue_mod.MISSED_IN_FLIGHT
+    assert t.result is not None
+    events = export_mod.read_events(
+        str(tmp_path / "serving.metrics.jsonl"))
+    miss = [e for e in events if e.get("kind") == "deadline_missed"]
+    assert len(miss) == 1 and miss[0]["missed"] == queue_mod.MISSED_IN_FLIGHT
+
+
+def test_preempt_resume_bit_identity(cadmm_family, tmp_path):
+    """SIGTERM semantics in-process: preemption stops at the chunk
+    boundary, the journal + snapshots restore the remainder, and the
+    merged results are bitwise identical to an uninterrupted run."""
+    def stream():
+        return [_req(i, horizon=6) for i in range(6)]
+
+    ref_srv = _mk_server(cadmm_family)
+    ref_tickets = [ref_srv.submit(r) for r in stream()]
+    _drain(ref_srv)
+    ref = {t.request.request_id: t.result for t in ref_tickets}
+    assert all(t.status == queue_mod.COMPLETED for t in ref_tickets)
+
+    run_dir = str(tmp_path / "run")
+    fi = FakeInterrupt()
+    srv1 = _mk_server(cadmm_family, run_dir=run_dir, interrupt=fi)
+    t1 = [srv1.submit(r) for r in stream()]
+    srv1.pump()
+    fi.triggered = "SIGTERM"
+    assert srv1.pump() is False
+    assert srv1.preempted
+    done1 = {t.request.request_id: t.result for t in t1
+             if t.status == queue_mod.COMPLETED}
+
+    srv2 = server_mod.ScenarioServer.resume(
+        run_dir, families=[cadmm_family], buckets=(4, 8))
+    _drain(srv2)
+    done2 = {rid: t.result for rid, t in srv2.tickets.items()
+             if t.status == queue_mod.COMPLETED}
+
+    merged = {**done1, **done2}
+    assert set(merged) == set(ref)
+    for rid in ref:
+        for x, y in zip(jax.tree.leaves(ref[rid]),
+                        jax.tree.leaves(merged[rid])):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_resume_snapshot_corruption_falls_back_to_replay(
+        cadmm_family, tmp_path):
+    """A bitrotted boundary snapshot must not kill resume: the affected
+    requests replay from their specs (bit-identical by determinism)."""
+    run_dir = str(tmp_path / "run")
+    fi = FakeInterrupt()
+    srv1 = _mk_server(cadmm_family, run_dir=run_dir, interrupt=fi)
+    t1 = [srv1.submit(_req(i, horizon=6)) for i in range(3)]
+    srv1.pump()
+    fi.triggered = "SIGTERM"
+    srv1.pump()
+    del t1
+    for name in os.listdir(run_dir):
+        if name.endswith(".ckpt"):
+            path = os.path.join(run_dir, name)
+            with open(path, "r+b") as fh:
+                first = fh.read(1)
+                fh.seek(0)
+                fh.write(bytes([first[0] ^ 0xFF]))
+    srv2 = server_mod.ScenarioServer.resume(
+        run_dir, families=[cadmm_family], buckets=(4, 8))
+    _drain(srv2)
+    assert len(srv2.tickets) == 3
+    assert all(t.status == queue_mod.COMPLETED
+               for t in srv2.tickets.values())
+
+
+def test_batch_id_reservation_monotonic():
+    """resume() reserves journaled batch ids so post-resume launches
+    cannot collide snapshot prefixes/journal identities; the allocator
+    never moves backward (in-process resumes must not reuse ids
+    either)."""
+    a = batcher._alloc_batch_id()
+    batcher.reserve_batch_ids(a + 10)
+    assert batcher._alloc_batch_id() == a + 10
+    batcher.reserve_batch_ids(0)  # never backward.
+    assert batcher._alloc_batch_id() == a + 11
+
+
+# ----------------------------------------------------------------------
+# The serve ladder integration.
+# ----------------------------------------------------------------------
+
+def test_serve_entry_prejitted_fallback_no_retrace(cadmm_family):
+    """serve_entry with a PRE-JITTED fallback reuses its jit cache across
+    serves — a serving replica must not retrace per request (the PR-8
+    serve_entry wrapped plain callables in a fresh jax.jit per call)."""
+    from tpu_aerial_transport.aot import loader as loader_mod
+
+    fam = cadmm_family
+    jitted = fam.batched_jit
+
+    def args():
+        carry = jax.tree.map(
+            lambda x: np.stack([np.asarray(x)] * 4),
+            fam.template_carry_host(),
+        )
+        return (carry, np.int32(0))
+
+    loader_mod.serve_entry(None, "warm", args(), jit_fallback=jitted)
+    before = jitted._cache_size()
+    for _ in range(3):
+        _, rung = loader_mod.serve_entry(
+            None, "again", args(), jit_fallback=jitted)
+    assert jitted._cache_size() == before
+    assert rung in (loader_mod.RUNG_JIT_CACHED, loader_mod.RUNG_JIT_COLD)
+
+
+@pytest.fixture(scope="session")
+def serving_bundle_dir(tmp_path_factory):
+    """A real CPU bundle of the canonical cadmm serving chunk (default
+    bucket only — the slow e2e builds the multi-bucket one)."""
+    from tpu_aerial_transport.aot import bundle as bundle_mod
+
+    out = str(tmp_path_factory.mktemp("serving_aot") / "cpu")
+    bundle_mod.build_bundle(
+        out, platform="cpu", names=["serving.batcher:serving_chunk"],
+    )
+    return out
+
+
+def test_bundle_sample_template_matches_family(
+        serving_bundle_dir, cadmm_family):
+    """DRIFT GUARD for the zero-compile path: the template carry a
+    bundled server reconstructs from args_sample must be bitwise the
+    jnp-built family template — otherwise bundled and jit replicas would
+    serve different trajectories for the same request."""
+    from tpu_aerial_transport.aot import loader as loader_mod
+
+    b = loader_mod.load_bundle(serving_bundle_dir)
+    sample = b.sample_args("serving.batcher:serving_chunk")
+    lane0 = jax.tree.map(lambda x: np.asarray(x)[0], sample[0])
+    built = cadmm_family.template_carry_host()
+    la, lb = jax.tree.leaves(lane0), jax.tree.leaves(built)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.asarray(x).dtype == np.asarray(y).dtype
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_bundled_server_exec_rung_parity(
+        serving_bundle_dir, cadmm_family, tmp_path):
+    """A require_bundle server serves the whole stream on the exec rung
+    with results bitwise equal to the jit-path server."""
+    reqs = [_req(i, horizon=4) for i in range(3)]
+
+    srv_jit = _mk_server(cadmm_family)
+    jit_tix = [srv_jit.submit(r) for r in reqs]
+    _drain(srv_jit)
+
+    metrics = str(tmp_path / "bundled.metrics.jsonl")
+    srv_b = server_mod.ScenarioServer(
+        families=["cadmm4"], bundle=serving_bundle_dir,
+        require_bundle=True, metrics=metrics,
+    )
+    # Coverage comes from the bundle: the default variant's bucket.
+    b_tix = [srv_b.submit(ScenarioRequest(
+        family="cadmm4", horizon=r.horizon, x0=r.x0,
+        request_id=r.request_id + "_b")) for r in reqs]
+    _drain(srv_b)
+
+    events = export_mod.read_events(metrics)
+    serves = [e for e in events if e.get("event") == "aot_serve"]
+    assert serves and all(e["rung"] == "bundle_exec" for e in serves)
+    for tj, tb in zip(jit_tix, b_tix):
+        assert tj.status == tb.status == queue_mod.COMPLETED
+        for x, y in zip(jax.tree.leaves(tj.result),
+                        jax.tree.leaves(tb.result)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_bundle_batch_buckets_listing(serving_bundle_dir):
+    from tpu_aerial_transport.aot import loader as loader_mod
+
+    b = loader_mod.load_bundle(serving_bundle_dir)
+    assert b.batch_buckets("serving.batcher:serving_chunk") == [
+        batcher.DEFAULT_BUCKETS[0]
+    ]
+
+
+def test_require_bundle_rejects_uncovered_family(serving_bundle_dir):
+    """Strict bundled admission: a family the bundle does not cover
+    rejects with no_bucket_coverage instead of silently compiling."""
+    srv = server_mod.ScenarioServer(
+        families=["cadmm4", "centralized4"], bundle=serving_bundle_dir,
+        require_bundle=True,
+    )
+    t = srv.submit(ScenarioRequest(family="centralized4", horizon=4))
+    assert (t.status, t.reason) == (
+        queue_mod.REJECTED, queue_mod.REASON_NO_COVERAGE)
+
+
+# ----------------------------------------------------------------------
+# Schema + run_health.
+# ----------------------------------------------------------------------
+
+def test_serving_event_schema_v4(tmp_path):
+    path = str(tmp_path / "v4.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    ev = w.emit("serving_event", kind="completed", request_id="r0",
+                slo={"latency_s": 0.5})
+    assert ev["schema"] == export_mod.SCHEMA_VERSION >= 4
+    assert export_mod.validate_file(path) == []
+    # Stamped v3 it is invalid: the v3 reader contract never defined it.
+    export_mod.jsonl_append(path, {
+        "schema": 3, "event": "serving_event", "ts": 0.0, "kind": "x",
+    })
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "requires schema >= 4" in errs[0]
+    # Missing the kind field is invalid.
+    export_mod.jsonl_append(path, {
+        "schema": 4, "event": "serving_event", "ts": 0.0,
+    })
+    assert any("missing fields" in e for e in export_mod.validate_file(path))
+
+
+def test_run_health_serving_section(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import run_health
+
+    path = str(tmp_path / "rh.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("serving_event", kind="batch_launch", batch_id=0,
+           family="cadmm4", bucket=8, lanes=5)
+    for i in range(4):
+        w.emit("serving_event", kind="completed", request_id=f"r{i}",
+               slo={"latency_s": 0.1 * (i + 1),
+                    "admit_to_complete_s": 0.05 * (i + 1)})
+    w.emit("serving_event", kind="rejected", request_id="r9",
+           reason=queue_mod.REASON_QUEUE_FULL)
+    w.emit("serving_event", kind="deadline_missed", request_id="r8",
+           missed=queue_mod.MISSED_IN_QUEUE)
+    w.emit("serving_event", kind="batch_boundary", batch_id=0,
+           family="cadmm4", chunk=1, occupancy=0.75, rung="bundle_exec")
+    w.emit("serving_event", kind="batch_boundary", batch_id=0,
+           family="cadmm4", chunk=2, occupancy=0.25, rung="bundle_exec")
+
+    s = run_health.summarize(export_mod.read_events(path))
+    sv = s["serving"]
+    assert sv["completed"] == 4
+    assert sv["rejections"] == {queue_mod.REASON_QUEUE_FULL: 1}
+    assert sv["deadline_misses"] == {queue_mod.MISSED_IN_QUEUE: 1}
+    assert sv["mean_occupancy"] == pytest.approx(0.5)
+    assert sv["latency_s"]["p50"] == pytest.approx(0.3)  # nearest-rank.
+    assert sv["batches"][0]["bucket"] == 8
+    assert sv["batches"][0]["rungs"] == {"bundle_exec": 2}
+
+
+# ----------------------------------------------------------------------
+# The acceptance e2e (slow): zero-compile mixed-shape soak + SIGTERM.
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="session")
+def serving_soak_bundle(tmp_path_factory):
+    """Multi-bucket bundle for both canonical families (the slow soak's
+    zero-compile admission surface)."""
+    from tpu_aerial_transport.aot import bundle as bundle_mod
+
+    out = str(tmp_path_factory.mktemp("serving_soak") / "cpu")
+    bundle_mod.build_bundle(
+        out, platform="cpu",
+        names=["serving.batcher:serving_chunk",
+               "serving.batcher:serving_chunk_centralized"],
+        batch_buckets=(16, 32),
+    )
+    return out
+
+
+def _serve_cli(bundle, extra, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TAT_XLA_CACHE_DIR="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "serve_scenarios.py"),
+         "--requests", "96", "--waves-spec", "64,24,8",
+         "--bundle", bundle, "--require-bundle", *extra],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO,
+    )
+    return proc
+
+
+@pytest.mark.slow
+def test_zero_compile_mixed_stream_soak(serving_soak_bundle, tmp_path):
+    """ACCEPTANCE: a fresh process serves >= 64 requests over >= 3 shape
+    buckets with late arrivals joining at chunk boundaries and 0 traces /
+    0 lowerings / 0 backend compiles, every request resolving with a
+    schema-v4 serving_event trail."""
+    metrics = str(tmp_path / "soak.metrics.jsonl")
+    proc = _serve_cli(serving_soak_bundle,
+                      ["--expect-zero-compile", "--metrics", metrics])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert (row["traces"], row["lowerings"], row["backend_compiles"]) \
+        == (0, 0, 0)
+    assert row["requests"] >= 64 and row["completed"] == row["requests"]
+
+    assert export_mod.validate_file(metrics) == []
+    events = export_mod.read_events(metrics)
+    launches = [e for e in events if e.get("kind") == "batch_launch"]
+    assert len({e["bucket"] for e in launches}) >= 3
+    lanes_at_launch = sum(e["lanes"] for e in launches)
+    admits = sum(1 for e in events if e.get("kind") == "admitted")
+    assert admits - lanes_at_launch >= 1  # late joins at boundaries.
+    serves = [e for e in events if e.get("event") == "aot_serve"]
+    assert serves and all(e["rung"] == "bundle_exec" for e in serves)
+
+
+@pytest.mark.slow
+def test_sigterm_resume_bit_identity_subprocess(
+        serving_soak_bundle, tmp_path):
+    """ACCEPTANCE: SIGTERM mid-stream completes at the chunk boundary;
+    a --resume invocation finishes the remainder; merged per-request
+    digests equal the uninterrupted run's."""
+    ref = str(tmp_path / "ref.json")
+    proc = _serve_cli(serving_soak_bundle, ["--results", ref])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    run_dir = str(tmp_path / "rundir")
+    r1 = str(tmp_path / "r1.json")
+    proc = _serve_cli(serving_soak_bundle, [
+        "--run-dir", run_dir, "--sigterm-after", "2", "--results", r1])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert json.loads(proc.stdout.strip().splitlines()[-1])["preempted"]
+
+    r2 = str(tmp_path / "r2.json")
+    proc = _serve_cli(serving_soak_bundle, [
+        "--run-dir", run_dir, "--resume", "--results", r2])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    with open(ref) as fh:
+        want = {k: v["digest"] for k, v in json.load(fh).items()
+                if "digest" in v}
+    got = {}
+    for p in (r1, r2):
+        with open(p) as fh:
+            for k, v in json.load(fh).items():
+                if "digest" in v:
+                    got[k] = v["digest"]
+    assert set(got) == set(want)
+    assert all(got[k] == want[k] for k in want)

@@ -141,6 +141,58 @@ def summarize(events: list[dict]) -> dict:
             ),
         }
 
+    # Serving tier (schema v4): request/batch lifecycle from serving/.
+    sevents = [e for e in events if e.get("event") == "serving_event"]
+    if sevents:
+        kinds: dict[str, int] = {}
+        for e in sevents:
+            k = e.get("kind", "?")
+            kinds[k] = kinds.get(k, 0) + 1
+        completed = [e for e in sevents if e.get("kind") == "completed"]
+        lat = [e["slo"]["latency_s"] for e in completed
+               if isinstance(e.get("slo"), dict)
+               and "latency_s" in e["slo"]]
+        a2c = [e["slo"]["admit_to_complete_s"] for e in completed
+               if isinstance(e.get("slo"), dict)
+               and "admit_to_complete_s" in e["slo"]]
+        rejections: dict[str, int] = {}
+        for e in sevents:
+            if e.get("kind") == "rejected":
+                r = e.get("reason", "?")
+                rejections[r] = rejections.get(r, 0) + 1
+        misses: dict[str, int] = {}
+        for e in sevents:
+            if e.get("kind") == "deadline_missed":
+                m = e.get("missed", "?")
+                misses[m] = misses.get(m, 0) + 1
+        bounds = [e for e in sevents if e.get("kind") == "batch_boundary"]
+        occ = [e["occupancy"] for e in bounds
+               if isinstance(e.get("occupancy"), (int, float))]
+        batches: dict = {}
+        for e in sevents:
+            if e.get("kind") == "batch_launch":
+                batches[e.get("batch_id")] = {
+                    "family": e.get("family"), "bucket": e.get("bucket"),
+                    "rungs": {},
+                }
+        for e in bounds:
+            b = batches.setdefault(
+                e.get("batch_id"), {"family": e.get("family"),
+                                    "bucket": None, "rungs": {}},
+            )
+            r = e.get("rung", "?")
+            b["rungs"][r] = b["rungs"].get(r, 0) + 1
+        out["serving"] = {
+            "kinds": kinds,
+            "completed": len(completed),
+            "rejections": rejections,
+            "deadline_misses": misses,
+            "latency_s": _latency_stats(lat),
+            "admit_to_complete_s": _latency_stats(a2c),
+            "mean_occupancy": (sum(occ) / len(occ)) if occ else None,
+            "batches": batches,
+        }
+
     # Backend guard (schema v2): error/circuit events from
     # resilience.backend.BackendGuard, plus the rung each cell/chunk
     # ACTUALLY ran at (bench cells carry it in their value dict, chunk
@@ -287,6 +339,43 @@ def render(summary: dict) -> None:
             for rung, n in sorted(per.items()):
                 print(f"| {entry} | {rung} | {n} |")
 
+    sv = summary.get("serving")
+    if sv:
+        print("\n## serving SLO (serving/ tier)")
+        print("events: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(sv["kinds"].items())
+        ))
+        for label, key in (("submit→complete", "latency_s"),
+                           ("admit→complete", "admit_to_complete_s")):
+            st = sv.get(key)
+            if st:
+                print(f"- {label} latency: p50 {_fmt(st['p50'])} s, "
+                      f"p90 {_fmt(st['p90'])} s, p99 {_fmt(st['p99'])} s "
+                      f"(mean {_fmt(st['mean'])}, n={st['count']})")
+        if sv["rejections"]:
+            print("- rejections: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(sv["rejections"].items())
+            ))
+        if sv["deadline_misses"]:
+            print("- deadline misses: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(
+                    sv["deadline_misses"].items())
+            ))
+        if sv["mean_occupancy"] is not None:
+            print(f"- mean batch occupancy: "
+                  f"{sv['mean_occupancy']:.3f}")
+        if sv["batches"]:
+            print("\n| batch | family | bucket | rungs |")
+            print("|---|---|---|---|")
+            for bid, b in sorted(sv["batches"].items(),
+                                 key=lambda kv: str(kv[0])):
+                rungs = ", ".join(
+                    f"{r}×{n}" for r, n in sorted(b["rungs"].items())
+                ) or "—"
+                print(f"| {bid} | {b['family']} | "
+                      f"{b['bucket'] if b['bucket'] is not None else '—'} "
+                      f"| {rungs} |")
+
     be = summary.get("backend")
     if be:
         print("\n## backend health (resilience.backend guard)")
@@ -310,6 +399,19 @@ def render(summary: dict) -> None:
             print("|---|---|---|")
             for unit, impl, rung in be["rungs"]:
                 print(f"| {unit} | {impl or '—'} | {rung} |")
+
+
+def _latency_stats(xs: list[float]) -> dict | None:
+    if not xs:
+        return None
+    return {
+        "count": len(xs),
+        "mean": sum(xs) / len(xs),
+        "p50": _percentile(xs, 0.5),
+        "p90": _percentile(xs, 0.9),
+        "p99": _percentile(xs, 0.99),
+        "max": max(xs),
+    }
 
 
 def _fmt(v) -> str:

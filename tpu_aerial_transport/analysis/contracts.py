@@ -707,6 +707,45 @@ def _build_mesh_scenarios():
     return fn, make_args
 
 
+def _serving_chunk_build(canonical: str):
+    """Shared builder for the serving-tier batched chunk entries: the
+    canonical family's vmapped chunk at the smallest shape bucket
+    (serving/batcher.py — the SAME factory the server and the AOT bundle
+    use, so bundle signatures match served batches by construction).
+    make_args cycles chunk offsets like the chunked_rollout contract: all
+    boundaries of a serving batch must hit ONE compiled program."""
+    import itertools
+
+    import numpy as np
+
+    from tpu_aerial_transport.harness import rollout as h_rollout
+    from tpu_aerial_transport.serving import batcher
+
+    fam = batcher.make_family(canonical)
+    bucket = batcher.DEFAULT_BUCKETS[0]
+    chunk_idx = itertools.count()
+
+    def make_args():
+        c = next(chunk_idx) % 4
+        carry = jax.tree.map(
+            lambda x: np.stack([np.array(x, copy=True)] * bucket),
+            fam.template_carry_host(),
+        )
+        return (carry, h_rollout.chunk_index_offset(c, fam.chunk_len))
+
+    return fam.batched_fn, make_args
+
+
+@_register("serving.batcher:serving_chunk")
+def _build_serving_chunk():
+    return _serving_chunk_build("cadmm4")
+
+
+@_register("serving.batcher:serving_chunk_centralized")
+def _build_serving_chunk_centralized():
+    return _serving_chunk_build("centralized4")
+
+
 # ----------------------------------------------------------------------
 # Checks.
 # ----------------------------------------------------------------------

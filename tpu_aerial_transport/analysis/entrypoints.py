@@ -127,6 +127,13 @@ CONTRACT_ENTRYPOINTS: dict[str, str] = {
         "chip-only — see LOWERING_WAIVERS)",
     "parallel.mesh:scenario_rollout":
         "scenario-sharded Monte-Carlo batch rollout",
+    "serving.batcher:serving_chunk":
+        "continuous-batching serving chunk (canonical cadmm family): the "
+        "PR-4 chunked rollout vmapped over a bucketed lane axis — the "
+        "serving tier's compiled/bundled admission surface",
+    "serving.batcher:serving_chunk_centralized":
+        "serving chunk for the canonical centralized family (the mixed-"
+        "stream twin of serving_chunk)",
 }
 
 # Public functions containing lax.scan / lax.while_loop / lax.fori_loop
@@ -185,6 +192,9 @@ TILE_WAIVERS: dict[str, str] = {
     "parallel.mesh:scenario_rollout":
         "scenario axis is data-parallel over the centralized-controller "
         "rollout; per-lane ops are 3-vectors",
+    "serving.batcher:serving_chunk_centralized":
+        "lanes are data-parallel over the centralized controller (waived "
+        "above); the cadmm serving_chunk twin runs padded and is enforced",
 }
 
 # TC106 lowering waivers: entrypoint name -> reason the off-chip

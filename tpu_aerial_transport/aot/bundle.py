@@ -73,9 +73,13 @@ PROBE_ENTRY = "aot:probe"
 BATCH_BUCKET_TILE = 8
 
 # Entries with a leading Monte-Carlo scenario-batch axis that may be
-# built at several batch buckets (entry name -> batch axis).
+# built at several batch buckets (entry name -> batch axis). The serving
+# chunk entries are the continuous-batching tier's admission surface: the
+# server's shape buckets are exactly the variants built here.
 BUCKETED_ENTRIES: dict[str, int] = {
     "parallel.mesh:scenario_rollout": 0,
+    "serving.batcher:serving_chunk": 0,
+    "serving.batcher:serving_chunk_centralized": 0,
 }
 
 
@@ -239,6 +243,8 @@ def bucketed_batch(args, batch_axis: int, batch: int):
     b = bucket_dim(batch, BATCH_BUCKET_TILE)
 
     def retile(x):
+        if x.ndim <= batch_axis:  # scalar args (the chunk step offset)
+            return x              # carry no batch axis to retile.
         cur = x.shape[batch_axis]
         reps = [1] * x.ndim
         reps[batch_axis] = -(-b // cur)
@@ -309,6 +315,14 @@ def _build_variant(name: str, fn, args, platform: str, out_dir: str,
             "nr_devices": int(exported.nr_devices),
             "in_treedef": _write_object(out_dir, pickle.dumps(in_treedef)),
             "out_treedef": _write_object(out_dir, pickle.dumps(out_treedef)),
+            # The build-time argument VALUES (host numpy): a zero-compile
+            # serving replica loads these as its template carry instead of
+            # running the eager jnp state factories (each of which pays a
+            # backend compile) — see loader.Bundle.sample_args.
+            "args_sample": _write_object(
+                out_dir,
+                pickle.dumps([np.asarray(l) for l in flat_args]),
+            ),
             "artifacts": {
                 "export": _write_object(out_dir, bytes(exported.serialize())),
             },

@@ -170,7 +170,11 @@ class Bundle:
     def variant_for_batch(self, name: str, batch: int) -> dict:
         """Smallest bucketed variant admitting ``batch`` lanes (callers
         pad their batch up to the variant's ``batch``); falls back to the
-        largest when the request exceeds every bucket."""
+        largest when the request exceeds every bucket. Bucket selection is
+        ``harness.bucketing.pick_bucket`` — the ONE smallest-admitting-
+        bucket rule this loader and the serving batcher share."""
+        from tpu_aerial_transport.harness.bucketing import pick_bucket
+
         vs = [v for v in self.variants(name) if "batch" in v]
         if not vs:
             raise BundleError(
@@ -178,10 +182,49 @@ class Bundle:
                 f"{name}: no bucketed variants (build with --batch-buckets)",
             )
         vs.sort(key=lambda v: v["batch"])
-        for v in vs:
-            if v["batch"] >= batch:
-                return v
-        return vs[-1]
+        picked = pick_bucket(batch, [v["batch"] for v in vs])
+        if picked is None:  # exceeds every bucket: largest wins.
+            return vs[-1]
+        return next(v for v in vs if v["batch"] == picked)
+
+    def batch_buckets(self, name: str, batch_axis: int = 0) -> list[int]:
+        """Sorted device-batch sizes this bundle precompiled for ``name``
+        (the serving tier's admission-control coverage set). The default
+        (unbucketed) variant counts too: its batch is the leading dim of
+        its first recorded input aval along ``batch_axis``."""
+        out = set()
+        for v in self.variants(name):
+            if "batch" in v:
+                out.add(int(v["batch"]))
+                continue
+            avals = v.get("in_avals") or []
+            if avals and len(avals[0]["shape"]) > batch_axis:
+                out.add(int(avals[0]["shape"][batch_axis]))
+        return sorted(out)
+
+    def sample_args(self, name: str, *, batch: int | None = None):
+        """The CONCRETE argument pytree a variant was built from
+        (host-numpy leaves, no tracing, no compiles): the bundle stores
+        the build-time ``make_args()`` values as an ``args_sample``
+        object. This is how a zero-compile serving replica gets a
+        semantically valid template carry (equilibrium warm starts,
+        identity attitudes) without running the eager jnp factories —
+        ``probe_args`` only synthesizes unit values, which are the wrong
+        CONTENTS for a controller state."""
+        import jax
+
+        variant = (self.variant_for_batch(name, batch)
+                   if batch is not None else self.variants(name)[0])
+        ref = variant.get("args_sample")
+        if ref is None:
+            raise BundleError(
+                "missing_entry", self.directory,
+                f"{name}: bundle predates args_sample artifacts — rebuild "
+                "(tools/aot_bundle.py build) to serve template carries",
+            )
+        leaves = pickle.loads(self._read_object(ref))
+        return jax.tree.unflatten(self._treedef(variant["in_treedef"]),
+                                  leaves)
 
     # ------------------------------------------------------ calling ----
     def _call_exec(self, name: str, variant: dict, flat_args):
@@ -337,7 +380,11 @@ def serve_entry(bundle: Bundle | None, name: str, args, *,
     ``bundle`` None (or a bundle COVERAGE miss — ``missing_entry``,
     ``signature_mismatch``, ``treedef_mismatch``, a stale/absent exec)
     falls through to ``jit_fallback`` — an unjitted callable taking the
-    same args; its rung is ``jit_cached`` when a persistent compilation
+    same args, OR an already-jitted one (anything with ``.lower``, e.g. a
+    ``jax.jit`` wrapper): a serving replica calling ``serve_entry`` per
+    request must pass its ONE pre-jitted callable, because wrapping a
+    plain function in a fresh ``jax.jit`` per serve would retrace every
+    request. The rung is ``jit_cached`` when a persistent compilation
     cache is configured in this process, ``jit_cold`` otherwise. An
     INTEGRITY failure (:data:`INTEGRITY_KINDS`: corrupt object,
     unreadable/newer-schema manifest) re-raises after journaling even
@@ -379,7 +426,11 @@ def serve_entry(bundle: Bundle | None, name: str, args, *,
         )
     rung = (RUNG_JIT_CACHED
             if jax.config.jax_compilation_cache_dir else RUNG_JIT_COLD)
-    out = jax.jit(jit_fallback)(*args)
+    # A pre-jitted fallback (duck-typed on .lower, which every jax.jit
+    # wrapper carries) is called as-is so repeat serves reuse ITS cache.
+    jitted = (jit_fallback if hasattr(jit_fallback, "lower")
+              else jax.jit(jit_fallback))
+    out = jitted(*args)
     jax.block_until_ready(out)
     emit(rung)
     return out, rung

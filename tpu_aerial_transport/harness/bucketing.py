@@ -24,7 +24,7 @@ Python loop (test_rqpcontrollers.py:112-124) and never faces batch coupling.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +42,29 @@ def bucket_dim(d: int, tile: int) -> int:
     if d < 0 or tile <= 0:
         raise ValueError((d, tile))
     return ((d + tile - 1) // tile) * tile
+
+
+def pick_bucket(size: int, buckets: Sequence[int]) -> int | None:
+    """Smallest bucket that ADMITS ``size`` (bucket >= size), or ``None``
+    when no bucket does. THE shared bucket-selection rule: the AOT
+    loader's ``variant_for_batch`` (which precompiled batch variant serves
+    a request batch) and the serving tier's batcher (which device-batch
+    size a group of admitted requests lands on) both route through here,
+    so "smallest admitting bucket" has exactly one definition.
+
+    Ties (duplicate bucket values) resolve to that value — the caller's
+    variant list order decides between equal-sized variants. Callers that
+    want the PR-8 loader semantics ("largest bucket when the request
+    exceeds every bucket, caller truncates/splits") handle the ``None``
+    themselves; admission control instead REJECTS on ``None`` for
+    per-request shapes (no coverage) and splits batches for counts.
+    """
+    if size < 0:
+        raise ValueError(f"pick_bucket: negative size {size}")
+    if not buckets:
+        raise ValueError("pick_bucket: empty bucket list")
+    admitting = [b for b in buckets if b >= size]
+    return min(admitting) if admitting else None
 
 
 def _take(tree, idx):

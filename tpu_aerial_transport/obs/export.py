@@ -30,10 +30,14 @@ from tpu_aerial_transport.obs import telemetry as telemetry_mod
 # records from ``resilience.backend.BackendGuard``). v3: adds the
 # ``aot_serve`` type (fallback-ladder rung + wall time per served
 # entrypoint call, from ``aot.loader.serve_entry`` — which processes are
-# still paying compiles). Files written at older versions remain valid
-# (see :data:`SUPPORTED_SCHEMAS`) — each bump only ADDS vocabulary.
-SCHEMA_VERSION = 3
-SUPPORTED_SCHEMAS = frozenset({1, 2, 3})
+# still paying compiles). v4: adds the ``serving_event`` type (the
+# continuous-batching scenario-serving tier's request/batch lifecycle:
+# admission, rejection-with-reason, SLO timestamps, deadline-miss
+# classification, per-boundary batch occupancy + rung — ``serving/``).
+# Files written at older versions remain valid (see
+# :data:`SUPPORTED_SCHEMAS`) — each bump only ADDS vocabulary.
+SCHEMA_VERSION = 4
+SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4})
 
 # Event vocabulary -> required fields (beyond schema/event/ts). The
 # validator rejects unknown event types and missing fields; extra fields
@@ -49,6 +53,12 @@ EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "rollout_summary": ("logs",),
     "backend_event": ("kind", "label"),
     "aot_serve": ("entry", "rung"),
+    # kind in {submitted, rejected, admitted, completed, deadline_missed,
+    # batch_launch, batch_boundary, preempted, resumed}; request-lifecycle
+    # kinds also carry request_id, batch kinds carry batch_id (extra
+    # fields are schema-legal — the reader contract is per-kind, rendered
+    # by tools/run_health.py's serving SLO section).
+    "serving_event": ("kind",),
 }
 
 # Events that did not exist before a given schema version: an event of
@@ -57,6 +67,7 @@ EVENT_FIELDS: dict[str, tuple[str, ...]] = {
 EVENT_MIN_SCHEMA: dict[str, int] = {
     "backend_event": 2,
     "aot_serve": 3,
+    "serving_event": 4,
 }
 
 

@@ -32,10 +32,14 @@ FAULTS = "faults"              # fault schedule eval + sensor noise.
 FALLBACK = "fallback"          # force-fallback ladder + quarantine.
 TELEMETRY = "telemetry"        # in-jit telemetry accumulation.
 SHARDED_STEP = "sharded_step"  # shard_map plumbing outside finer scopes.
+SERVING_CHUNK = "serving_chunk"  # vmap plumbing of the serving tier's
+#                                  batched chunk (serving/batcher.py);
+#                                  finer controller scopes inside win.
 
 PHASES = (
     QP_BUILD, CBF_ROWS, LOCAL_SOLVE, CONSENSUS, CONSENSUS_EXCHANGE,
     DUAL_UPDATE, DYNAMICS, PAD, FAULTS, FALLBACK, TELEMETRY, SHARDED_STEP,
+    SERVING_CHUNK,
 )
 
 

@@ -211,6 +211,21 @@ def scenario_rollout(rollout_fn: Callable, mesh: Mesh, axis: str = "scenario",
     return run
 
 
+def vmap_chunk_jit(chunk_fn: Callable, donate: bool = False):
+    """Batched-chunk jit for :func:`scenario_rollout_resumable`: vmap an
+    unjitted single-scenario chunk ``(carry, i0) -> (carry, logs)`` over
+    the leading lane axis (the step offset stays scalar) and jit it
+    once. The serving tier's continuous batcher builds the SAME shape of
+    program but wraps its vmap in the ``tat.serving_chunk`` attribution
+    scope (``serving.batcher.Family.batched_fn``) — change batching
+    semantics (in_axes, donation) in BOTH places or the serving batches
+    silently diverge."""
+    return jax.jit(
+        jax.vmap(chunk_fn, in_axes=(0, None)),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
 def scenario_rollout_resumable(
     chunk_fn: Callable,
     mesh: Mesh,
@@ -268,10 +283,7 @@ def scenario_rollout_resumable(
             f"n_hl_steps={n_hl_steps} not divisible by n_chunks={n_chunks}"
             " — must match the chunking the chunk_fn was built with"
         )
-    batched_jit = jax.jit(
-        jax.vmap(chunk_fn, in_axes=(0, None)),
-        donate_argnums=(0,) if donate else (),
-    )
+    batched_jit = vmap_chunk_jit(chunk_fn, donate=donate)
     plan = recovery.RunPlan(
         run_dir=run_dir, n_hl_steps=n_hl_steps, n_chunks=n_chunks,
         seed=seed, config_hash=config_hash, keep_last=keep_last,

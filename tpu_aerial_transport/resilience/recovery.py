@@ -60,6 +60,19 @@ CARRY_PREFIX = "carry"
 LOGS_PREFIX = "logs"
 
 
+def host_copy(tree):
+    """THE host backup of a device pytree (retry/requeue/snapshot anchor):
+    ``np.array(copy=True)`` per leaf, NOT ``np.asarray`` — on the CPU
+    backend ``np.asarray`` of a jax array is a zero-copy VIEW of the
+    device buffer, which a later donation (or a dying device) silently
+    recycles under the "backup". Shared by :func:`run_chunks` and the
+    serving tier's boundary bookkeeping (``serving/server.py``) so the
+    footgun is documented and dodged in exactly one place. Also a device
+    sync: it blocks until the leaves are ready, surfacing device errors
+    at the caller."""
+    return jax.tree.map(lambda l: np.array(l, copy=True), tree)
+
+
 @dataclasses.dataclass(frozen=True)
 class RunPlan:
     """Static description of a chunked run — journaled at start, re-read by
@@ -263,11 +276,9 @@ def run_chunks(
         })
     logs_chunks = list(prior_logs)
     # The host copy is the retry/requeue anchor: donation consumes device
-    # buffers, a dying device drops them — numpy on the host survives both.
-    # np.array(copy=True), NOT np.asarray: on the CPU backend np.asarray is
-    # a zero-copy VIEW of the device buffer, which the next chunk's
-    # donation would silently recycle under the "backup".
-    carry_host = jax.tree.map(lambda l: np.array(l, copy=True), carry)
+    # buffers, a dying device drops them — numpy on the host survives both
+    # (host_copy documents why it must be a real copy).
+    carry_host = host_copy(carry)
     carry = place(carry) if place is not None else carry
     retries_total = 0
     attempt = 0
@@ -328,9 +339,7 @@ def run_chunks(
                 # published: rebinding carry_host here would make a
                 # snapshot IO failure retry chunk c from chunk c's own
                 # output — applying its dynamics twice.
-                out_host = jax.tree.map(
-                    lambda l: np.array(l, copy=True), out_carry
-                )
+                out_host = host_copy(out_carry)
                 return out_carry, out_logs, out_host
 
             if guard is None:

@@ -1,0 +1,21 @@
+"""Scenario-serving tier: continuous batching, admission control, and
+per-request SLO accounting over the package's compiled rollout programs.
+
+The ROADMAP's "refactor that turns a bench harness into a service":
+heterogeneous :class:`~tpu_aerial_transport.serving.queue.ScenarioRequest`
+traffic is admitted through a bounded queue (``queue.py``), grouped by
+shape bucket into donation-clean device batches that reuse ONE compiled
+chunk program per bucket (``batcher.py`` — late arrivals join at the
+PR-4 chunk seam), and driven by a host-side server whose every device
+interaction goes through the backend guard and whose every compiled call
+is served through the AOT bundle ladder (``server.py``). Preemption
+safety rides the recovery tier's journal + snapshots: a SIGTERM mid-batch
+completes at the chunk boundary and a restarted process re-admits the
+remainder bit-identically.
+"""
+
+from tpu_aerial_transport.serving.queue import (  # noqa: F401
+    AdmissionQueue,
+    ScenarioRequest,
+    Ticket,
+)

@@ -1,0 +1,464 @@
+"""Continuous batching: heterogeneous requests grouped by shape bucket
+into device batches that reuse ONE compiled chunk program per bucket.
+
+A **family** is a served program: controller + agent count + chunk shape
+(:class:`FamilySpec`, pure data). Its device program is the PR-4 chunked
+rollout's single compiled chunk ``(carry, i0) -> (carry, logs)`` vmapped
+over a leading lane axis — so the compiled shapes are keyed on
+``(family, bucket)`` and NEVER churn: partially-full batches pad with
+quarantined filler lanes (copies of the family template whose results
+are discarded), and the bucket for a group of admitted requests is the
+smallest admitting one (``harness.bucketing.pick_bucket`` — the same
+rule the AOT loader uses to pick a precompiled batch variant, so
+admission-control coverage and bundle coverage agree by construction).
+
+Chunk boundaries are the continuous-batching seam: after every chunk,
+lanes whose requests finished their horizon are harvested (result = the
+lane's slice of the boundary carry) and late-arriving requests of the
+same family are admitted into the freed/filler lanes by host-side lane
+surgery on the boundary carry — no reshape, no recompile.
+
+Lane independence contract: a lane's result must not depend on which
+OTHER lanes share its batch (admission order, filler contents) or on the
+batch's global step offset. The first holds because vmapped lanes
+compute independently (the worst-lane ``while_loop`` trip count freezes
+converged lanes' carries exactly — asserted for regrouping by
+tests/test_bucketing.py and for serving by tests/test_serving.py); the
+second is why :func:`make_family` builds a TIME-INVARIANT tracking
+reference (``acc_des_fn`` ignores ``t``) — lanes admitted at different
+chunk boundaries run at different global offsets inside one batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpu_aerial_transport.harness.bucketing import pick_bucket
+from tpu_aerial_transport.serving import queue as queue_mod
+
+# Default shape buckets (bucket_dim grid, f32 sublane tile multiples).
+DEFAULT_BUCKETS = (8, 16, 32)
+
+
+@dataclasses.dataclass(frozen=True)
+class FamilySpec:
+    """One served program family (pure data — hashable, journalable).
+    ``entry`` names the family's ``analysis.entrypoints`` registry /
+    AOT-bundle entry when it has one (the canonical families below do;
+    ad-hoc families serve through the jit rung only)."""
+
+    name: str
+    controller: str = "cadmm"
+    n: int = 4
+    chunk_len: int = 2
+    hl_rel_freq: int = 2
+    max_iter: int = 2
+    inner_iters: int = 4
+    entry: str | None = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# The canonical families: ONE source of truth shared by the contract
+# registry (analysis/contracts.py builds the serving_chunk entrypoints
+# from these), the AOT bundle (its variants ARE the serving tier's
+# zero-compile admission surface), and the server's defaults — so a
+# bundle built from the registry always signature-matches the batches
+# the server dispatches.
+CANONICAL_FAMILIES: dict[str, FamilySpec] = {
+    "cadmm4": FamilySpec(
+        name="cadmm4", controller="cadmm", n=4,
+        entry="serving.batcher:serving_chunk",
+    ),
+    "centralized4": FamilySpec(
+        name="centralized4", controller="centralized", n=4,
+        entry="serving.batcher:serving_chunk_centralized",
+    ),
+}
+
+
+class Family:
+    """A family's host-side handles. Device-program construction is LAZY
+    (`.chunk_fn` / `.batched_jit` / `.template_carry_host()`): a strict
+    bundled replica never builds them — its template carry comes from the
+    bundle's ``args_sample`` and its dispatches replay precompiled
+    executables, so the process stays zero-compile."""
+
+    def __init__(self, spec: FamilySpec):
+        self.spec = spec
+        self._built = None
+        self._batched_jit = None
+        self._template_host = None
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    @property
+    def chunk_len(self) -> int:
+        return self.spec.chunk_len
+
+    @property
+    def entry(self) -> str | None:
+        return self.spec.entry
+
+    # ------------------------------------------------ lazy jnp builds --
+    def _build(self):
+        if self._built is None:
+            self._built = _build_chunk(self.spec)
+        return self._built
+
+    @property
+    def chunk_fn(self):
+        """Unjitted single-scenario chunk ``(carry, i0) -> (carry, logs)``."""
+        return self._build()[0]
+
+    @property
+    def batched_fn(self):
+        """Unjitted batched chunk ``(batch_carry, i0) -> (batch_carry,
+        logs)`` — the registry/bundle entry callable (lanes vmapped, step
+        offset scalar, ``tat.serving_chunk`` scope on the plumbing)."""
+        import jax
+
+        from tpu_aerial_transport.obs import phases
+
+        chunk_fn = self.chunk_fn
+
+        def batched(carry, i0):
+            with phases.scope(phases.SERVING_CHUNK):
+                return jax.vmap(chunk_fn, in_axes=(0, None))(carry, i0)
+
+        return batched
+
+    @property
+    def batched_jit(self):
+        """The family's ONE jitted batched chunk (pre-jitted so
+        ``aot.loader.serve_entry`` reuses its cache across requests)."""
+        if self._batched_jit is None:
+            import jax
+
+            self._batched_jit = jax.jit(self.batched_fn)
+        return self._batched_jit
+
+    def template_carry_host(self):
+        """The family's canonical initial lane carry as a HOST pytree
+        (identity attitudes, equilibrium warm starts). Built through the
+        jnp state factories — pays their eager compiles — so bundled
+        servers override it with the bundle's ``args_sample`` instead
+        (``server.ScenarioServer``)."""
+        if self._template_host is None:
+            from tpu_aerial_transport.resilience.recovery import host_copy
+
+            self._template_host = host_copy(self._build()[1])
+        return self._template_host
+
+    def set_template_carry_host(self, template) -> None:
+        """Install an externally sourced template (the bundle's
+        ``args_sample`` lane) — numpy leaves, no device work."""
+        self._template_host = template
+
+    # ------------------------------------------------- host-side lanes --
+    def lane_carry(self, template, request: queue_mod.ScenarioRequest):
+        """A fresh lane carry for ``request``: the template with the
+        scenario's initial payload position/velocity written in. Pure
+        numpy — callable on the zero-compile path."""
+        state, rest = template[0], template[1:]
+        dtype = np.asarray(state.xl).dtype
+        state = state.replace(
+            xl=np.asarray(request.x0, dtype),
+            vl=np.asarray(request.v0, dtype),
+        )
+        return (state,) + tuple(rest)
+
+    def lane_result(self, carry_host, lane: int):
+        """A completed lane's deliverable: the final SCENARIO STATE
+        (carry element 0), copied out of the boundary carry. The
+        controller state (warm starts, duals, per-solve residual
+        diagnostics) is server-internal and deliberately excluded — its
+        scalar residual diagnostics are reduction-order artifacts that
+        vary with the surrounding batch's bucket size on XLA-CPU, while
+        the scenario state itself is bitwise composition-independent
+        (asserted by tests/test_serving.py across buckets, filler
+        padding, and late joins)."""
+        import jax
+
+        return jax.tree.map(
+            lambda x: np.array(x[lane], copy=True), carry_host[0]
+        )
+
+    def config_hash(self) -> str:
+        from tpu_aerial_transport.harness.checkpoint import (
+            config_fingerprint,
+        )
+
+        return config_fingerprint(family=self.spec.to_json())
+
+
+def _build_chunk(spec: FamilySpec):
+    """Build the family's unjitted single-scenario chunk + canonical
+    initial carry (jnp path). The tracking reference is TIME-INVARIANT
+    (PD toward a fixed hover anchor — ``acc_des_fn`` drops ``t``): see
+    the module docstring's lane-independence contract."""
+    import jax.numpy as jnp
+
+    from tpu_aerial_transport.control import centralized, lowlevel
+    from tpu_aerial_transport.harness import rollout as h_rollout
+    from tpu_aerial_transport.harness import setup
+
+    params, col, state0 = setup.rqp_setup(spec.n)
+    f_eq = centralized.equilibrium_forces(params)
+    llc = lowlevel.make_lowlevel_controller("pd", params)
+    anchor = jnp.zeros(3, jnp.float32)
+
+    def acc_des_fn(state, t):
+        del t  # time-invariant: lanes at different offsets are legal.
+        dvl = -1.0 * state.vl - 1.0 * (state.xl - anchor)
+        return (dvl, jnp.zeros(3, state.xl.dtype)), anchor, jnp.zeros(3)
+
+    if spec.controller == "cadmm":
+        from tpu_aerial_transport.control import cadmm
+
+        # pad_operators pinned True: the serving chunk is a registered
+        # TC104-enforced entrypoint — the tile-target program structure is
+        # checked even on a CPU host (same pinning as the resilient
+        # contract builders).
+        cfg = cadmm.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=spec.max_iter, inner_iters=spec.inner_iters,
+            pad_operators=True,
+        )
+        plan = cadmm.make_plan(params, cfg)
+        cs0 = cadmm.init_cadmm_state(params, cfg)
+
+        def hl(cs, s, a):
+            return cadmm.control(params, cfg, f_eq, cs, s, a, plan=plan)
+
+    elif spec.controller == "dd":
+        from tpu_aerial_transport.control import dd
+
+        cfg = dd.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=spec.max_iter, inner_iters=spec.inner_iters,
+            pad_operators=True,
+        )
+        plan = dd.make_dd_plan(params, cfg)
+        cs0 = dd.init_dd_state(params, cfg)
+
+        def hl(cs, s, a):
+            return dd.control(params, cfg, f_eq, cs, s, a, plan=plan)
+
+    elif spec.controller == "centralized":
+        cfg = centralized.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            solver_iters=max(spec.inner_iters, 4),
+        )
+        cs0 = centralized.init_ctrl_state(params, cfg)
+
+        def hl(cs, s, a):
+            return centralized.control(params, cfg, f_eq, cs, s, a)
+
+    else:
+        raise ValueError(f"unknown serving controller {spec.controller!r}")
+
+    run = h_rollout.make_chunked_rollout(
+        hl, llc.control, params,
+        n_hl_steps=spec.chunk_len, n_chunks=1,
+        hl_rel_freq=spec.hl_rel_freq, acc_des_fn=acc_des_fn,
+        donate=False,  # boundary carries are harvested/spliced host-side.
+    )
+    return run.chunk_fn, run.init_carry(state0, cs0)
+
+
+def make_family(spec: FamilySpec | str) -> Family:
+    if isinstance(spec, str):
+        spec = CANONICAL_FAMILIES[spec]
+    return Family(spec)
+
+
+# ----------------------------------------------------------------------
+# The per-family continuous batch.
+# ----------------------------------------------------------------------
+
+_next_batch_id = 0
+
+
+def _alloc_batch_id() -> int:
+    global _next_batch_id
+    i = _next_batch_id
+    _next_batch_id += 1
+    return i
+
+
+def reserve_batch_ids(past: int) -> None:
+    """Advance the process-wide batch-id allocator so every FUTURE batch
+    id is >= ``past`` (never moves it backward). ``ScenarioServer.resume``
+    calls this with (max journaled batch id + 1): a fresh process's
+    allocator restarts at 0, and a post-resume launch reusing a journaled
+    id would collide snapshot prefixes (``serving_b<id>``) and journal
+    identities with the restored batch — a second resume could then
+    silently restore another request's carry."""
+    global _next_batch_id
+    _next_batch_id = max(_next_batch_id, past)
+
+
+def _tree_map(fn, *trees):
+    import jax
+
+    return jax.tree.map(fn, *trees)
+
+
+class Batch:
+    """Host bookkeeping for one in-flight device batch of ``bucket``
+    lanes. The device carry itself is owned by the server (which runs the
+    chunks); this class owns lane assignment, per-lane remaining-chunk
+    counts, SLO transitions, and the boundary carry's lane surgery."""
+
+    def __init__(self, family: Family, bucket: int, template,
+                 clock, emit, batch_id: int | None = None):
+        self.family = family
+        self.bucket = bucket
+        self.batch_id = (_alloc_batch_id() if batch_id is None
+                         else batch_id)
+        self.clock = clock
+        self.emit = emit
+        # Filler lanes = template copies; results discarded (quarantined).
+        self.carry_host = _tree_map(
+            lambda x: np.stack([np.asarray(x)] * bucket), template
+        )
+        self.tickets: list[queue_mod.Ticket | None] = [None] * bucket
+        self.remaining = np.zeros(bucket, np.int64)
+        self.chunks_done = 0
+        self.occupancy_samples: list[float] = []
+
+    # --------------------------------------------------------- lanes ---
+    @property
+    def active_lanes(self) -> int:
+        return sum(t is not None for t in self.tickets)
+
+    @property
+    def retired(self) -> bool:
+        return self.active_lanes == 0
+
+    def free_lanes(self) -> list[int]:
+        return [i for i, t in enumerate(self.tickets) if t is None]
+
+    def admit(self, ticket: queue_mod.Ticket, lane: int,
+              remaining: int | None = None) -> None:
+        """Lane surgery at a boundary (or at launch): write the request's
+        initial carry into ``lane`` of the boundary carry and start its
+        chunk countdown."""
+        req = ticket.request
+        lane_carry = self.family.lane_carry(
+            self.family.template_carry_host(), req
+        )
+        for dst, src in zip(
+            _leaves(self.carry_host), _leaves(lane_carry)
+        ):
+            dst[lane] = src
+        self.tickets[lane] = ticket
+        self.remaining[lane] = (
+            req.horizon // self.family.chunk_len
+            if remaining is None else remaining
+        )
+        ticket.batch_id = self.batch_id
+        ticket.lane = lane
+        ticket.slo.t_admit = self.clock()
+        self.emit(kind="admitted", request_id=req.request_id,
+                  family=self.family.name, batch_id=self.batch_id,
+                  lane=lane, bucket=self.bucket)
+
+    def restore_lane(self, ticket: queue_mod.Ticket, lane: int,
+                     remaining: int) -> None:
+        """Resume-path bookkeeping ONLY: bind a ticket to a lane whose
+        carry was just restored from a boundary snapshot — no lane
+        surgery (writing the template over the restored mid-flight carry
+        would restart the scenario)."""
+        self.tickets[lane] = ticket
+        self.remaining[lane] = remaining
+        ticket.batch_id = self.batch_id
+        ticket.lane = lane
+        ticket.slo.t_admit = self.clock()
+        self.emit(kind="admitted", request_id=ticket.request.request_id,
+                  family=self.family.name, batch_id=self.batch_id,
+                  lane=lane, bucket=self.bucket, restored=True)
+
+    # ------------------------------------------------------ boundary ---
+    def record_launch(self) -> None:
+        """Called just before each chunk dispatch: stamp t_launch on
+        newly admitted lanes and sample occupancy."""
+        now = self.clock()
+        for t in self.tickets:
+            if t is not None and t.slo.t_launch is None:
+                t.slo.t_launch = now
+        self.occupancy_samples.append(self.active_lanes / self.bucket)
+
+    def harvest(self) -> list[queue_mod.Ticket]:
+        """Process one completed chunk boundary: decrement countdowns,
+        resolve lanes that finished their horizon (deadline-classified),
+        free their lanes. Returns the resolved tickets."""
+        self.chunks_done += 1
+        now = self.clock()
+        finished: list[queue_mod.Ticket] = []
+        for lane, ticket in enumerate(self.tickets):
+            if ticket is None:
+                continue
+            self.remaining[lane] -= 1
+            if self.remaining[lane] > 0:
+                continue
+            ticket.slo.t_complete = now
+            ticket.result = self.family.lane_result(self.carry_host, lane)
+            ticket.steps_served = (
+                ticket.request.horizon // self.family.chunk_len
+            ) * self.family.chunk_len
+            slo = ticket.slo
+            if slo.deadline_at is not None and now > slo.deadline_at:
+                slo.missed = queue_mod.MISSED_IN_FLIGHT
+                ticket._resolve(queue_mod.DEADLINE_MISSED)
+                self.emit(kind="deadline_missed",
+                          request_id=ticket.request.request_id,
+                          family=self.family.name,
+                          batch_id=self.batch_id,
+                          missed=queue_mod.MISSED_IN_FLIGHT,
+                          slo=slo.to_event())
+            else:
+                ticket._resolve(queue_mod.COMPLETED)
+                self.emit(kind="completed",
+                          request_id=ticket.request.request_id,
+                          family=self.family.name,
+                          batch_id=self.batch_id,
+                          steps=ticket.steps_served,
+                          slo=slo.to_event())
+            self.tickets[lane] = None
+            finished.append(ticket)
+        return finished
+
+    def lanes_json(self) -> list[list]:
+        """Journal form of the lane map (resume reads it back)."""
+        return [
+            [lane, t.request.request_id, int(self.remaining[lane])]
+            for lane, t in enumerate(self.tickets) if t is not None
+        ]
+
+    def mean_occupancy(self) -> float | None:
+        if not self.occupancy_samples:
+            return None
+        return float(np.mean(self.occupancy_samples))
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def bucket_for(pending: int, buckets) -> int:
+    """Device-batch size for ``pending`` waiting requests: the smallest
+    admitting bucket, or the largest bucket when more are waiting than
+    any bucket holds (the rest stay queued for the next batch/boundary).
+    """
+    bs = sorted(buckets)
+    picked = pick_bucket(min(pending, bs[-1]), bs)
+    return bs[-1] if picked is None else picked

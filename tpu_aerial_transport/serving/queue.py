@@ -1,0 +1,243 @@
+"""Bounded admission queue with per-request SLO accounting.
+
+Requests are heterogeneous (:class:`ScenarioRequest`: family = a
+registered (controller, n, chunk shape) program, horizon, scenario
+parameters, deadline); admission control REJECTS with a structured reason
+— never an exception into the server loop — when the queue is full, when
+the request's family has no compiled-bucket coverage, or when the request
+cannot be served as specified (horizon off the chunk grid, deadline
+already spent). Every transition lands as a ``serving_event`` metrics
+row (``obs.export`` schema v4) so ``tools/run_health.py`` can render
+admit→complete latency percentiles and rejection/deadline-miss counts
+without instrumenting the caller.
+
+The SLO clock per request::
+
+    t_submit --(queue)--> t_admit --(lane wait)--> t_launch --> t_complete
+                 |                                        |
+                 +-- deadline passes: missed "in_queue"   +-- "in_flight"
+
+``t_admit`` is when the request entered a device batch lane (at a batch
+launch or a later chunk boundary — the continuous-batching seam);
+``t_launch`` is the first chunk dispatch that contained it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+
+import numpy as np
+
+# Statuses a ticket resolves to.
+PENDING = "pending"
+COMPLETED = "completed"
+REJECTED = "rejected"
+DEADLINE_MISSED = "deadline_missed"
+
+# Structured rejection reasons (admission control).
+REASON_QUEUE_FULL = "queue_full"
+REASON_NO_COVERAGE = "no_bucket_coverage"
+REASON_BAD_HORIZON = "horizon_not_chunk_aligned"
+REASON_DEADLINE_SPENT = "deadline_already_passed"
+
+# Deadline-miss classification.
+MISSED_IN_QUEUE = "in_queue"
+MISSED_IN_FLIGHT = "in_flight"
+
+_req_counter = itertools.count()
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioRequest:
+    """One scenario-MPC job. ``family`` names a server-registered program
+    family (controller + n + chunk shape); ``horizon`` is the requested
+    high-level step count (must be a multiple of the family's chunk
+    length — chunk boundaries are the only admission/harvest seams);
+    ``x0``/``v0`` are the scenario's initial payload position/velocity;
+    ``deadline_s`` is a wall-clock budget relative to submission (None =
+    no deadline)."""
+
+    family: str
+    horizon: int
+    x0: tuple = (0.0, 0.0, 0.0)
+    v0: tuple = (0.0, 0.0, 0.0)
+    deadline_s: float | None = None
+    request_id: str = dataclasses.field(
+        default_factory=lambda: f"req{next(_req_counter):06d}"
+    )
+
+    def to_json(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "family": self.family,
+            "horizon": int(self.horizon),
+            "x0": [float(v) for v in np.asarray(self.x0).reshape(-1)],
+            "v0": [float(v) for v in np.asarray(self.v0).reshape(-1)],
+            "deadline_s": (None if self.deadline_s is None
+                           else float(self.deadline_s)),
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "ScenarioRequest":
+        return cls(
+            family=obj["family"], horizon=obj["horizon"],
+            x0=tuple(obj["x0"]), v0=tuple(obj["v0"]),
+            deadline_s=obj.get("deadline_s"),
+            request_id=obj["request_id"],
+        )
+
+
+@dataclasses.dataclass
+class SLO:
+    """Per-request SLO record: host timestamps (``clock`` domain — the
+    server's monotonic clock by default) plus the deadline bookkeeping."""
+
+    t_submit: float | None = None
+    t_admit: float | None = None
+    t_launch: float | None = None
+    t_complete: float | None = None
+    deadline_at: float | None = None  # absolute, clock domain.
+    missed: str | None = None         # MISSED_IN_QUEUE / MISSED_IN_FLIGHT.
+
+    def to_event(self) -> dict:
+        out = {k: v for k, v in dataclasses.asdict(self).items()
+               if v is not None}
+        if self.t_complete is not None and self.t_submit is not None:
+            out["latency_s"] = self.t_complete - self.t_submit
+        if self.t_complete is not None and self.t_admit is not None:
+            out["admit_to_complete_s"] = self.t_complete - self.t_admit
+        return out
+
+
+class Ticket:
+    """The caller's handle for a submitted request: status, SLO record,
+    and (on completion) the request's final scenario state as a host
+    pytree. ``wait()`` blocks a consumer thread until resolution — the
+    async side of the host pipeline; the server itself never blocks on
+    tickets."""
+
+    def __init__(self, request: ScenarioRequest):
+        self.request = request
+        self.slo = SLO()
+        self.status = PENDING
+        self.reason: str | None = None
+        self.result = None        # host pytree: the lane's final carry.
+        self.steps_served = 0
+        self.batch_id: int | None = None
+        self.lane: int | None = None
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self.status != PENDING
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    def _resolve(self, status: str, reason: str | None = None) -> None:
+        self.status = status
+        self.reason = reason
+        self._done.set()
+
+    def __repr__(self) -> str:  # operator-facing.
+        return (f"Ticket({self.request.request_id}, {self.status}"
+                + (f", {self.reason}" if self.reason else "") + ")")
+
+
+class AdmissionQueue:
+    """Bounded FIFO with admission control.
+
+    ``coverage`` maps a family name to its served chunk length (``int``)
+    or ``None`` when the family has no compiled-bucket coverage (unknown
+    family, or — in strict bundled mode — no bundle entry/variant); the
+    server supplies it so the queue never imports device code. ``emit``
+    is the server's ``serving_event`` sink (may be None)."""
+
+    def __init__(self, coverage, capacity: int = 256,
+                 clock=time.monotonic, emit=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.coverage = coverage
+        self.capacity = capacity
+        self.clock = clock
+        self.emit = emit or (lambda **kw: None)
+        self._pending: dict[str, list[Ticket]] = {}  # family -> FIFO.
+
+    # ------------------------------------------------------ admission --
+    def submit(self, request: ScenarioRequest) -> Ticket:
+        """Admit or reject one request. ALWAYS returns a resolved-or-
+        pending ticket (rejection is a structured status + reason +
+        ``serving_event``, never an exception)."""
+        ticket = Ticket(request)
+        now = self.clock()
+        ticket.slo.t_submit = now
+        if request.deadline_s is not None:
+            ticket.slo.deadline_at = now + float(request.deadline_s)
+
+        reason = self._admission_reason(request, now)
+        if reason is not None:
+            ticket._resolve(REJECTED, reason)
+            self.emit(kind="rejected", request_id=request.request_id,
+                      family=request.family, reason=reason,
+                      depth=self.depth())
+            return ticket
+
+        self._pending.setdefault(request.family, []).append(ticket)
+        self.emit(kind="submitted", request_id=request.request_id,
+                  family=request.family, horizon=request.horizon,
+                  depth=self.depth())
+        return ticket
+
+    def _admission_reason(self, request: ScenarioRequest,
+                          now: float) -> str | None:
+        if self.depth() >= self.capacity:
+            return REASON_QUEUE_FULL
+        chunk_len = self.coverage(request.family)
+        if chunk_len is None:
+            return REASON_NO_COVERAGE
+        if request.horizon <= 0 or request.horizon % chunk_len:
+            return REASON_BAD_HORIZON
+        if request.deadline_s is not None and request.deadline_s <= 0:
+            return REASON_DEADLINE_SPENT
+        return None
+
+    # ------------------------------------------------------- draining --
+    def depth(self, family: str | None = None) -> int:
+        if family is not None:
+            return len(self._pending.get(family, []))
+        return sum(len(v) for v in self._pending.values())
+
+    def families_pending(self) -> list[str]:
+        return sorted(f for f, v in self._pending.items() if v)
+
+    def take(self, family: str, k: int) -> list[Ticket]:
+        """Pop up to ``k`` oldest pending tickets of ``family`` (the
+        batcher admits them into device lanes)."""
+        fifo = self._pending.get(family, [])
+        taken, self._pending[family] = fifo[:k], fifo[k:]
+        return taken
+
+    def expire_deadlines(self) -> list[Ticket]:
+        """Resolve queued tickets whose deadline passed before admission:
+        status ``deadline_missed``, classified ``in_queue``."""
+        now = self.clock()
+        missed: list[Ticket] = []
+        for family, fifo in self._pending.items():
+            keep = []
+            for t in fifo:
+                if (t.slo.deadline_at is not None
+                        and now >= t.slo.deadline_at):
+                    t.slo.missed = MISSED_IN_QUEUE
+                    t._resolve(DEADLINE_MISSED)
+                    self.emit(kind="deadline_missed",
+                              request_id=t.request.request_id,
+                              family=family, missed=MISSED_IN_QUEUE,
+                              slo=t.slo.to_event())
+                    missed.append(t)
+                else:
+                    keep.append(t)
+            self._pending[family] = keep
+        return missed

@@ -1,0 +1,450 @@
+"""The scenario-serving driver: admission queue + continuous batcher
+wired to the device through the backend guard and the AOT serve ladder.
+
+Rules of the road (the ROADMAP's standing-subsystem contract):
+
+- **every device interaction goes through** ``resilience.backend
+  .BackendGuard`` — a wedged/flaky backend degrades a chunk to the
+  tagged CPU rung instead of killing the server loop;
+- **every compiled call is served through** ``aot.loader.serve_entry`` —
+  a bundled replica admits requests with ZERO in-process compiles (the
+  exec rung replays serialized executables; the family's template carry
+  comes from the bundle's ``args_sample``, so even input construction is
+  host-numpy); un-bundled processes fall down the ladder to the
+  family's ONE pre-jitted batched chunk;
+- **preemption safety rides the PR-4 journal**: every chunk boundary
+  publishes an atomic carry snapshot + a journaled lane map, so a
+  SIGTERM mid-batch completes at the boundary and
+  :meth:`ScenarioServer.resume` re-admits the remainder — recomputed
+  chunks are bit-identical to the uninterrupted run (the chunked-rollout
+  determinism contract, tests/test_serving.py).
+
+The server is host-synchronous by design (``pump()`` drives one
+scheduling round; ``run_until_drained()`` loops it): the async surface
+is the ticket — ``submit()`` never blocks on device work and consumers
+``Ticket.wait()`` from their own threads.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from tpu_aerial_transport.harness import checkpoint
+from tpu_aerial_transport.serving import batcher as batcher_mod
+from tpu_aerial_transport.serving import queue as queue_mod
+from tpu_aerial_transport.serving.batcher import (
+    DEFAULT_BUCKETS,
+    Batch,
+    Family,
+    make_family,
+)
+
+SERVING_JOURNAL = "serving_journal.jsonl"
+SNAP_PREFIX = "serving_b"  # + batch_id (checkpoint prefix grammar: no '-').
+
+
+class ScenarioServer:
+    """Serve a heterogeneous scenario-MPC request stream.
+
+    ``families``: iterable of :class:`FamilySpec` / canonical-family
+    names / :class:`Family` (default: the canonical families).
+    ``bundle``: an ``aot.loader.Bundle`` or bundle directory — the
+    zero-compile admission prerequisite; ``require_bundle=True`` makes
+    bundle coverage an ADMISSION criterion (uncovered families reject
+    with ``no_bucket_coverage``) and never builds a jit fallback.
+    ``run_dir`` turns on preemption safety (journal + per-boundary
+    snapshots). ``mesh`` (a ``jax.sharding.Mesh``) places each batch
+    sharded over its lane axis before dispatch — sharded
+    (``min_devices>1``) programs serve through the export/jit rungs, the
+    exec replay path addresses one device (PR-8 note).
+    """
+
+    def __init__(self, families=None, *, buckets=DEFAULT_BUCKETS,
+                 capacity: int = 256, bundle=None,
+                 require_bundle: bool = False, run_dir: str | None = None,
+                 metrics=None, guard=None, interrupt=None, mesh=None,
+                 clock=time.monotonic):
+        from tpu_aerial_transport.obs import export as export_mod
+        from tpu_aerial_transport.resilience import backend as backend_mod
+        from tpu_aerial_transport.resilience.recovery import RunJournal
+
+        if families is None:
+            families = list(batcher_mod.CANONICAL_FAMILIES.values())
+        self.families: dict[str, Family] = {}
+        for f in families:
+            fam = f if isinstance(f, Family) else make_family(f)
+            self.families[fam.name] = fam
+        self.buckets = tuple(sorted(buckets))
+        self.clock = clock
+        self.mesh = mesh
+        self.require_bundle = require_bundle
+        if isinstance(metrics, str):
+            metrics = export_mod.MetricsWriter(metrics)
+        self.metrics = metrics
+        self.guard = guard or backend_mod.BackendGuard(metrics=metrics)
+        self.interrupt = interrupt
+        self.preempted = False
+        self.run_dir = run_dir
+        self.journal = (RunJournal(run_dir, SERVING_JOURNAL)
+                        if run_dir else None)
+
+        if isinstance(bundle, str):
+            from tpu_aerial_transport.aot import loader as loader_mod
+
+            bundle = loader_mod.load_bundle(bundle)
+        self.bundle = bundle
+        self._install_bundle_templates()
+
+        self.queue = queue_mod.AdmissionQueue(
+            self._coverage, capacity=capacity, clock=clock,
+            emit=self._emit,
+        )
+        self.tickets: dict[str, queue_mod.Ticket] = {}
+        self.done_requests: set[str] = set()  # filled by resume().
+        self._batches: dict[str, Batch | None] = {}
+        self._occupancy: list[float] = []
+
+    # ------------------------------------------------------- coverage --
+    def _bundle_entry_buckets(self, fam: Family) -> list[int]:
+        """Device-batch sizes the bundle precompiled for this family
+        (empty when un-bundled / uncovered / pre-args_sample bundle)."""
+        if self.bundle is None or fam.entry is None:
+            return []
+        try:
+            return self.bundle.batch_buckets(fam.entry)
+        except Exception:  # missing_entry/manifest-only: no coverage.
+            return []
+
+    def _family_buckets(self, fam: Family) -> tuple[int, ...]:
+        covered = self._bundle_entry_buckets(fam)
+        if covered and self.require_bundle:
+            return tuple(covered)
+        if covered:
+            # Prefer precompiled buckets, but any configured bucket still
+            # serves via the jit rung.
+            return tuple(sorted(set(covered) | set(self.buckets)))
+        return self.buckets
+
+    def _coverage(self, family: str) -> int | None:
+        fam = self.families.get(family)
+        if fam is None:
+            return None
+        if self.require_bundle and not self._bundle_entry_buckets(fam):
+            return None
+        return fam.chunk_len
+
+    def _install_bundle_templates(self) -> None:
+        """Template carries from the bundle's build-time argument values:
+        lane 0 of the entry's recorded batch — host numpy, no compiles.
+        Families the bundle does not cover keep the lazy jnp build —
+        EXCEPT under ``require_bundle``, where a missing/corrupt
+        ``args_sample`` raises instead of silently degrading the
+        "zero-compile" replica into the eager jnp template build (the
+        compiles would land in the serve path with no visible cause)."""
+        from tpu_aerial_transport.aot.bundle import BundleError
+
+        if self.bundle is None:
+            return
+        for fam in self.families.values():
+            if fam.entry is None:
+                continue
+            try:
+                sample = self.bundle.sample_args(fam.entry)
+            except BundleError:
+                if self.require_bundle and self._bundle_entry_buckets(fam):
+                    # The family IS admissible (bucket coverage exists)
+                    # but its template cannot come from the bundle.
+                    raise
+                continue
+            batch_carry = sample[0]
+            fam.set_template_carry_host(_tree_map(
+                lambda x: np.array(np.asarray(x)[0], copy=True),
+                batch_carry,
+            ))
+
+    # ---------------------------------------------------------- events --
+    def _emit(self, **fields) -> None:
+        if self.metrics is not None:
+            self.metrics.emit("serving_event", **fields)
+        if self.journal is not None and fields.get("kind") in (
+            "completed", "deadline_missed",
+        ):
+            self.journal.append({
+                "event": "serving_done",
+                "request_id": fields.get("request_id"),
+                "status": fields["kind"],
+            })
+
+    # ---------------------------------------------------------- submit --
+    def submit(self, request: queue_mod.ScenarioRequest) -> queue_mod.Ticket:
+        """Admit or reject one request (never raises out of admission —
+        rejection is a resolved ticket with a structured reason)."""
+        ticket = self.queue.submit(request)
+        self.tickets[request.request_id] = ticket
+        if ticket.status == queue_mod.PENDING and self.journal is not None:
+            self.journal.append({
+                "event": "serving_request", "request": request.to_json(),
+            })
+        return ticket
+
+    # ------------------------------------------------------ scheduling --
+    def _check_preempt(self) -> bool:
+        if (not self.preempted and self.interrupt is not None
+                and self.interrupt.triggered):
+            self.preempted = True
+            if self.journal is not None:
+                self.journal.append({
+                    "event": "serving_preempted",
+                    "signal": self.interrupt.triggered,
+                })
+            self._emit(kind="preempted", signal=self.interrupt.triggered)
+        return self.preempted
+
+    def has_work(self) -> bool:
+        return bool(
+            self.queue.depth()
+            or any(b is not None and not b.retired
+                   for b in self._batches.values())
+        )
+
+    def pump(self) -> bool:
+        """One scheduling round: expire queue deadlines, launch batches
+        for families with pending work, advance every active batch by one
+        chunk (the boundary then harvests finished lanes and admits late
+        arrivals). Returns True while work remains (False after
+        preemption — the remainder is journaled for :meth:`resume`)."""
+        if self._check_preempt():
+            return False
+        self.queue.expire_deadlines()
+        for name, fam in self.families.items():
+            if self._check_preempt():
+                return False
+            batch = self._batches.get(name)
+            if batch is None or batch.retired:
+                if not self.queue.depth(name):
+                    continue
+                batch = self._launch(fam)
+            self._advance(fam, batch)
+        return self.has_work() and not self.preempted
+
+    def run_until_drained(self, max_rounds: int | None = None) -> dict:
+        rounds = 0
+        while self.pump():
+            rounds += 1
+            if max_rounds is not None and rounds >= max_rounds:
+                break
+        return self.stats()
+
+    # -------------------------------------------------------- batches --
+    def _launch(self, fam: Family) -> Batch:
+        bucket = batcher_mod.bucket_for(
+            self.queue.depth(fam.name), self._family_buckets(fam)
+        )
+        batch = Batch(fam, bucket, fam.template_carry_host(),
+                      self.clock, self._emit)
+        self._batches[fam.name] = batch
+        for lane, ticket in enumerate(self.queue.take(fam.name, bucket)):
+            batch.admit(ticket, lane)
+        self._emit(kind="batch_launch", family=fam.name,
+                   batch_id=batch.batch_id, bucket=bucket,
+                   lanes=batch.active_lanes)
+        return batch
+
+    def _advance(self, fam: Family, batch: Batch) -> None:
+        batch.record_launch()
+        i0 = np.int32(batch.chunks_done * fam.chunk_len)
+        carry = batch.carry_host
+        if self.mesh is not None:
+            from tpu_aerial_transport.parallel import mesh as mesh_mod
+
+            carry = mesh_mod.shard_scenarios(self.mesh, carry, "scenario")
+        label = f"{fam.name}:b{batch.batch_id}:c{batch.chunks_done}"
+        (out, serve_rung), guard_rung = self._dispatch(
+            fam, (carry, i0), label
+        )
+        from tpu_aerial_transport.resilience.recovery import host_copy
+
+        new_carry, _logs = out
+        batch.carry_host = host_copy(new_carry)
+        batch.harvest()
+        for lane in batch.free_lanes():
+            late = self.queue.take(fam.name, 1)
+            if not late:
+                break
+            batch.admit(late[0], lane)
+        occupancy = batch.occupancy_samples[-1]
+        self._snapshot_boundary(fam, batch)
+        self._emit(kind="batch_boundary", family=fam.name,
+                   batch_id=batch.batch_id, chunk=batch.chunks_done,
+                   occupancy=occupancy, rung=serve_rung,
+                   guard_rung=guard_rung)
+        if batch.retired:
+            self._occupancy.extend(batch.occupancy_samples)
+
+    def _dispatch(self, fam: Family, args, label: str):
+        """One guarded chunk through the serve ladder. Returns
+        ``((out, serve_rung), guard_rung)``."""
+        from tpu_aerial_transport.aot import loader as loader_mod
+        from tpu_aerial_transport.resilience import backend as backend_mod
+
+        entry = fam.entry or fam.name
+        jit_fb = None if self.require_bundle else fam.batched_jit
+
+        def primary():
+            return loader_mod.serve_entry(
+                self.bundle, entry, args, jit_fallback=jit_fb,
+                metrics=self.metrics, label=label,
+            )
+
+        fallback = None
+        if not self.require_bundle:
+            fallback = backend_mod.run_on_cpu(lambda: loader_mod.serve_entry(
+                None, entry, args, jit_fallback=fam.batched_jit,
+                metrics=self.metrics, label=label + ":cpu",
+            ))
+        return self.guard.run(label, primary, fallback_fn=fallback)
+
+    def _snapshot_boundary(self, fam: Family, batch: Batch) -> None:
+        if self.journal is None:
+            return
+        checkpoint.save_snapshot(
+            self.run_dir, batch.chunks_done, batch.carry_host,
+            prefix=f"{SNAP_PREFIX}{batch.batch_id}",
+            config_hash=fam.config_hash(), keep_last=2,
+            meta={"family": fam.name, "bucket": batch.bucket},
+        )
+        self.journal.append({
+            "event": "serving_batch", "batch_id": batch.batch_id,
+            "family": fam.name, "bucket": batch.bucket,
+            "chunk": batch.chunks_done, "lanes": batch.lanes_json(),
+        })
+
+    # ----------------------------------------------------------- stats --
+    def stats(self) -> dict:
+        by_status: dict[str, int] = {}
+        steps = 0
+        for t in self.tickets.values():
+            by_status[t.status] = by_status.get(t.status, 0) + 1
+            if t.status == queue_mod.COMPLETED:
+                steps += t.steps_served
+        # Retired batches already moved their samples into _occupancy
+        # (and may linger in _batches until replaced) — counting them
+        # here again would skew the mean toward each family's last batch.
+        live = [
+            s for b in self._batches.values()
+            if b is not None and not b.retired
+            for s in b.occupancy_samples
+        ]
+        occ = self._occupancy + live
+        return {
+            "requests": len(self.tickets),
+            **by_status,
+            "scenario_steps": steps,
+            "mean_occupancy": float(np.mean(occ)) if occ else None,
+            "preempted": self.preempted,
+        }
+
+    # ---------------------------------------------------------- resume --
+    @classmethod
+    def resume(cls, run_dir: str, families=None, **kw) -> "ScenarioServer":
+        """Rebuild a server from a preempted run directory: restore each
+        unfinished batch's boundary carry from its newest journaled
+        snapshot (lane map + chunk count from the matching journal
+        event), re-enqueue requests that were still waiting, and resolve
+        nothing twice. Recomputed work is bit-identical to the
+        uninterrupted run (chunk determinism); a batch whose snapshot
+        fails validation falls back to full request replay — also
+        bit-identical, just more recompute. Restored/replayed tickets are
+        reachable through ``server.tickets[request_id]``."""
+        from tpu_aerial_transport.resilience.recovery import RunJournal
+
+        events = RunJournal(run_dir, SERVING_JOURNAL).read()
+        requests: dict[str, queue_mod.ScenarioRequest] = {}
+        order: list[str] = []
+        done: set[str] = set()
+        last_batch: dict[int, dict] = {}
+        for e in events:
+            if e.get("event") == "serving_request":
+                req = queue_mod.ScenarioRequest.from_json(e["request"])
+                if req.request_id not in requests:
+                    order.append(req.request_id)
+                requests[req.request_id] = req
+            elif e.get("event") == "serving_done":
+                done.add(e.get("request_id"))
+            elif e.get("event") == "serving_batch":
+                last_batch[e["batch_id"]] = e
+
+        server = cls(families=families, run_dir=run_dir, **kw)
+        # Requests the journal already saw through to resolution: clients
+        # replaying their stream spec after a crash dedupe against this.
+        server.done_requests = done
+        server._emit(kind="resumed", run_dir=run_dir,
+                     pending=len([r for r in requests if r not in done]))
+        if server.journal is not None:
+            server.journal.append({"event": "serving_resumed"})
+
+        if last_batch:
+            # Fresh-process batch ids restart at 0: future launches must
+            # not collide with journaled batch identities/snapshots.
+            batcher_mod.reserve_batch_ids(max(last_batch) + 1)
+        restored: set[str] = set()
+        for bid in sorted(last_batch):
+            e = last_batch[bid]
+            live = [(lane, rid, rem) for lane, rid, rem in e["lanes"]
+                    if rid not in done and rid in requests]
+            if not live:
+                continue
+            fam = server.families.get(e["family"])
+            if fam is None:
+                continue  # family not configured: requests replay below.
+            path = checkpoint.snapshot_path(
+                run_dir, e["chunk"], f"{SNAP_PREFIX}{bid}"
+            )
+            template = _tree_map(
+                lambda x: np.stack([np.asarray(x)] * e["bucket"]),
+                fam.template_carry_host(),
+            )
+            try:
+                carry, _meta = checkpoint.load_snapshot(
+                    path, template, config_hash=fam.config_hash()
+                )
+            except checkpoint.SnapshotError as exc:
+                if server.journal is not None:
+                    server.journal.append({
+                        "event": "serving_snapshot_skipped",
+                        "batch_id": bid, "error": str(exc)[:300],
+                    })
+                continue  # full replay via the queue below.
+            batch = Batch(fam, e["bucket"], fam.template_carry_host(),
+                          server.clock, server._emit, batch_id=bid)
+            batch.carry_host = _tree_map(
+                lambda x: np.array(x, copy=True), carry
+            )
+            batch.chunks_done = e["chunk"]
+            for lane, rid, rem in live:
+                ticket = queue_mod.Ticket(requests[rid])
+                now = server.clock()
+                ticket.slo.t_submit = now
+                if requests[rid].deadline_s is not None:
+                    # Deadlines RE-ARM on resume (the monotonic clock
+                    # domain dies with the process) — same fresh budget a
+                    # still-queued request gets when it re-submits below.
+                    ticket.slo.deadline_at = (
+                        now + float(requests[rid].deadline_s)
+                    )
+                batch.restore_lane(ticket, lane, rem)
+                server.tickets[rid] = ticket
+                restored.add(rid)
+            server._batches[fam.name] = batch
+
+        for rid in order:
+            if rid in done or rid in restored:
+                continue
+            server.submit(requests[rid])
+        return server
+
+
+# One lazy-jax tree.map wrapper for the whole package (batcher.py owns it).
+_tree_map = batcher_mod._tree_map
