@@ -118,6 +118,13 @@ def ensure_backend(metric: str = HEADLINE_METRIC,
     return "cpu", reason
 
 
+# Topology the last successful probe reported (platform / n_devices /
+# n_processes) — the sweep stamps it on every cell so a chip-round record
+# can never be ambiguous about the mesh that measured it, without this
+# process paying an in-process backend init to ask.
+_PROBE_INFO: dict = {}
+
+
 def _probe_backend() -> tuple[bool, str]:
     """Subprocess-watchdogged backend probe (no printing, no exiting):
     ``(True, platform)`` when a backend answered, ``(False, error)``
@@ -129,13 +136,29 @@ def _probe_backend() -> tuple[bool, str]:
     round-trip) rather than just ``jax.devices()`` — round 2's probe
     passed on backend enumeration while the first dispatched op raised the
     lazy backend-init ``UNAVAILABLE`` (BENCH_r02.json); a probe "pass" now
-    implies the first real dispatch succeeds."""
+    implies the first real dispatch succeeds. The probe also reports the
+    visible device/process counts (stashed in :data:`_PROBE_INFO`) and,
+    when ``TAT_EXPECTED_DEVICES`` / ``TAT_EXPECTED_PROCESSES`` are set,
+    FAILS on a shortfall with a classified ``topology_mismatch`` — the
+    MULTICHIP_r01 failure mode (1 of 8 devices visible, probe green)
+    becomes a tagged CPU round instead of an 8x-undersharded headline."""
     from tpu_aerial_transport.resilience import backend as backend_mod
 
     errors = []
     for attempt in range(PROBE_ATTEMPTS):
-        ok, detail = backend_mod.probe_subprocess(timeout_s=PROBE_TIMEOUT_S)
+        info: dict = {}
+        ok, detail = backend_mod.probe_subprocess(
+            timeout_s=PROBE_TIMEOUT_S, info=info,
+        )
         if ok:
+            # SUCCESSFUL probes only: after a failed probe (e.g. a
+            # topology_mismatch routing the round to XLA-CPU) the
+            # accelerator's reported topology must NOT be stamped onto
+            # the cpu-tagged cells — _annotate_topology then falls back
+            # to the live in-process counts, which ARE the fallback
+            # backend's topology.
+            if info:
+                _PROBE_INFO.update(info)
             return True, detail
         errors.append(f"attempt {attempt + 1}: {detail}")
     return False, " ;; ".join(errors)
@@ -1081,9 +1104,99 @@ def _serving_cell(families=("cadmm4",), n_requests: int = 64,
     }
 
 
+# Pods-tier weak-scaling cells (tools/pods_local.py localhost harness):
+# fixed per-process work (PODS_SCENARIOS_PER_PROC scenarios x 8 agents),
+# 1 process vs 2 — the 2-process arm IS the 1024-agent BASELINE config
+# (128 payloads x 8 quads) run end-to-end through the pods tier.
+PODS_TIMEOUT_S = 1500.0
+PODS_SCENARIOS_PER_PROC = 64
+PODS_STEPS = 4
+PODS_MAX_ITER = 6
+PODS_LOCAL_DEVICES = 4
+
+
+def _pods_cell(processes: int, scenarios: int, n: int = 8,
+               steps: int = PODS_STEPS, max_iter: int = PODS_MAX_ITER,
+               local_devices: int = PODS_LOCAL_DEVICES) -> dict:
+    """One pods weak-scaling cell: run the multi-process localhost
+    harness (coordinator + N group-killable workers, CPU backend,
+    TAT_VIRTUAL_DEVICES virtual devices each) under a deadline and parse
+    its one-line JSON. The harness's own topology gate
+    (``pods.check_topology``) raises a classified ``topology_mismatch``
+    inside the workers; a 1-core host returns a written ``skipped``
+    reason instead of flaking. Workers watch their parent pid, so a
+    deadline group-kill here cannot orphan the gloo rendezvous."""
+    from tpu_aerial_transport.resilience import backend as backend_mod
+
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "pods_local.py")
+    cmd = [sys.executable, tool, "--mode", "bench",
+           "--processes", str(processes),
+           "--local-devices", str(local_devices),
+           "--n", str(n), "--scenarios", str(scenarios),
+           "--steps", str(steps), "--max-iter", str(max_iter),
+           "--timeout", str(PODS_TIMEOUT_S - 120)]
+    proc = backend_mod.run_group(cmd, PODS_TIMEOUT_S)
+    row = None
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            row = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if not isinstance(row, dict):
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+        raise RuntimeError(
+            f"pods harness rc={proc.returncode}: " + " | ".join(tail)
+        )
+    if "error" in row:
+        # Surfaces the workers' classified failure (topology_mismatch,
+        # wedge...) to the guard's classifier.
+        raise RuntimeError(f"pods harness failed: {row['error']}"[:400])
+    return row
+
+
 SWEEP_PARTIAL_PATH = "BENCH_SWEEP_PARTIAL.json"
 SWEEP_JOURNAL_PATH = "BENCH_SWEEP_JOURNAL.jsonl"
 SWEEP_METRICS_PATH = "artifacts/bench_sweep.metrics.jsonl"
+
+
+def _annotate_topology(value):
+    """Additive topology fields on every sweep cell (plain v2 bench_cell
+    fields, no schema bump): ``n_devices`` / ``n_processes`` from the
+    subprocess probe's report (falling back to the live counts — by
+    record time the cell already initialized the backend), plus a
+    ``mesh`` shape where the cell implies one (the sharded A/B cells'
+    agent mesh; pods cells carry their own). A chip-round record can
+    never again be ambiguous about what topology measured it
+    (MULTICHIP_r01's 1-of-8-devices round was exactly that ambiguity).
+
+    Cells the guard DEGRADED to the CPU rung get the CPU fallback's own
+    topology, not the probed accelerator's — stamping the chip's mesh on
+    a cpu-tagged cell would be the ambiguity this field exists to kill.
+    Error cells measured nothing and are left unstamped."""
+    if not isinstance(value, dict) or "error" in value:
+        return value
+    from tpu_aerial_transport.resilience import backend as backend_mod
+
+    fell_back = (value.get("rung") == backend_mod.RUNG_CPU
+                 and _PROBE_INFO.get("platform") not in (None, "cpu"))
+    if fell_back:
+        value.setdefault("n_devices", len(jax.devices("cpu")))
+        value.setdefault("n_processes", jax.process_count())
+    else:
+        value.setdefault(
+            "n_devices",
+            _PROBE_INFO.get("n_devices", len(jax.devices())),
+        )
+        value.setdefault(
+            "n_processes",
+            _PROBE_INFO.get("n_processes", jax.process_count()),
+        )
+    if "mesh" not in value:
+        value["mesh"] = ({"agent": value["devices"]}
+                         if "devices" in value else None)
+    return value
 
 
 def _git_head() -> str:
@@ -1146,6 +1259,10 @@ def sweep(resume: bool = False, platform: str | None = None):
         # first call is a cache-load, not a compile — rows are only
         # comparable across rounds under the same cache state.
         "xla_cache_dir": jax.config.jax_compilation_cache_dir or None,
+        # What the subprocess probe saw (platform/devices/processes) —
+        # the round-level topology record (per-cell fields ride on each
+        # value via _annotate_topology).
+        **({"topology": dict(_PROBE_INFO)} if _PROBE_INFO else {}),
     }}
     if os.path.exists(SWEEP_PARTIAL_PATH) and not resume:
         raise SystemExit(
@@ -1201,11 +1318,23 @@ def sweep(resume: bool = False, platform: str | None = None):
     for key, value in legacy_cells.items():
         journal.append({"event": "cell", "cell": key, "value": value})
 
+    # Test/debug hook: TAT_SWEEP_CELLS=<regex> restricts which cells run
+    # (the fault-injection end-to-end test sweeps a cheap subset; a human
+    # debugging one cell re-measures just it). Parsed BEFORE the metrics
+    # writer: a cell-filtered run must APPEND to the tracked flight
+    # recorder, not reset it (see below).
+    cells_spec = os.environ.get("TAT_SWEEP_CELLS", "")
+    cells_pat = re.compile(cells_spec) if cells_spec else None
+
     # Flight-recorder export (obs.export): one bench_cell event per
-    # measured config, appended across --resume attempts; a fresh sweep
-    # resets the file with the journal. tools/run_health.py renders it,
-    # tools/ci_check.sh schema-validates it.
-    if not resume and os.path.exists(SWEEP_METRICS_PATH):
+    # measured config, appended across --resume attempts; a fresh FULL
+    # sweep resets the file with the journal. A CELL-FILTERED run
+    # appends instead — resetting would replace the whole tracked trail
+    # with the filtered subset (the same footgun the BENCH_SWEEP.json
+    # carried_cells provenance exists for). tools/run_health.py renders
+    # it, tools/ci_check.sh schema-validates it.
+    if not resume and cells_pat is None \
+            and os.path.exists(SWEEP_METRICS_PATH):
         os.remove(SWEEP_METRICS_PATH)
     metrics = export_mod.MetricsWriter(
         SWEEP_METRICS_PATH,
@@ -1215,6 +1344,7 @@ def sweep(resume: bool = False, platform: str | None = None):
     )
 
     def record(key, value):
+        value = _annotate_topology(value)
         results[key] = value
         journal.append({"event": "cell", "cell": key, "value": value})
         metrics.emit("bench_cell", cell=key, value=value)
@@ -1238,12 +1368,6 @@ def sweep(resume: bool = False, platform: str | None = None):
                       backend_mod.RUNG_CPU if platform == "cpu"
                       else backend_mod.RUNG_ONCHIP),
     )
-
-    # Test/debug hook: TAT_SWEEP_CELLS=<regex> restricts which cells run
-    # (the fault-injection end-to-end test sweeps a cheap subset; a human
-    # debugging one cell re-measures just it).
-    cells_spec = os.environ.get("TAT_SWEEP_CELLS", "")
-    cells_pat = re.compile(cells_spec) if cells_spec else None
 
     def want(key: str) -> bool:
         return cells_pat is None or bool(cells_pat.search(key))
@@ -1383,6 +1507,57 @@ def sweep(resume: bool = False, platform: str | None = None):
             "bundled_compiles":
                 have["bundled"]["backend_compiles"],
             "cold_compiles": have["cold"]["backend_compiles"],
+        })
+
+    # Pods-tier weak-scaling cells (tpu_aerial_transport/parallel/pods.py
+    # via the tools/pods_local.py localhost harness): fixed per-process
+    # work, 1 vs 2 processes — the 2-process arm runs the 1024-agent
+    # BASELINE config (128 payloads x 8 quads) END-TO-END through the
+    # multi-process 2-D mesh tier on this host (CPU backend + gloo), so
+    # the chip round only has to swap the backend. Fresh subprocess
+    # fleets, group-killable, own deadlines (the guard's would
+    # misclassify a healthy multi-process compile as a wedge); a 1-core
+    # host records the harness's written skip reason as the cell value.
+    for key, procs, nsc in (
+        ("pods_weakscale_1proc", 1, PODS_SCENARIOS_PER_PROC),
+        ("pods_swarm_128x8_2proc", 2, 2 * PODS_SCENARIOS_PER_PROC),
+    ):
+        if not want(key) or (key in results
+                             and "error" not in results[key]):
+            continue
+        try:
+            value, ran_at = guard.run(
+                key, lambda p=procs, s=nsc: _pods_cell(p, s),
+                deadline_s=PODS_TIMEOUT_S + 60.0,
+            )
+            record(key, {**value, "rung": ran_at})
+        except Exception as e:
+            record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+    ws = {k: results.get(k) for k in
+          ("pods_weakscale_1proc", "pods_swarm_128x8_2proc")}
+    if (want("pods_weakscale") and "pods_weakscale" not in results
+            and all(v and "scenario_mpc_steps_per_sec" in v
+                    for v in ws.values())):
+        r1 = ws["pods_weakscale_1proc"]["scenario_mpc_steps_per_sec"]
+        w2 = ws["pods_swarm_128x8_2proc"]
+        r2 = w2["scenario_mpc_steps_per_sec"]
+        record("pods_weakscale", {
+            # Topology of the SCALED-TO arm (the derived cell pairs two
+            # topologies; _annotate_topology would otherwise stamp this
+            # process's own 1-device view, which is neither).
+            "n_processes": w2.get("n_processes"),
+            "n_devices": w2.get("n_devices"),
+            "mesh": w2.get("mesh"),
+            # Weak scaling at fixed per-process work: ideal 2-process
+            # rate is 2x the 1-process rate; the shortfall is the pods
+            # overhead (cross-process exchange + rendezvous + host
+            # contention on this box — the chip round re-reads this cell
+            # on real DCN).
+            "scenarios_per_process": PODS_SCENARIOS_PER_PROC,
+            "rate_1proc": r1,
+            "rate_2proc": r2,
+            "scaling_efficiency": r2 / (2.0 * r1),
+            "overhead_fraction": 1.0 - r2 / (2.0 * r1),
         })
 
     # Scenario-serving tier cells (tpu_aerial_transport/serving/): the
@@ -1563,10 +1738,18 @@ def sweep(resume: bool = False, platform: str | None = None):
     for key in [k for k in results
                 if "batch" in k or "swarm" in k or "fused" in k
                 or "innertol" in k or "sharded" in k or "donate" in k
-                or "coldstart" in k or "serving" in k]:
+                or "coldstart" in k or "serving" in k or "pods" in k]:
         r = results[key]
         if "error" in r:
             print(f"| {key} | ERROR: {r['error']} | — | — |")
+            continue
+        if "skipped" in r:
+            print(f"| {key} | SKIPPED: {r['skipped']} | — | — |")
+            continue
+        if "scaling_efficiency" in r:  # derived pods weak-scaling cell.
+            print(f"| {key} | {r['scaling_efficiency']:.2f} efficiency at "
+                  f"{r['scenarios_per_process']} scenarios/process "
+                  f"(overhead {r['overhead_fraction']:.0%}) | — | — |")
             continue
         if "ttfs_s" in r:  # cold-start ladder cell (aot/).
             print(f"| {key} | TTFS {r['ttfs_s']:.2f} s "

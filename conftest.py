@@ -9,11 +9,14 @@ import os
 # JAX_PLATFORMS=axon): the test suite needs 8 virtual devices for the collective
 # code paths, and the driver benchmarks on real TPU separately via bench.py.
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+
+# Virtual-device request through the ONE shared knob (utils/platform.py):
+# TAT_VIRTUAL_DEVICES overrides the 8-device default; an ambient XLA_FLAGS
+# pin wins over both (tests/conftest.py then skips mesh tests with an
+# actionable message). platform.py imports no jax — safe pre-init.
+from tpu_aerial_transport.utils.platform import apply_virtual_devices  # noqa: E402
+
+apply_virtual_devices(default=8)
 
 # The axon site hook (PYTHONPATH=/root/.axon_site) rewrites jax_platforms to
 # "axon,cpu" at import, overriding the env var — override it back at the config

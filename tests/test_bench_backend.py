@@ -56,6 +56,82 @@ def test_explicit_cpu_request_is_not_a_fallback(monkeypatch):
     assert note is None  # an explicit CPU run is not tagged as degraded.
 
 
+def test_sweep_cells_record_topology(monkeypatch):
+    """Every sweep cell value additionally records n_processes /
+    n_devices / mesh (plain additive fields, no schema bump) — a
+    chip-round record can never be ambiguous about what topology
+    measured it (the MULTICHIP_r01 ambiguity)."""
+    monkeypatch.setitem(bench._PROBE_INFO, "n_devices", 8)
+    monkeypatch.setitem(bench._PROBE_INFO, "n_processes", 2)
+    v = bench._annotate_topology({"mpc_steps_per_sec": 1.0})
+    assert v["n_devices"] == 8 and v["n_processes"] == 2
+    assert v["mesh"] is None
+    # Sharded A/B cells imply an agent mesh from their devices field.
+    v = bench._annotate_topology({"mpc_steps_per_sec": 1.0, "devices": 4})
+    assert v["mesh"] == {"agent": 4}
+    # Pods cells carry their own mesh — never overwritten.
+    v = bench._annotate_topology({
+        "mesh": {"scenario": 2, "agent": 4},
+        "n_processes": 2, "n_devices": 8,
+    })
+    assert v["mesh"] == {"scenario": 2, "agent": 4}
+    assert v["n_processes"] == 2
+    # Non-dict values (nothing today) pass through untouched.
+    assert bench._annotate_topology(None) is None
+    # Error cells measured nothing: left unstamped.
+    assert bench._annotate_topology({"error": "boom"}) == {"error": "boom"}
+
+
+def test_guard_degraded_cells_get_cpu_topology(monkeypatch):
+    """Probe green on the chip, but the guard degraded THIS cell to the
+    CPU rung: it must record the CPU fallback's topology, not the probed
+    accelerator mesh (stamping the chip's shape on a cpu-tagged cell is
+    the ambiguity the field exists to kill)."""
+    import jax
+
+    monkeypatch.setitem(bench._PROBE_INFO, "platform", "tpu")
+    monkeypatch.setitem(bench._PROBE_INFO, "n_devices", 999)
+    monkeypatch.setitem(bench._PROBE_INFO, "n_processes", 1)
+    v = bench._annotate_topology({"x": 1.0, "rung": "cpu-tagged"})
+    assert v["n_devices"] == len(jax.devices("cpu"))  # not 999.
+    # A healthy on-chip cell keeps the probed topology.
+    v = bench._annotate_topology({"x": 1.0, "rung": "on-chip"})
+    assert v["n_devices"] == 999
+
+
+def test_run_health_topology_section():
+    """tools/run_health.py renders the topology trail: per-cell shapes,
+    pods-cell rung table, topology_mismatch events."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "run_health",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "run_health.py"),
+    )
+    rh = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rh)
+    events = [
+        {"event": "bench_cell", "cell": "pods_swarm_128x8_2proc",
+         "value": {"scenario_mpc_steps_per_sec": 10.0, "rung": "cpu-tagged",
+                   "n_processes": 2, "n_devices": 8,
+                   "mesh": {"scenario": 2, "agent": 4}}},
+        {"event": "bench_cell", "cell": "cadmm_n64_single",
+         "value": {"mpc_steps_per_sec": 90.0, "n_processes": 1,
+                   "n_devices": 8, "mesh": None}},
+        {"event": "backend_event", "kind": "topology_mismatch",
+         "label": "probe", "rung": "unresolved",
+         "detail": "visible 1 of 8 devices"},
+    ]
+    summary = rh.summarize(events)
+    topo = summary["topology"]
+    assert topo["shapes"] == {"2proc x 8dev": 1, "1proc x 8dev": 1}
+    assert topo["pods_cells"][0]["cell"] == "pods_swarm_128x8_2proc"
+    assert topo["pods_cells"][0]["rung"] == "cpu-tagged"
+    assert topo["mismatch_events"][0]["detail"] == "visible 1 of 8 devices"
+    rh.render(summary)  # the table renders without crashing.
+
+
 def test_resolve_fused_env_gate(monkeypatch):
     """TPU_AERIAL_FUSED overrides the non-CPU 'auto' default; CPU always
     resolves to scan; junk values raise."""

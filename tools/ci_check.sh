@@ -27,14 +27,29 @@ python tools/jaxlint.py "${PATHS[@]}" || fail=1
 echo "== jaxlint --contracts --target tpu (ring consensus entrypoints) =="
 # TC106 off-chip TPU lowering gate + Tier-B trace contracts over the
 # ring-exchange entrypoints (PR 7). The ring entries need a >=4-device
-# mesh, so force a 4-virtual-device CPU host — the gate is designed to
-# run off-chip (JAX_PLATFORMS=cpu even on a TPU box). The full registry
-# runs under `tools/jaxlint.py --contracts` / -m slow.
+# mesh, so force a virtual-device CPU host through the ONE shared knob
+# (utils/platform.py TAT_VIRTUAL_DEVICES; default 4 here) — the gate is
+# designed to run off-chip (JAX_PLATFORMS=cpu even on a TPU box). The
+# full registry runs under `tools/jaxlint.py --contracts` / -m slow.
 JAX_PLATFORMS=cpu \
-XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${TAT_VIRTUAL_DEVICES:-4}" \
 python tools/jaxlint.py --contracts --target tpu \
     --only parallel.ring:consensus_exchange,parallel.ring:consensus_exchange_pallas,parallel.mesh:cadmm_control_sharded_ring \
     tpu_aerial_transport/parallel/ring.py || fail=1
+
+echo "== pods 2-process parity smoke (tools/pods_local.py) =="
+# Bounded multi-process smoke of the pods tier (parallel/pods.py): 2
+# REAL processes x 2 virtual CPU devices each, gloo cross-process
+# collectives, compared by the harness against the single-process run
+# of the SAME 2x2 mesh (--check-parity; f32-rounding bar). Workers are
+# group-killable under the harness deadline and watch their parent pid
+# (no orphaned gloo rendezvous); a 1-core host skips with a written
+# reason (the harness prints it and exits 0). The heavier masked /
+# 2x4-acceptance / 1024-agent e2es live in tests/test_pods.py (-m slow).
+python tools/pods_local.py --mode parity --check-parity \
+    --processes 2 --local-devices 2 --n 4 --scenarios 4 --steps 1 \
+    --max-iter 2 --no-masked --out-dir artifacts/pods-smoke \
+    --timeout 420 || fail=1
 
 echo "== aot bundle coverage (tools/aot_bundle.py check) =="
 # Registry/bundle drift gate (PR 8): the in-tree manifest-only coverage
@@ -44,6 +59,9 @@ echo "== aot bundle coverage (tools/aot_bundle.py check) =="
 # under the same forced 8-virtual-device CPU env used here: sharded
 # entries' arg shapes depend on the device count). Signatures come from
 # make_args avals only — the gate never lowers or compiles anything.
+# Deliberately PINNED at 8 (not TAT_VIRTUAL_DEVICES): the tracked
+# coverage record was built at 8 and an env override must not make the
+# diff lie.
 if [ -f artifacts/aot/coverage-cpu/manifest.json ]; then
     JAX_PLATFORMS=cpu \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \

@@ -193,6 +193,44 @@ def summarize(events: list[dict]) -> dict:
             "batches": batches,
         }
 
+    # Topology (pods tier): per-cell process/device counts + mesh shapes
+    # (plain additive bench_cell value fields, _annotate_topology),
+    # classified topology_mismatch events, and the pods cells' rungs —
+    # the MULTICHIP_r0x trail as tables instead of raw JSON tails.
+    # Dedup by cell, LAST event wins (the metrics file appends across
+    # --resume / cell-filtered re-runs — same rule as bench_cells above;
+    # counting per event would double-count re-measured cells).
+    topo_by_cell: dict[str, dict] = {}
+    for e in cells:
+        v = e.get("value")
+        if isinstance(v, dict) and ("n_devices" in v or "mesh" in v):
+            topo_by_cell[e["cell"]] = {
+                "cell": e["cell"],
+                "n_processes": v.get("n_processes"),
+                "n_devices": v.get("n_devices"),
+                "mesh": v.get("mesh"),
+                "rung": v.get("rung"),
+                "skipped": v.get("skipped"),
+            }
+    topo_rows = list(topo_by_cell.values())
+    mismatches = [
+        {k: e.get(k) for k in ("label", "rung", "detail") if k in e}
+        for e in events
+        if e.get("event") == "backend_event"
+        and e.get("kind") == "topology_mismatch"
+    ]
+    if topo_rows or mismatches:
+        shapes: dict[str, int] = {}
+        for r in topo_rows:
+            key = f"{r['n_processes']}proc x {r['n_devices']}dev"
+            shapes[key] = shapes.get(key, 0) + 1
+        out["topology"] = {
+            "shapes": shapes,
+            "mismatch_events": mismatches,
+            "pods_cells": [r for r in topo_rows
+                           if r["cell"].startswith("pods")],
+        }
+
     # Backend guard (schema v2): error/circuit events from
     # resilience.backend.BackendGuard, plus the rung each cell/chunk
     # ACTUALLY ran at (bench cells carry it in their value dict, chunk
@@ -375,6 +413,32 @@ def render(summary: dict) -> None:
                 print(f"| {bid} | {b['family']} | "
                       f"{b['bucket'] if b['bucket'] is not None else '—'} "
                       f"| {rungs} |")
+
+    tp = summary.get("topology")
+    if tp:
+        print("\n## topology (pods tier / parallel.pods)")
+        print("- cell topologies: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(tp["shapes"].items())
+        ))
+        if tp["mismatch_events"]:
+            print("- topology_mismatch events:")
+            for m in tp["mismatch_events"]:
+                print(f"  - {m.get('label')}: "
+                      f"{(m.get('detail') or '')[:140]}")
+        if tp["pods_cells"]:
+            print("\n| pods cell | mesh | procs | devices | rung |")
+            print("|---|---|---|---|---|")
+            for r in tp["pods_cells"]:
+                mesh = r["mesh"]
+                mesh_s = ("x".join(str(v) for v in mesh.values())
+                          if isinstance(mesh, dict) else "—")
+                rung = r.get("rung") or (
+                    f"skipped: {r['skipped']}" if r.get("skipped") else "—"
+                )
+                print(f"| {r['cell']} | {mesh_s} | "
+                      f"{r['n_processes'] if r['n_processes'] is not None else '—'} | "
+                      f"{r['n_devices'] if r['n_devices'] is not None else '—'} | "
+                      f"{rung} |")
 
     be = summary.get("backend")
     if be:

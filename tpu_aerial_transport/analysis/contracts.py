@@ -675,6 +675,38 @@ def _build_ring_exchange_pallas():
     return shmap(fn, 1), make_args
 
 
+@_register("parallel.pods:pods_control_step", min_devices=8)
+def _build_pods_step():
+    """The 2-D (scenario, agent) pods-mesh C-ADMM step on the 2x4 virtual
+    mesh (single-process here; the process boundary is exercised by
+    tools/pods_local.py — the PROGRAM is identical, shard_map over the
+    same mesh axes). pad_operators pinned True so TC104 checks the
+    tile-target layout like the 1-D sharded twins."""
+    from tpu_aerial_transport.control import cadmm, centralized
+    from tpu_aerial_transport.parallel import pods
+
+    params, col, state = _rqp_bits(4)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=2, inner_iters=4, pad_operators=True,
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    mesh = pods.make_pods_mesh(pods.resolve_pods_spec(4, "2x4"))
+    step = pods.pods_control_step(params, cfg, f_eq, mesh, None, "cadmm")
+
+    def make_args():
+        b = 4
+        cs0 = cadmm.init_cadmm_state(params, cfg)
+        css = jax.vmap(lambda _: cs0)(jnp.arange(b))
+        states = jax.tree.map(
+            lambda x: jnp.tile(x[None], (b,) + (1,) * x.ndim),
+            _rqp_bits(4)[2],
+        )
+        return (css, states, _acc())
+
+    return step, make_args
+
+
 @_register("parallel.mesh:scenario_rollout", min_devices=2)
 def _build_mesh_scenarios():
     from tpu_aerial_transport.harness import rollout as h_rollout

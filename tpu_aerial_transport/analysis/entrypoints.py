@@ -81,6 +81,9 @@ TRACED_FUNCTIONS: dict[str, tuple[str, ...]] = {
         "_rotate_allreduce", "_ring_gather", "_pallas_ring_allreduce",
         "_ring_sum_kernel",
     ),
+    "tpu_aerial_transport/parallel/pods.py": (
+        "pods_control_step", "_physics_substeps",
+    ),
 }
 
 # name -> short description; analysis.contracts.REGISTRY must carry
@@ -134,6 +137,12 @@ CONTRACT_ENTRYPOINTS: dict[str, str] = {
     "serving.batcher:serving_chunk_centralized":
         "serving chunk for the canonical centralized family (the mixed-"
         "stream twin of serving_chunk)",
+    "parallel.pods:pods_control_step":
+        "2-D (scenario, agent) pods-mesh C-ADMM control step: scenarios "
+        "vmapped per shard, consensus over the agent axis, batch stats "
+        "over the scenario axis — the multi-process scale-out tier "
+        "(parallel/pods.py; exercised single-process on the 2x4 virtual "
+        "mesh, multi-process by tools/pods_local.py)",
 }
 
 # Public functions containing lax.scan / lax.while_loop / lax.fori_loop
@@ -159,6 +168,10 @@ HOT_NON_ENTRYPOINTS: dict[str, str] = {
         "trajectory-optimization research harness, offline tooling",
     "tpu_aerial_transport/harness/diff.py:tune_gains":
         "host-side Adam loop around a jitted loss, not itself traced",
+    "tpu_aerial_transport/parallel/pods.py:make_pods_workload":
+        "benchmark-workload factory over pods_control_step (the scan is "
+        "the step rollout driver for tools/pods_local.py / bench pods_* "
+        "cells); the 2-D sharded step inside carries the contract",
 }
 
 # Tier-B tile waivers: entrypoint name -> reason TC104 (sublane alignment

@@ -348,6 +348,111 @@ def load_snapshot(
     return state, manifest
 
 
+# ----------------------------------------------------------------------
+# Multi-process (pods) shard snapshots: per-process shard files + ONE
+# global manifest (parallel/pods.py drives this tier).
+# ----------------------------------------------------------------------
+
+def shard_prefix(prefix: str, process_id: int, n_processes: int) -> str:
+    """Snapshot prefix for one process's shard of a sharded carry —
+    ``carry.p0of2`` — inside the normal prefix grammar, so retention,
+    :func:`list_snapshots` and recovery see shard snapshots like any
+    other snapshot family. Each process writes ONLY its own prefix (no
+    cross-process file races); the shard manifest below ties the set
+    together."""
+    if not 0 <= process_id < n_processes:
+        raise ValueError(f"process_id {process_id} not in [0, {n_processes})")
+    return f"{prefix}.p{process_id}of{n_processes}"
+
+
+def shard_manifest_path(directory: str, prefix: str = "snap") -> str:
+    return os.path.join(directory, f"{prefix}.shards.json")
+
+
+def save_shard_manifest(
+    directory: str,
+    *,
+    prefix: str = "snap",
+    n_processes: int,
+    topology: dict | None = None,
+    config_hash: str | None = None,
+) -> str:
+    """Atomically publish the GLOBAL manifest for a sharded snapshot
+    family: how many per-process shard prefixes make a complete boundary,
+    plus the topology the carry was sharded under and the run's config
+    hash. Written by process 0 ONCE per run (the topology is static); a
+    resume on a rebuilt mesh validates against it BEFORE trusting any
+    shard (:func:`load_shard_manifest`) — the config-hash refusal covers
+    topology drift because the pods runner folds the topology into the
+    hash."""
+    path = shard_manifest_path(directory, prefix)
+    os.makedirs(directory, exist_ok=True)
+    payload = {
+        "schema": SCHEMA_VERSION,
+        "prefix": prefix,
+        "n_processes": int(n_processes),
+        "shard_prefixes": [
+            shard_prefix(prefix, p, n_processes) for p in range(n_processes)
+        ],
+        "topology": topology or {},
+        "config_hash": config_hash,
+    }
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=1)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load_shard_manifest(
+    directory: str,
+    *,
+    prefix: str = "snap",
+    n_processes: int | None = None,
+    config_hash: str | None = None,
+) -> dict:
+    """Read + validate the shard manifest. Raises :class:`SnapshotError`:
+    ``unreadable`` when missing/corrupt, ``schema`` for a newer writer,
+    ``config_mismatch`` when the rebuilt mesh's process count or the
+    config hash disagrees with what the shards were written under —
+    re-placing 2-process shards on a 4-process mesh would silently load
+    half a carry per process."""
+    path = shard_manifest_path(directory, prefix)
+    try:
+        with open(path) as fh:
+            manifest = json.load(fh)
+    except Exception as e:
+        raise SnapshotError(
+            "unreadable", path, f"{type(e).__name__}: {e}"
+        ) from e
+    if manifest.get("schema", -1) > SCHEMA_VERSION:
+        raise SnapshotError(
+            "schema", path,
+            f"written by schema {manifest.get('schema')} > supported "
+            f"{SCHEMA_VERSION}",
+        )
+    if (n_processes is not None
+            and manifest.get("n_processes") != n_processes):
+        raise SnapshotError(
+            "config_mismatch", path,
+            f"shards written by {manifest.get('n_processes')} processes, "
+            f"resuming with {n_processes}: re-placing would split the "
+            "carry wrong (rebuild the mesh with the journaled topology "
+            "or restart fresh)",
+        )
+    if (config_hash is not None
+            and manifest.get("config_hash") is not None
+            and manifest["config_hash"] != config_hash):
+        raise SnapshotError(
+            "config_mismatch", path,
+            f"shard manifest config {manifest['config_hash']} != current "
+            f"{config_hash}: resuming would mix configurations/topologies",
+        )
+    return manifest
+
+
 def load_latest_valid(
     directory: str,
     template,

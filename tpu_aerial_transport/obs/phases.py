@@ -35,11 +35,14 @@ SHARDED_STEP = "sharded_step"  # shard_map plumbing outside finer scopes.
 SERVING_CHUNK = "serving_chunk"  # vmap plumbing of the serving tier's
 #                                  batched chunk (serving/batcher.py);
 #                                  finer controller scopes inside win.
+PODS_STEP = "pods_step"        # 2-D (scenario, agent) pods-mesh shard_map
+#                                plumbing (parallel/pods.py); the
+#                                controllers' fine scopes inside win.
 
 PHASES = (
     QP_BUILD, CBF_ROWS, LOCAL_SOLVE, CONSENSUS, CONSENSUS_EXCHANGE,
     DUAL_UPDATE, DYNAMICS, PAD, FAULTS, FALLBACK, TELEMETRY, SHARDED_STEP,
-    SERVING_CHUNK,
+    SERVING_CHUNK, PODS_STEP,
 )
 
 

@@ -15,8 +15,13 @@ from tpu_aerial_transport.parallel import ring  # noqa: F401
 
 
 def __getattr__(name):
-    if name == "mesh":
+    if name in ("mesh", "pods"):
+        # pods imports mesh lazily inside its functions, but resolving
+        # both names here keeps `parallel.pods` attribute access working
+        # under the same no-cycle rule as `parallel.mesh`.
         import importlib
 
-        return importlib.import_module("tpu_aerial_transport.parallel.mesh")
+        return importlib.import_module(
+            f"tpu_aerial_transport.parallel.{name}"
+        )
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -172,9 +172,31 @@ def _warm_structure():
     return SOCPSolution(x=0, y=0, z=0, prim_res=0, dual_res=0)
 
 
+def is_multiprocess_mesh(mesh: Mesh) -> bool:
+    """True when ``mesh`` spans devices of OTHER processes (the pods
+    tier): plain ``jax.device_put`` cannot address them, so placement
+    must assemble a global ``jax.Array`` from per-process host data
+    (``parallel.pods.place_global_batch``)."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
 def shard_scenarios(mesh: Mesh, batch, axis: str = "scenario"):
     """Place a leading-axis Monte-Carlo batch pytree onto the mesh, sharded over
-    ``axis`` (payloads/scenarios are independent — pure data parallelism)."""
+    ``axis`` (payloads/scenarios are independent — pure data parallelism).
+
+    Works on the single-process meshes unchanged (``device_put`` with a
+    ``NamedSharding``; a 2-D mesh replicates over the axes ``axis`` does
+    not name). On a MULTI-process (pods) mesh the same call still works
+    from host-global data: every process passes the full host batch and
+    contributes the rows its devices own (``jax.make_array_from_callback``
+    under the hood — parallel/pods.py), which is exactly the serving
+    tier's ``mesh=`` contract (the server's carry_host is host-global on
+    every process)."""
+    if is_multiprocess_mesh(mesh):
+        from tpu_aerial_transport.parallel import pods
+
+        return pods.place_global_batch(mesh, batch, axis=axis)
     sharding = NamedSharding(mesh, P(axis))
     return jax.tree.map(
         lambda x: jax.device_put(x, sharding) if hasattr(x, "ndim") and x.ndim

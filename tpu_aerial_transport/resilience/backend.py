@@ -64,6 +64,12 @@ import types
 
 ERROR_KINDS = (
     "init_unavailable",   # backend setup/connect failed (r02's real cause)
+    "topology_mismatch",  # backend answered but with the WRONG shape: fewer
+                          # visible devices/processes than the expected
+                          # topology (MULTICHIP_r01: 1 of 8 devices visible
+                          # while the single-device probe passed) — running
+                          # on it would silently undershard, so it is an
+                          # infra failure, not a measurement
     "wedge_timeout",      # accepted work, never answered (rounds 3-5)
     "compile_error",      # XLA/Mosaic rejected the program
     "dtype_lowering",     # f64/convert_element_type-class lowering bug
@@ -85,6 +91,12 @@ ERROR_KINDS = (
 _CLASSIFIERS: tuple[tuple[str, re.Pattern], ...] = (
     ("bundle_stale", re.compile(
         r"(?i)bundle[_ ]stale|stale bundle|bundle.*fingerprint")),
+    # Before init_unavailable: a topology report names its counts
+    # explicitly and must not be swallowed by the looser init patterns
+    # ("no accelerator" etc.) below.
+    ("topology_mismatch", re.compile(
+        r"(?i)topology[_ ]mismatch|"
+        r"visible \d+ of \d+ devices|\d+ of \d+ devices visible")),
     ("init_unavailable", re.compile(
         r"(?i)unable to initialize backend|backend setup|"
         r"failed to connect|\bUNAVAILABLE\b|no accelerator|"
@@ -311,6 +323,13 @@ def call_with_deadline(fn, timeout_s: float | None, label: str = ""):
 # `jax.devices()` alone while the first dispatched op (a convert) raised
 # the lazy backend-init UNAVAILABLE. A probe "pass" must mean the first
 # REAL dispatch succeeds.
+# The BACKEND_OK token line is a positional contract shared by every
+# probe body and the parser in probe_subprocess:
+#   BACKEND_OK <platform> <n_devices> <n_processes> <checksum> [notes...]
+# n_devices/n_processes close the MULTICHIP_r01 gap: the single-device
+# probe PASSED while only 1 of 8 devices was visible — the probe now
+# reports the topology it actually saw so the caller can refuse to
+# measure an undersharded mesh (see expect_devices/expect_processes).
 PROBE_CODE = (
     "import os, jax\n"
     "envp = os.environ.get('JAX_PLATFORMS')\n"
@@ -321,11 +340,19 @@ PROBE_CODE = (
     "x = jnp.ones((128, 128), jnp.float32)\n"
     "y = lax.convert_element_type(x @ x, jnp.bfloat16)\n"
     "s = float(lax.convert_element_type(y, jnp.float32).sum())\n"
-    "print('BACKEND_OK', d[0].platform, len(d), s)\n"
+    "print('BACKEND_OK', d[0].platform, len(d), jax.process_count(), s)\n"
 )
 
 FAULTS_ENV = "TAT_BACKEND_FAULTS"
 DEADLINE_ENV = "TAT_BACKEND_DEADLINE_S"
+# Expected topology (ints): when set, probe_subprocess compares the
+# visible device/process counts against them and a shortfall FAILS the
+# probe with a classified topology_mismatch — the r01 failure mode
+# (1 of 8 devices visible, probe green) becomes a structured refusal
+# instead of an 8x-undersharded measurement. A multi-chip driver sets
+# these alongside JAX_PLATFORMS.
+EXPECTED_DEVICES_ENV = "TAT_EXPECTED_DEVICES"
+EXPECTED_PROCESSES_ENV = "TAT_EXPECTED_PROCESSES"
 # AOT bundle the probe prefers: the probe computation loads from the
 # bundle's precompiled artifact instead of compiling, so a cold-init
 # probe cannot burn its deadline in XLA (tpu_aerial_transport/aot/).
@@ -361,7 +388,8 @@ def _bundle_probe_code(bundle_dir: str) -> str:
         "    x = jnp.ones((128, 128), jnp.float32)\n"
         "    y = lax.convert_element_type(x @ x, jnp.bfloat16)\n"
         "    s = float(lax.convert_element_type(y, jnp.float32).sum())\n"
-        "print('BACKEND_OK', d[0].platform, len(d), s, note)\n"
+        "print('BACKEND_OK', d[0].platform, len(d), jax.process_count(), "
+        "s, note)\n"
     )
 
 
@@ -396,10 +424,31 @@ def run_group(cmd: list[str], timeout_s: float,
     )
 
 
+def _expected_topology(env: dict | None) -> tuple[int | None, int | None]:
+    """(expected_devices, expected_processes) from the env knobs; None
+    means "no expectation". Garbage values raise — a typo silently
+    disabling the topology gate would fake a green probe."""
+    src = env or os.environ
+    out = []
+    for key in (EXPECTED_DEVICES_ENV, EXPECTED_PROCESSES_ENV):
+        raw = src.get(key, "")
+        if not raw:
+            out.append(None)
+            continue
+        try:
+            out.append(int(raw))
+        except ValueError:
+            raise ValueError(f"{key}={raw!r} is not an integer") from None
+    return out[0], out[1]
+
+
 def probe_subprocess(timeout_s: float = 60.0,
                      env: dict | None = None,
                      bundle_dir: str | None = None,
-                     notes: list | None = None) -> tuple[bool, str]:
+                     notes: list | None = None,
+                     expect_devices: int | None = None,
+                     expect_processes: int | None = None,
+                     info: dict | None = None) -> tuple[bool, str]:
     """Watchdogged subprocess probe of cold backend init + first dispatch:
     ``(True, platform)`` when the computation ran, ``(False, detail)``
     otherwise. Subprocess isolation because a wedged BACKEND INIT cannot
@@ -414,6 +463,17 @@ def probe_subprocess(timeout_s: float = 60.0,
     is reported through ``notes`` (appended strings) — a rebuild hint,
     never a failed probe and never a circuit-breaker strike.
 
+    ``expect_devices`` / ``expect_processes`` (default: the
+    :data:`EXPECTED_DEVICES_ENV` / :data:`EXPECTED_PROCESSES_ENV` env
+    vars) arm the topology gate: the probe reports the visible
+    device/process counts (``info``, when passed, receives ``platform`` /
+    ``n_devices`` / ``n_processes``) and a count BELOW the expectation
+    fails the probe with a ``topology_mismatch``-classified detail — the
+    MULTICHIP_r01 failure mode (1 of 8 devices visible, single-device
+    probe green) becomes a structured refusal instead of a silently
+    undersharded measurement. A SURPLUS is not a failure (a bigger slice
+    than asked for still runs the asked-for mesh).
+
     Honors the :class:`FaultInjector` env hook: an ``init_unavailable``
     directive fails the probe in-process (fast), so end-to-end tests can
     simulate the r02 failure mode without a chip.
@@ -425,6 +485,11 @@ def probe_subprocess(timeout_s: float = 60.0,
             "fault-injected: Unable to initialize backend "
             "(TAT_BACKEND_FAULTS=init_unavailable)"
         )
+    env_devices, env_processes = _expected_topology(env)
+    if expect_devices is None:
+        expect_devices = env_devices
+    if expect_processes is None:
+        expect_processes = env_processes
     if bundle_dir is None:
         bundle_dir = (env or os.environ).get(BUNDLE_ENV, "")
     code = _bundle_probe_code(bundle_dir) if bundle_dir else PROBE_CODE
@@ -441,9 +506,27 @@ def probe_subprocess(timeout_s: float = 60.0,
     token = [ln for ln in proc.stdout.splitlines()
              if ln.startswith("BACKEND_OK")]
     if proc.returncode == 0 and token:
+        # Positional contract (see PROBE_CODE):
+        # BACKEND_OK platform n_devices n_processes checksum [notes...]
         parts = token[0].split()
-        if notes is not None and len(parts) > 4:
-            notes.extend(parts[4:])
+        n_dev, n_proc = int(parts[2]), int(parts[3])
+        if info is not None:
+            info.update(
+                platform=parts[1], n_devices=n_dev, n_processes=n_proc,
+            )
+        if notes is not None and len(parts) > 5:
+            notes.extend(parts[5:])
+        if ((expect_devices is not None and n_dev < expect_devices)
+                or (expect_processes is not None
+                    and n_proc < expect_processes)):
+            return False, (
+                f"topology_mismatch: visible {n_dev} of "
+                f"{expect_devices if expect_devices is not None else n_dev}"
+                f" devices, {n_proc} of "
+                f"{expect_processes if expect_processes is not None else n_proc}"
+                f" processes on {parts[1]} — refusing to measure an "
+                "undersharded mesh (MULTICHIP_r01)"
+            )
         return True, parts[1]
     tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
     return False, f"probe rc={proc.returncode}: " + " | ".join(tail)
@@ -555,7 +638,8 @@ RUNG_CPU = "cpu-tagged"
 # failures — or a fleet serving from a bundle built under last week's
 # jaxlib — on a healthy chip must not route the rest of the work to CPU.
 BREAKER_KINDS = frozenset(
-    {"init_unavailable", "wedge_timeout", "device_crash", "oom"}
+    {"init_unavailable", "topology_mismatch", "wedge_timeout",
+     "device_crash", "oom"}
 )
 
 # Default deadline for one guarded unit (a sweep cell's compile + measure,

@@ -90,6 +90,15 @@ class RunPlan:
     # (parallel.mesh.scenario_rollout_resumable sets 1).
     logs_time_axis: int = 0
     meta: dict = dataclasses.field(default_factory=dict)
+    # Snapshot-family / journal names. Defaults are the historical
+    # single-process layout; the pods tier (parallel/pods.py) gives each
+    # PROCESS its own prefixes (checkpoint.shard_prefix) and journal file
+    # inside ONE shared run_dir, so N processes checkpoint concurrently
+    # without racing on files while the shard manifest ties the set
+    # together.
+    carry_prefix: str = CARRY_PREFIX
+    logs_prefix: str = LOGS_PREFIX
+    journal_filename: str | None = None
 
     @property
     def chunk_len(self) -> int:
@@ -181,9 +190,11 @@ class GracefulInterrupt:
         return False
 
 
-def read_plan(run_dir: str) -> RunPlan:
-    """Reconstruct the :class:`RunPlan` from a run directory's journal."""
-    journal = RunJournal(run_dir)
+def read_plan(run_dir: str, journal_filename: str | None = None) -> RunPlan:
+    """Reconstruct the :class:`RunPlan` from a run directory's journal
+    (``journal_filename`` selects a per-process journal in the pods
+    layout; default is the single-process journal)."""
+    journal = RunJournal(run_dir, filename=journal_filename)
     for e in journal.read():
         if e.get("event") == "run_start":
             return RunPlan(
@@ -195,6 +206,9 @@ def read_plan(run_dir: str) -> RunPlan:
                 keep_last=e.get("keep_last", 3),
                 logs_time_axis=e.get("logs_time_axis", 0),
                 meta=e.get("meta", {}),
+                carry_prefix=e.get("carry_prefix", CARRY_PREFIX),
+                logs_prefix=e.get("logs_prefix", LOGS_PREFIX),
+                journal_filename=journal_filename,
             )
     raise checkpoint.SnapshotError(
         "unreadable", journal.path,
@@ -215,10 +229,20 @@ def run_chunks(
     resumed_from_chunk: int | None = None,
     metrics: "export_mod.MetricsWriter | str | None" = None,
     guard: "backend_mod.BackendGuard | None" = None,
+    to_host=None,
 ) -> RunResult:
     """Drive ``chunk_jit(carry, i0) -> (carry, logs)`` from ``start_chunk``
     to ``plan.n_chunks``, snapshotting the carry and the chunk's logs at
     every boundary and journaling completion.
+
+    ``to_host`` (optional) replaces :func:`host_copy` as the
+    device-to-host extraction for BOTH the boundary carry and the chunk
+    logs. The pods tier needs it: ``np.array`` of a multi-process global
+    ``jax.Array`` raises (the process only addresses its own shards), so
+    ``parallel.pods`` passes its local-shard extractor and each process
+    snapshots exactly the block it owns. When set, the chunk logs are
+    ALSO localized before snapshot/concat — the returned ``logs`` are
+    then host arrays of the process-local block.
 
     ``place`` (optional) maps a host carry onto devices (e.g.
     ``parallel.mesh.shard_scenarios``) — applied to the initial carry and
@@ -254,8 +278,9 @@ def run_chunks(
     reconstructable) and are only removed by the operator deleting the run
     directory.
     """
-    journal = RunJournal(plan.run_dir)
+    journal = RunJournal(plan.run_dir, filename=plan.journal_filename)
     os.makedirs(plan.run_dir, exist_ok=True)
+    _host = to_host if to_host is not None else host_copy
     if isinstance(metrics, str):
         metrics = export_mod.MetricsWriter(metrics)
     if metrics is not None and start_chunk == 0:
@@ -273,12 +298,14 @@ def run_chunks(
             "chunk_len": plan.chunk_len, "seed": plan.seed,
             "config_hash": plan.config_hash, "keep_last": plan.keep_last,
             "logs_time_axis": plan.logs_time_axis, "meta": plan.meta,
+            "carry_prefix": plan.carry_prefix,
+            "logs_prefix": plan.logs_prefix,
         })
     logs_chunks = list(prior_logs)
     # The host copy is the retry/requeue anchor: donation consumes device
     # buffers, a dying device drops them — numpy on the host survives both
     # (host_copy documents why it must be a real copy).
-    carry_host = host_copy(carry)
+    carry_host = _host(carry)
     carry = place(carry) if place is not None else carry
     retries_total = 0
     attempt = 0
@@ -306,7 +333,7 @@ def run_chunks(
                 # is durable even if that publish predates this process.
                 checkpoint.save_snapshot(
                     plan.run_dir, c - 1, carry_host,
-                    prefix=CARRY_PREFIX, config_hash=plan.config_hash,
+                    prefix=plan.carry_prefix, config_hash=plan.config_hash,
                     keep_last=plan.keep_last, meta={"chunk": c - 1},
                 )
             journal.append({
@@ -339,7 +366,12 @@ def run_chunks(
                 # published: rebinding carry_host here would make a
                 # snapshot IO failure retry chunk c from chunk c's own
                 # output — applying its dynamics twice.
-                out_host = host_copy(out_carry)
+                out_host = _host(out_carry)
+                if to_host is not None:
+                    # Pods: logs are multi-process global arrays too —
+                    # localize before snapshot/concat (np.asarray of the
+                    # global array would raise in save_snapshot).
+                    out_logs = to_host(out_logs)
                 return out_carry, out_logs, out_host
 
             if guard is None:
@@ -364,12 +396,12 @@ def run_chunks(
                 degraded = guard.last_fell_back
             wall_s = time.perf_counter() - t0  # host copy = device sync.
             checkpoint.save_snapshot(
-                plan.run_dir, c, new_carry_host, prefix=CARRY_PREFIX,
+                plan.run_dir, c, new_carry_host, prefix=plan.carry_prefix,
                 config_hash=plan.config_hash, keep_last=plan.keep_last,
                 meta={"chunk": c},
             )
             checkpoint.save_snapshot(
-                plan.run_dir, c, logs, prefix=LOGS_PREFIX,
+                plan.run_dir, c, logs, prefix=plan.logs_prefix,
                 config_hash=plan.config_hash, keep_last=0,
                 meta={"chunk": c},
             )
@@ -398,7 +430,7 @@ def run_chunks(
             "event": "chunk", "chunk": c,
             "step_end": (c + 1) * plan.chunk_len,
             "carry_snapshot": os.path.basename(
-                checkpoint.snapshot_path(plan.run_dir, c, CARRY_PREFIX)
+                checkpoint.snapshot_path(plan.run_dir, c, plan.carry_prefix)
             ),
             "retries": attempt,
             # The rung this chunk ACTUALLY ran at (guard runs only).
@@ -458,8 +490,19 @@ def resume_run(
     max_retries: int = 0,
     metrics: "export_mod.MetricsWriter | str | None" = None,
     guard: "backend_mod.BackendGuard | None" = None,
+    journal_filename: str | None = None,
+    to_host=None,
+    max_start_chunk: int | None = None,
 ) -> RunResult:
     """Resume a journaled run from its newest fully-valid boundary.
+
+    ``journal_filename`` / ``to_host`` mirror :func:`run_chunks` (the
+    pods per-process layout). ``max_start_chunk`` caps the resume point:
+    the pods tier must restart every process from the SAME boundary —
+    a process whose newest shard snapshot is ahead of a peer's (it died
+    mid-publish) passes the cross-process minimum here and re-runs the
+    chunks its peers lost (parallel.pods agrees on the cap via an
+    all-gather before calling this).
 
     ``initial_carry`` is the chunk-0 carry regenerated DETERMINISTICALLY
     from the journaled seed/meta (``run.init_carry(...)`` on freshly built
@@ -477,36 +520,46 @@ def resume_run(
     chunks recompute from the restored carry through the one compiled
     chunk function.
     """
-    plan = read_plan(run_dir)
+    plan = read_plan(run_dir, journal_filename=journal_filename)
+    journal = RunJournal(run_dir, filename=journal_filename)
     if (config_hash is not None and plan.config_hash is not None
             and config_hash != plan.config_hash):
         raise checkpoint.SnapshotError(
-            "config_mismatch", RunJournal(run_dir).path,
+            "config_mismatch", journal.path,
             f"journal config {plan.config_hash} != current {config_hash}: "
             "the run was started under a different configuration",
         )
     check_hash = config_hash if config_hash is not None else plan.config_hash
     # Shape-only evaluation of the chunk gives the log template without
-    # running (or even compiling) anything.
+    # running (or even compiling) anything. Under a pods to_host the
+    # SAVED logs are host-local blocks of the same shapes (the chunk is
+    # traced at the local batch size), so the template still matches.
     _, logs_template = jax.eval_shape(
         chunk_jit, initial_carry, chunk_index_offset(0, plan.chunk_len)
     )
-    journal = RunJournal(run_dir)
 
     skipped: list[str] = []
     start_chunk = 0
     carry = initial_carry
     prior_logs: list = []
     for step, path in reversed(
-        checkpoint.list_snapshots(run_dir, CARRY_PREFIX)
+        checkpoint.list_snapshots(run_dir, plan.carry_prefix)
     ):
+        if max_start_chunk is not None and step + 1 > max_start_chunk:
+            skipped.append(
+                f"[beyond_cap] {path}: boundary {step + 1} > agreed "
+                f"start cap {max_start_chunk} (peer processes lost it)"
+            )
+            continue
         try:
             cand, _ = checkpoint.load_snapshot(
                 path, initial_carry, config_hash=check_hash
             )
             cand_logs = []
             for lc in range(step + 1):
-                lpath = checkpoint.snapshot_path(run_dir, lc, LOGS_PREFIX)
+                lpath = checkpoint.snapshot_path(
+                    run_dir, lc, plan.logs_prefix
+                )
                 lg, _ = checkpoint.load_snapshot(
                     lpath, logs_template, config_hash=check_hash
                 )
@@ -532,5 +585,5 @@ def resume_run(
         plan, chunk_jit, carry, start_chunk=start_chunk,
         prior_logs=prior_logs, interrupt=interrupt, place=place,
         max_retries=max_retries, resumed_from_chunk=start_chunk,
-        metrics=metrics, guard=guard,
+        metrics=metrics, guard=guard, to_host=to_host,
     )

@@ -58,7 +58,13 @@ class ScenarioServer:
     snapshots). ``mesh`` (a ``jax.sharding.Mesh``) places each batch
     sharded over its lane axis before dispatch — sharded
     (``min_devices>1``) programs serve through the export/jit rungs, the
-    exec replay path addresses one device (PR-8 note).
+    exec replay path addresses one device (PR-8 note). The 2-D pods mesh
+    (``parallel.pods.make_pods_mesh``) is accepted too: placement rides
+    ``parallel.mesh.shard_scenarios``, which on a MULTI-process mesh
+    assembles the global batch from each process's host copy (every
+    process runs the same host-synchronous server loop; the carry_host
+    is host-global on all of them, which is exactly what that path
+    needs).
     """
 
     def __init__(self, families=None, *, buckets=DEFAULT_BUCKETS,
@@ -264,10 +270,8 @@ class ScenarioServer:
         (out, serve_rung), guard_rung = self._dispatch(
             fam, (carry, i0), label
         )
-        from tpu_aerial_transport.resilience.recovery import host_copy
-
         new_carry, _logs = out
-        batch.carry_host = host_copy(new_carry)
+        batch.carry_host = self._boundary_host(new_carry)
         batch.harvest()
         for lane in batch.free_lanes():
             late = self.queue.take(fam.name, 1)
@@ -282,6 +286,23 @@ class ScenarioServer:
                    guard_rung=guard_rung)
         if batch.retired:
             self._occupancy.extend(batch.occupancy_samples)
+
+    def _boundary_host(self, carry):
+        """Boundary carry back to host. The server loop is host-global by
+        design (late joins / lane surgery operate on the full batch on
+        every process), so under a MULTI-process pods mesh the extraction
+        is ``pods.host_global`` (all-gather to replicated, then copy) —
+        ``recovery.host_copy``'s plain ``np.array`` raises on an array
+        spanning non-addressable devices."""
+        from tpu_aerial_transport.resilience.recovery import host_copy
+
+        if self.mesh is not None:
+            from tpu_aerial_transport.parallel import mesh as mesh_mod
+            from tpu_aerial_transport.parallel import pods
+
+            if mesh_mod.is_multiprocess_mesh(self.mesh):
+                return pods.host_global(carry)
+        return host_copy(carry)
 
     def _dispatch(self, fam: Family, args, label: str):
         """One guarded chunk through the serve ladder. Returns

@@ -29,6 +29,50 @@ def honor_jax_platforms_env() -> None:
 # location with TAT_XLA_CACHE_DIR, disable with TAT_XLA_CACHE_DIR="".
 XLA_CACHE_ENV = "TAT_XLA_CACHE_DIR"
 
+# The virtual-device knob (mirrors the TAT_XLA_CACHE_DIR pattern): ONE
+# env var naming how many virtual CPU devices a process should fake via
+# XLA's --xla_force_host_platform_device_count. The test conftest, the
+# ci_check forced-mesh contract runs, and the pods localhost harness
+# (tools/pods_local.py) all route through apply_virtual_devices() instead
+# of hand-rolling XLA_FLAGS strings — hand-rolled copies drifted (4 here,
+# 8 there) and a mismatch surfaces as silently-skipped min_devices tests.
+VIRTUAL_DEVICES_ENV = "TAT_VIRTUAL_DEVICES"
+
+_FORCE_FLAG = "--xla_force_host_platform_device_count"
+
+
+def virtual_device_count(default: int | None = None) -> int | None:
+    """The requested virtual-device count: :data:`VIRTUAL_DEVICES_ENV` when
+    set (must be a positive int), else ``default``."""
+    raw = os.environ.get(VIRTUAL_DEVICES_ENV, "")
+    if not raw:
+        return default
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{VIRTUAL_DEVICES_ENV}={raw!r} is not an integer"
+        ) from None
+    if n < 1:
+        raise ValueError(f"{VIRTUAL_DEVICES_ENV}={raw!r} must be >= 1")
+    return n
+
+
+def apply_virtual_devices(default: int | None = None) -> int | None:
+    """Request ``virtual_device_count(default)`` virtual CPU devices by
+    appending :data:`_FORCE_FLAG` to ``XLA_FLAGS`` — unless XLA_FLAGS
+    already pins a count (an ambient pin wins, same contract the test
+    conftest always had: tests/conftest.py then SKIPS mesh tests with an
+    actionable message instead of dying in ``make_mesh``). Must run
+    BEFORE the first jax backend init to take effect. Returns the count
+    requested here, or None when nothing was applied."""
+    n = virtual_device_count(default)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if n is None or _FORCE_FLAG in flags:
+        return None
+    os.environ["XLA_FLAGS"] = f"{flags} {_FORCE_FLAG}={n}".strip()
+    return n
+
 
 def default_cache_dir() -> str:
     """Repo-local default (gitignored): ``<repo>/.cache/xla``."""
