@@ -252,7 +252,8 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
                   inner_iters: int | None = None, socp_fused: str = "auto",
                   force_fixed_iters: bool = False, inner_tol: float = 0.0,
                   substep_unroll: int = 1,
-                  pad_operators: bool | None = None):
+                  pad_operators: bool | None = None,
+                  socp_precision: str = "auto"):
     # Default inner ADMM budgets are the measured knees. C-ADMM: 20 — below
     # it the warm-started agent solves miss the 5e-3 primal tolerance and
     # fall back to equilibrium forces (visible as an exactly-zero consensus
@@ -272,7 +273,8 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             params, col.collision_radius, col.max_deceleration,
             max_iter=max_iter,
             inner_iters=inner_iters if inner_iters is not None else 20,
-            socp_fused=socp_fused, inner_tol=inner_tol,
+            socp_fused=socp_fused, socp_precision=socp_precision,
+            inner_tol=inner_tol,
             pad_operators=pad_operators,
             # res_tol = 0 can never be met (inf-norm >= 0), so the consensus
             # loop runs to exactly max_iter + 1 iterations — the fixed-count
@@ -296,7 +298,8 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             params, col.collision_radius, col.max_deceleration,
             max_iter=max_iter,
             inner_iters=inner_iters if inner_iters is not None else 40,
-            socp_fused=socp_fused, inner_tol=inner_tol,
+            socp_fused=socp_fused, socp_precision=socp_precision,
+            inner_tol=inner_tol,
             pad_operators=pad_operators,
             **({"prim_inf_tol": 0.0} if force_fixed_iters else {}),
         )
@@ -387,7 +390,8 @@ def build(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
     return jax.jit(rollout, static_argnames="n_steps"), css, states
 
 
-def measure(step, css, states, device, n_steps, n_scenarios, reps=3):
+def measure(step, css, states, device, n_steps, n_scenarios, reps=3,
+            return_last=False):
     css = jax.device_put(css, device)
     states = jax.device_put(states, device)
     # Compile + warmup at the timed length so the timed calls hit the
@@ -408,7 +412,13 @@ def measure(step, css, states, device, n_steps, n_scenarios, reps=3):
         times.append(time.perf_counter() - t0)
     # Median over reps: one-off dispatch/timing glitches produced wildly
     # wrong single-sample readings through the device tunnel.
-    return (n_scenarios * n_steps / float(np.median(times)), compile_wall_s)
+    rate = n_scenarios * n_steps / float(np.median(times))
+    if return_last:
+        # The last timed rep's output, for callers that read a result off
+        # the measured run (e.g. the fused A/B cells' final consensus
+        # residual) without paying an extra rollout.
+        return rate, compile_wall_s, out
+    return rate, compile_wall_s
 
 
 def ref_arch_cpu_rate(n=N_AGENTS, max_iter=20, inner_iters=20, n_steps=5):
@@ -744,6 +754,127 @@ def _batched(controller, n, n_scenarios, n_steps=10, socp_fused="auto",
                               pad_operators=pad_operators)
     return measure(step, css, states, jax.devices()[0], n_steps,
                    n_scenarios)  # -> (rate, compile_wall_s)
+
+
+def _fused_measure(controller, n, n_scenarios, fused, precision,
+                   n_steps=10):
+    """Measure one fused-A/B arm: the `_batched` rollout with the inner
+    solves pinned to ``fused`` x ``precision``, ALSO returning the final
+    step's worst-lane consensus residual (the bf16 parity-bar input) and
+    the config's residual tolerance (the bar itself — the paper's 1e-2 N).
+    Returns ``(rate, compile_wall_s, final_res, res_bar)``."""
+    mpc_step, cs0, state0 = make_mpc_step(
+        controller, n, socp_fused=fused, socp_precision=precision
+    )
+    states = _scenario_batch(state0, n_scenarios)
+    css = jax.vmap(lambda _: cs0)(jnp.arange(n_scenarios))
+    batched_step = jax.vmap(mpc_step)
+
+    def rollout(css, states, n_steps):
+        def body(carry, _):
+            cs, s = carry
+            cs, s, stats = batched_step(cs, s)
+            return (cs, s), jnp.max(stats.solve_res)
+
+        (css, states), res_seq = jax.lax.scan(
+            body, (css, states), None, length=n_steps
+        )
+        return css, states, res_seq[-1]
+
+    step = jax.jit(rollout, static_argnames="n_steps")
+    rate, compile_wall_s, out = measure(
+        step, css, states, jax.devices()[0], n_steps, n_scenarios,
+        return_last=True,
+    )
+    final_res = float(out[2])
+    # The parity bar: the consensus loop's own stop tolerance (reference
+    # res_tol = 1e-2 N; DD's prim_inf_tol mirrors it).
+    res_bar = 1e-2
+    return rate, compile_wall_s, final_res, res_bar
+
+
+def _fused_ab_cell(controller, n, n_scenarios, fused, precision="f32"):
+    """Whole-solve mega-kernel A/B cell (ops/socp.py fused="kernel" vs
+    "scan"), with the bf16-storage arm gated on the consensus-residual
+    parity bar: a bf16 arm whose final worst-lane consensus residual
+    fails the bar (>= the paper's 1e-2 N tolerance) REFUSES — the cell
+    re-measures at f32 and records the refusal — so a chip round can
+    never read a non-converging bf16 rate as a win. The gate decision
+    lands on the cell as ``precision`` (requested) + ``precision_resolved``
+    (what was measured), the ``impl``/``impl_resolved`` pattern of the
+    ring A/B cells; ``fused``/``fused_resolved`` record the trace-time
+    off-TPU downgrade (kernel -> scan on a CPU rung) the same way."""
+    from tpu_aerial_transport.control import cadmm as cadmm_mod
+    from tpu_aerial_transport.control import dd as dd_mod
+    from tpu_aerial_transport.ops import socp as socp_mod
+
+    # Resolve the mode THE SAME WAY solve_socp's dispatch will — through
+    # the one shared resolver, at this cell's actual per-agent operator
+    # dims (the padded tier when pad_operators resolves on, raw
+    # otherwise) — so a VMEM-fits fallback or off-TPU downgrade can never
+    # leave a scan measurement labeled as a kernel verdict.
+    params, col, *_ = _setup(n)
+    if controller == "cadmm":
+        dims_cfg = cadmm_mod.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            socp_fused=fused, socp_precision=precision,
+        )
+        _, _, nv_p, n_box_p, m_p = cadmm_mod._qp_dims(dims_cfg, n)
+    else:
+        dims_cfg = dd_mod.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            socp_fused=fused, socp_precision=precision,
+        )
+        _, _, nv_p, n_box_p, m_p = dd_mod._qp_dims(dims_cfg)
+    fused_resolved = socp_mod.runtime_fused_mode(fused, nv_p, m_p, n_box_p)
+    # Off the kernel path the precision knob is inert (bit-identical scan
+    # program — asserted in tests/test_fused_solve.py): resolve it to f32
+    # up front so a CPU-rung bf16 cell is labeled as the f32 scan
+    # measurement it actually is.
+    precision_eff = precision if fused_resolved in (
+        "kernel", "kernel_interpret") else "f32"
+    rate, compile_wall_s, final_res, res_bar = _fused_measure(
+        controller, n, n_scenarios, fused, precision_eff
+    )
+    value = {
+        "scenario_mpc_steps_per_sec": rate,
+        "agent_mpc_steps_per_sec": rate * n,
+        "compile_wall_s": compile_wall_s,
+        "fused": fused,
+        "fused_resolved": fused_resolved,
+        "precision": precision,
+        "precision_resolved": precision_eff,
+        "final_consensus_res": final_res,
+        "res_bar": res_bar,
+    }
+    if precision_eff == "bf16" and not (final_res < res_bar):
+        # The bf16 arm missed the bar — measure the f32 twin to tell a
+        # REAL refusal (bf16 broke a convergence f32 achieves) from an
+        # inconclusive operating point (benchmark configs often run to
+        # the iteration cap above the bar in EITHER precision — a
+        # cap-railed f32 residual means the bar cannot indict bf16 here).
+        rate32, compile32, res32, _ = _fused_measure(
+            controller, n, n_scenarios, fused, "f32"
+        )
+        if res32 < res_bar:
+            # Parity-bar refusal: record the f32 measurement as the
+            # cell's rate — one a deployment could actually run at.
+            value.update({
+                "scenario_mpc_steps_per_sec": rate32,
+                "agent_mpc_steps_per_sec": rate32 * n,
+                "compile_wall_s": compile_wall_s + compile32,
+                "precision_resolved": "f32",
+                "final_consensus_res": res32,
+                "bf16_refused": True,
+                "bf16_final_consensus_res": final_res,
+                "bf16_rate_unusable": rate,
+            })
+        else:
+            value.update({
+                "res_bar_inconclusive": True,
+                "f32_final_consensus_res": res32,
+            })
+    return value
 
 
 def _measured_iter_ms(controller, n, k_lo=4, k_hi=24, n_steps=30):
@@ -1455,6 +1586,33 @@ def sweep(resume: bool = False, platform: str | None = None):
             record(key, guarded_cell(key, _donated_resume_cell))
         except Exception as e:
             record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    # Whole-solve mega-kernel A/B cells (ops/socp.py fused="kernel" — the
+    # "attack the 84%" decision cells): scan vs kernel twins at n in
+    # {16, 64} for both consensus controllers, plus the bf16-storage arm
+    # gated on the consensus-residual parity bar (_fused_ab_cell). Run on
+    # ANY backend: off-TPU the kernel downgrades to scan at trace time
+    # (fused_resolved records it), so a CPU round produces rung-tagged
+    # baseline rows and the chip round overwrites them with the real
+    # verdict — the flip criterion is written at socp.resolve_fused.
+    for ctrl in ("cadmm", "dd"):
+        for n_f, ns_f in ((16, 64), (64, 16)):
+            fused_cells = [
+                (f"{ctrl}_n{n_f}_fused_scan", dict(fused="scan")),
+                (f"{ctrl}_n{n_f}_fused_kernel", dict(fused="kernel")),
+                (f"{ctrl}_n{n_f}_fused_kernel_bf16",
+                 dict(fused="kernel", precision="bf16")),
+            ]
+            for key, kw in fused_cells:
+                if not want(key) or (key in results
+                                     and "error" not in results[key]):
+                    continue
+                try:
+                    record(key, guarded_cell(
+                        key, _fused_ab_cell, ctrl, n_f, ns_f, **kw,
+                    ))
+                except Exception as e:
+                    record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
 
     # Cold-start ladder A/B (tpu_aerial_transport/aot/): time-to-first-
     # step of a FRESH process per fallback-ladder rung — the zero-compile

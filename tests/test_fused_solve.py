@@ -1,0 +1,679 @@
+"""Whole-solve fused ADMM mega-kernel (ops/admm_kernel.fused_solve_lanes,
+solve_socp fused="kernel"/"kernel_interpret") vs the scan path.
+
+Oracles, strongest first:
+
+1. **Bitwise** (interpret mode, padded operators): the kernel's
+   ``exact_dot`` body is ``jax.vmap`` of the scan path's OWN per-instance
+   functions, so per-iteration AND end-to-end solutions — including the
+   in-kernel w2 build and residual reduction — equal the scan path's
+   bit-for-bit (np.array_equal, not allclose).
+2. **f32 rounding**: the compiled broadcast-reduce body (the form Mosaic
+   can actually lower — run here under the interpreter with
+   ``exact_dot=False``) vs the exact body; and full cadmm/dd control
+   steps (nominal + alive-masked, single-program + agent-sharded).
+3. **Zero-cost gates**: fused="scan" lowers IDENTICAL HLO regardless of
+   the precision knob (the no_faults()/telemetry=None contract);
+   fused="kernel" downgrades to the scan program off-TPU at trace time.
+4. **VMEM bounds**: MAX_FUSED_DIM stays the derived 112 and
+   fused_solve_fits flips exactly at the documented budget.
+5. **bf16 gate** (bench.py _fused_ab_cell): the bf16 arm refuses — falls
+   back to a f32 measurement — when the consensus-residual parity bar
+   fails, and the decision lands in precision/precision_resolved.
+"""
+
+import os
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.control import cadmm, centralized, dd
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.ops import admm_kernel, socp
+from tpu_aerial_transport.resilience import faults as faults_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------- problem builders --------------------------
+
+
+def _problems(B=5, nv=8, n_box=6, soc=(4,), seed=0):
+    rng = np.random.default_rng(seed)
+
+    def one():
+        L = rng.standard_normal((nv, nv))
+        P = jnp.asarray(L @ L.T + np.eye(nv), jnp.float32)
+        q = jnp.asarray(rng.standard_normal(nv), jnp.float32)
+        m = n_box + sum(soc)
+        A = jnp.asarray(rng.standard_normal((m, nv)) * 0.5, jnp.float32)
+        lb = jnp.asarray(rng.uniform(-2.0, -0.5, n_box), jnp.float32)
+        ub = jnp.asarray(rng.uniform(0.5, 2.0, n_box), jnp.float32)
+        shift = jnp.zeros((m,), jnp.float32).at[n_box].set(3.0)
+        return P, q, A, lb, ub, shift
+
+    return [jnp.stack(x) for x in zip(*[one() for _ in range(B)])]
+
+
+def _solve_batch(mode, args, iters, with_shift=True, precision="f32"):
+    Ps, qs, As, lbs, ubs, shifts = args
+    if with_shift:
+        return jax.vmap(
+            lambda P_, q_, A_, lb_, ub_, s_: socp.solve_socp_padded(
+                P_, q_, A_, lb_, ub_, n_box=6, soc_dims=(4,), iters=iters,
+                shift=s_, fused=mode, precision=precision,
+            )
+        )(Ps, qs, As, lbs, ubs, shifts)
+    return jax.vmap(
+        lambda P_, q_, A_, lb_, ub_: socp.solve_socp(
+            P_, q_, A_, lb_, ub_, n_box=6, soc_dims=(4,), iters=iters,
+            fused=mode, precision=precision,
+        )
+    )(Ps, qs, As, lbs, ubs)
+
+
+def _assert_bitwise(out, ref):
+    for name in ("x", "y", "z", "prim_res", "dual_res"):
+        a, b = np.asarray(getattr(out, name)), np.asarray(getattr(ref, name))
+        assert np.array_equal(a, b), (
+            f"{name} differs (max abs {np.abs(a - b).max()})"
+        )
+
+
+# --------------------------- bitwise parity ----------------------------
+
+
+@pytest.mark.parametrize("iters", [1, 2, 30])
+def test_kernel_interpret_bitwise_vs_scan(iters):
+    """The acceptance bar: interpret-mode mega-kernel ≡ scan path BITWISE
+    per iteration (iters=1, 2) and end-to-end (30) on the padded
+    operator — solution iterates AND the in-kernel residual reduction."""
+    args = _problems()
+    ref = _solve_batch("scan", args, iters)
+    out = _solve_batch("kernel_interpret", args, iters)
+    _assert_bitwise(out, ref)
+
+
+def test_kernel_interpret_bitwise_double_fold():
+    """Nested vmaps (scenarios x instances — the controllers' fold) still
+    land bitwise: the custom_vmap recursion folds both axes into one
+    kernel batch axis without changing any per-lane op."""
+    args = _problems()
+    stacked = [jnp.stack([a, a]) for a in args]
+
+    def run(mode):
+        one = lambda P_, q_, A_, lb_, ub_, s_: socp.solve_socp_padded(
+            P_, q_, A_, lb_, ub_, n_box=6, soc_dims=(4,), iters=10,
+            shift=s_, fused=mode,
+        )
+        return jax.vmap(jax.vmap(one))(*stacked)
+
+    _assert_bitwise(run("kernel_interpret"), run("scan"))
+
+
+def test_kernel_interpret_bitwise_no_shift():
+    """shift=None takes the static shiftless branch in BOTH realizations
+    (no z + 0 signed-zero drift from a zeros placeholder)."""
+    args = _problems()
+    ref = _solve_batch("scan", args, 10, with_shift=False)
+    out = _solve_batch("kernel_interpret", args, 10, with_shift=False)
+    _assert_bitwise(out, ref)
+
+
+def test_kernel_interpret_unbatched_matches_scan():
+    """A lone (unbatched) solve takes the runner's scan twin — bitwise."""
+    Ps, qs, As, lbs, ubs, shifts = _problems(B=1)
+    kw = dict(n_box=6, soc_dims=(4,), iters=12)
+    ref = socp.solve_socp_padded(
+        Ps[0], qs[0], As[0], lbs[0], ubs[0], shift=shifts[0], fused="scan",
+        **kw,
+    )
+    out = socp.solve_socp_padded(
+        Ps[0], qs[0], As[0], lbs[0], ubs[0], shift=shifts[0],
+        fused="kernel_interpret", **kw,
+    )
+    _assert_bitwise(out, ref)
+
+
+def test_compiled_form_matches_exact_form_f32():
+    """The Mosaic-lowerable broadcast-reduce body (exact_dot=False — what
+    a real chip runs), executed under the interpreter, agrees with the
+    bitwise exact_dot body to f32 rounding — the chunk kernel's numerics
+    contract, asserted for the mega-kernel's compiled form."""
+    Ps, qs, As, lbs, ubs, shifts = _problems()
+    B = Ps.shape[0]
+    nv_p, n_box_p = socp.padded_dims(8, 6, (4,))
+    m_p = n_box_p + 4
+    pqps = jax.vmap(
+        lambda P_, A_, lb_, ub_, s_: socp.padded_kkt_operator(
+            P_, A_, lb_, ub_, s_, n_box=6, soc_dims=(4,)
+        )
+    )(Ps, As, lbs, ubs, shifts)
+    qs_p = jnp.pad(qs, ((0, 0), (0, nv_p - 8)))
+    z0 = jax.vmap(
+        lambda lb_, ub_, s_: socp._project_cone(
+            jnp.zeros((m_p,)), lb_, ub_, n_box_p, (4,), s_
+        )
+    )(pqps.lb, pqps.ub, pqps.shift)
+    rho_v = jax.vmap(
+        lambda lb_, ub_: socp.make_rho_vec(m_p, n_box_p, lb_, ub_, 0.4)
+    )(pqps.lb, pqps.ub)
+
+    def run(exact_dot):
+        return admm_kernel.fused_solve_lanes(
+            jnp.zeros((B, nv_p)), jnp.zeros((B, m_p)), z0,
+            pqps.op.K2, pqps.op.Minv, pqps.A, pqps.P, qs_p, rho_v,
+            pqps.lb, pqps.ub, pqps.shift,
+            nv=nv_p, n_box=n_box_p, soc_dims=(4,), iters=30, alpha=1.6,
+            interpret=True, exact_dot=exact_dot,
+        )
+
+    exact, compiled = run(True), run(False)
+    for a, b in zip(exact, compiled):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=1e-4
+        )
+
+
+# ----------------------- controller-level parity -----------------------
+
+
+_HEALTH = faults_mod.FaultStep(
+    alive=jnp.array([False, True, True, True]),
+    thrust_scale=jnp.array([0.0, 1.0, 1.0, 1.0], jnp.float32),
+    msg_ok=jnp.array([False, True, False, True]),
+)
+
+
+def _cadmm_step_batch(mode, health):
+    n = 4
+    params, col, state = setup.rqp_setup(n)
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=6, inner_iters=10, res_tol=1e-3, socp_fused=mode,
+        pad_operators=True,
+    )
+    f_eq = centralized.equilibrium_forces(
+        params, alive=None if health is None else health.alive
+    )
+    astate = cadmm.init_cadmm_state(params, cfg)
+    if health is not None:
+        astate = astate.replace(held=astate.f)
+    vls = jnp.stack([
+        jnp.array([0.2, 0.1, 0.0]), jnp.array([-0.1, 0.3, 0.1]),
+        jnp.array([0.0, 0.0, -0.2]),
+    ])
+    states = jax.vmap(lambda v: state.replace(vl=v))(vls)
+    astates = jax.vmap(lambda _: astate)(vls)
+
+    def one(ast, st):
+        return cadmm.control(
+            params, cfg, f_eq, ast, st, acc_des, health=health
+        )
+
+    f, _, stats = jax.jit(jax.vmap(one))(astates, states)
+    return np.asarray(f), np.asarray(stats.iters)
+
+
+@pytest.mark.parametrize("masked", [False, True],
+                         ids=["nominal", "alive-masked"])
+def test_cadmm_control_step_kernel_matches_scan(masked):
+    """Full C-ADMM control step (vmapped scenario batch, padded tier),
+    kernel vs scan, nominal AND alive-masked/fault-injected: the
+    acceptance bar is f32 rounding; on this image it is in fact bitwise
+    (every per-lane op identical), asserted at 1e-5 to stay robust to
+    XLA re-fusion across versions."""
+    health = _HEALTH if masked else None
+    f_ref, it_ref = _cadmm_step_batch("scan", health)
+    f_out, it_out = _cadmm_step_batch("kernel_interpret", health)
+    np.testing.assert_allclose(f_out, f_ref, rtol=0, atol=1e-5)
+    assert np.array_equal(it_out, it_ref)
+
+
+def _dd_step_batch(mode, health):
+    n = 4
+    params, col, state = setup.rqp_setup(n)
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+    cfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=6, inner_iters=10, socp_fused=mode, pad_operators=True,
+    )
+    f_eq = centralized.equilibrium_forces(
+        params, alive=None if health is None else health.alive
+    )
+    dstate = dd.init_dd_state(params, cfg)
+    if health is not None:
+        dstate = dstate.replace(
+            held_f=dstate.f, held_lam_F=dstate.lam_F,
+            held_lam_M=dstate.lam_M,
+        )
+    vls = jnp.stack([
+        jnp.array([0.2, 0.1, 0.0]), jnp.array([-0.1, 0.3, 0.1]),
+    ])
+    states = jax.vmap(lambda v: state.replace(vl=v))(vls)
+    dstates = jax.vmap(lambda _: dstate)(vls)
+
+    def one(dst, st):
+        return dd.control(params, cfg, f_eq, dst, st, acc_des, health=health)
+
+    f, _, stats = jax.jit(jax.vmap(one))(dstates, states)
+    return np.asarray(f), np.asarray(stats.iters)
+
+
+@pytest.mark.parametrize("masked", [False, True],
+                         ids=["nominal", "alive-masked"])
+def test_dd_control_step_kernel_matches_scan(masked):
+    """Full DD control step parity, nominal + alive-masked (see the cadmm
+    twin for the tolerance rationale)."""
+    health = _HEALTH if masked else None
+    f_ref, it_ref = _dd_step_batch("scan", health)
+    f_out, it_out = _dd_step_batch("kernel_interpret", health)
+    np.testing.assert_allclose(f_out, f_ref, rtol=0, atol=1e-5)
+    assert np.array_equal(it_out, it_ref)
+
+
+def test_sharded_cadmm_kernel_matches_single_program():
+    """Agent-sharded consensus (shard_map, ring exchange seam outside the
+    kernel) with the mega-kernel == the single-program scan path — the
+    composition a real mesh runs, where the per-iteration consensus hop
+    rides parallel.ring.consensus_exchange around the fused solve."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from tpu_aerial_transport.parallel import mesh as mesh_mod
+
+    n = 4
+    params, col, state = setup.rqp_setup(n)
+    state = state.replace(vl=jnp.array([0.2, 0.1, 0.0], jnp.float32))
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+    f_eq = centralized.equilibrium_forces(params)
+
+    cfg_ref = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=6, inner_iters=10, res_tol=1e-3, socp_fused="scan",
+        pad_operators=True,
+    )
+    astate = cadmm.init_cadmm_state(params, cfg_ref)
+    f_ref, _, _ = jax.jit(
+        lambda a, s: cadmm.control(params, cfg_ref, f_eq, a, s, acc_des)
+    )(astate, state)
+
+    cfg = cfg_ref.replace(socp_fused="kernel_interpret")
+    m = mesh_mod.make_mesh({"agent": 4})
+    step = jax.jit(mesh_mod.cadmm_control_sharded(params, cfg, f_eq, m))
+    f_sh, _, _ = step(astate, state, acc_des)
+    assert np.abs(np.asarray(f_sh) - np.asarray(f_ref)).max() < 5e-3
+
+
+# ------------------------- gates and fallbacks -------------------------
+
+
+def test_kernel_downgrades_to_scan_offchip():
+    """fused="kernel" on a non-TPU host is a TRACE-TIME downgrade (the
+    pallas_ring precedent): the compiled program IS the scan program —
+    same HLO, bitwise results — so a backend-guard CPU re-run of a
+    kernel-configured cell measures a working solve."""
+    args = _problems()
+    ref = _solve_batch("scan", args, 10)
+    out = _solve_batch("kernel", args, 10)
+    _assert_bitwise(out, ref)
+
+    Ps, qs, As, lbs, ubs, shifts = args
+
+    def lowered(mode):
+        return jax.jit(
+            lambda P_, q_, A_, lb_, ub_, s_: socp.solve_socp_padded(
+                P_, q_, A_, lb_, ub_, n_box=6, soc_dims=(4,), iters=10,
+                shift=s_, fused=mode,
+            )
+        ).lower(Ps[0], qs[0], As[0], lbs[0], ubs[0], shifts[0]).as_text()
+
+    assert lowered("kernel") == lowered("scan")
+
+
+def test_oversized_solve_falls_back_to_scan():
+    """Solves over the whole-solve VMEM bound must not build a kernel:
+    fused="kernel_interpret" silently takes the scan path and still
+    solves."""
+    nv = 4
+    while admm_kernel.fused_solve_fits(nv, 4):
+        nv += 64
+    P = jnp.eye(nv)
+    q = -jnp.ones((nv,))
+    A = jnp.eye(nv)[:4]
+    lb, ub = jnp.zeros(4), jnp.full((4,), 0.5)
+    sol = socp.solve_socp(
+        P, q, A, lb, ub, n_box=4, soc_dims=(), iters=30,
+        fused="kernel_interpret",
+    )
+    assert float(sol.prim_res) < 1e-3
+    np.testing.assert_allclose(np.asarray(sol.x[:4]), 0.5, atol=1e-2)
+
+
+def test_vmem_bounds_derived():
+    """The VMEM-residency guards are DERIVED from the documented budget,
+    not hand-maintained constants: MAX_FUSED_DIM reproduces the padded-
+    tier recomputation (112) and sits exactly at the double-buffered
+    boundary; fused_solve_fits admits both consensus controllers' padded
+    dims and flips at its own budget line."""
+    assert admm_kernel.MAX_FUSED_DIM == 112
+    budget = admm_kernel.VMEM_BUDGET_BYTES
+    lanes = admm_kernel.LANE_TILE
+    d = admm_kernel.MAX_FUSED_DIM
+    assert 2 * admm_kernel.chunk_kernel_bytes_per_lane(d) * lanes <= budget
+    nxt = d + admm_kernel.SUBLANE_TILE
+    assert 2 * admm_kernel.chunk_kernel_bytes_per_lane(nxt) * lanes > budget
+
+    # The hot padded dims: C-ADMM reduced (nv_p=16, m_p=32) and DD
+    # (nv_p=24, m_p=32) both fit the whole-solve kernel.
+    assert admm_kernel.fused_solve_fits(16, 32, 24)
+    assert admm_kernel.fused_solve_fits(24, 32, 24)
+    # The boundary is exactly the budget inequality.
+    nv = 8
+    while admm_kernel.fused_solve_fits(nv + 8, nv + 8):
+        nv += 8
+    bytes_next = admm_kernel.fused_solve_bytes_per_lane(
+        nv + 8, nv + 8, nv + 8
+    )
+    assert 2 * bytes_next * admm_kernel.SOLVE_BATCH_TILE > budget
+
+
+def _normalize_symbols(hlo: str) -> str:
+    """Strip jax's private-helper dedup suffixes (@_where vs @_where_2):
+    WHICH suffix a helper symbol gets depends on process-global trace
+    caches (what was traced earlier in the pytest process), not on the
+    program — the helper bodies themselves stay in the text and are still
+    compared."""
+    return re.sub(r"(@[A-Za-z_][\w.]*?)_\d+\b", r"\1", hlo)
+
+
+def test_precision_inert_off_kernel_identical_hlo():
+    """The zero-cost contract (the no_faults()/telemetry=None pattern):
+    with the gate off (fused="scan" — today's default path), the
+    precision knob changes NOTHING — identical lowered programs at both
+    the solver and the full-control-step level, so shipping the knob
+    cannot perturb any existing deployment."""
+    Ps, qs, As, lbs, ubs, shifts = _problems(B=1)
+
+    def solve_fn(precision):
+        return lambda P_, q_, A_, lb_, ub_, s_: socp.solve_socp_padded(
+            P_, q_, A_, lb_, ub_, n_box=6, soc_dims=(4,), iters=10,
+            shift=s_, fused="scan", precision=precision,
+        )
+
+    solve_args = (Ps[0], qs[0], As[0], lbs[0], ubs[0], shifts[0])
+
+    def fresh_trace(fn, *args):
+        # Trace from an EMPTY process-global cache state: which shared
+        # sub-jaxprs (clip, _pad, _where) get hoisted/named in the
+        # printed program depends on what earlier tests left in jax's
+        # trace caches — a text artifact, not an op difference. Clearing
+        # puts both variants on identical footing.
+        jax.clear_caches()
+        jxp = str(jax.make_jaxpr(fn)(*args))
+        jax.clear_caches()
+        hlo = _normalize_symbols(jax.jit(fn).lower(*args).as_text())
+        return jxp, hlo
+
+    assert fresh_trace(solve_fn("f32"), *solve_args) \
+        == fresh_trace(solve_fn("bf16"), *solve_args)
+
+    n = 4
+    params, col, state = setup.rqp_setup(n)
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+    f_eq = centralized.equilibrium_forces(params)
+
+    def step_fn(precision):
+        cfg = cadmm.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=2, inner_iters=4, socp_fused="scan",
+            socp_precision=precision, pad_operators=True,
+        )
+        astate = cadmm.init_cadmm_state(params, cfg)
+        return (
+            lambda a, s: cadmm.control(params, cfg, f_eq, a, s, acc_des),
+            astate,
+        )
+
+    fn32, cs32 = step_fn("f32")
+    fn16, cs16 = step_fn("bf16")
+
+    def fresh_step_hlo(fn, cs):
+        jax.clear_caches()  # see fresh_trace above.
+        return _normalize_symbols(jax.jit(fn).lower(cs, state).as_text())
+
+    assert fresh_step_hlo(fn32, cs32) == fresh_step_hlo(fn16, cs16)
+
+
+def test_bf16_storage_close_to_f32():
+    """bf16-storage / f32-accumulation stays within bf16 mantissa
+    distance of the f32 solve (the operators carry ~8 mantissa bits; the
+    iterates and accumulation are full f32)."""
+    args = _problems()
+    ref = _solve_batch("scan", args, 30)
+    out = _solve_batch("kernel_interpret", args, 30, precision="bf16")
+    np.testing.assert_allclose(
+        np.asarray(out.x), np.asarray(ref.x), rtol=0, atol=3e-2
+    )
+    # And it is genuinely different from the f32 kernel (the cast is
+    # real, not dropped on the floor).
+    f32 = _solve_batch("kernel_interpret", args, 30)
+    assert float(jnp.max(jnp.abs(out.x - f32.x))) > 0.0
+
+
+def test_fused_solve_scope_in_lowered_program():
+    """The kernel dispatch is attributed under tat.fused_solve
+    (obs/phases.py vocabulary; op_profile --by-phase picks tat.* scopes
+    up generically, innermost wins inside tat.local_solve), and the
+    scope exists ONLY on the kernel path — scan stays scope-free there
+    (pure-metadata zero-cost rule)."""
+    from tpu_aerial_transport.obs import phases
+
+    assert phases.FUSED_SOLVE in phases.PHASES
+    Ps, qs, As, lbs, ubs, shifts = _problems(B=2)
+
+    def compiled(mode):
+        # Scopes live in op_name METADATA — present in the compiled
+        # HloModule text (what bench --profile dumps for op_profile's
+        # hlo_map), not in the metadata-stripped StableHLO dump.
+        return jax.jit(jax.vmap(
+            lambda P_, q_, A_, lb_, ub_, s_: socp.solve_socp_padded(
+                P_, q_, A_, lb_, ub_, n_box=6, soc_dims=(4,), iters=4,
+                shift=s_, fused=mode,
+            )
+        )).lower(Ps, qs, As, lbs, ubs, shifts).compile().as_text()
+
+    assert "tat.fused_solve" in compiled("kernel_interpret")
+    assert "tat.fused_solve" not in compiled("scan")
+
+
+def test_resolve_fused_env_gains_kernel(monkeypatch):
+    """TPU_AERIAL_FUSED gains the "kernel" value (non-CPU 'auto' only —
+    CPU still always resolves to scan)."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("TPU_AERIAL_FUSED", "kernel")
+    assert socp.resolve_fused("auto") == "kernel"
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert socp.resolve_fused("auto") == "scan"
+    # Explicit modes pass through untouched.
+    assert socp.resolve_fused("kernel") == "kernel"
+    assert socp.resolve_fused("kernel_interpret") == "kernel_interpret"
+
+
+def test_runtime_fused_mode_shared_resolver(monkeypatch):
+    """socp.runtime_fused_mode — the ONE resolver solve_socp's dispatch
+    and bench's fused_resolved labels share: junk modes are a clear
+    ValueError (not an opaque Mosaic failure), oversize dims label as the
+    scan fallback they actually run, and the off-TPU downgrade applies."""
+    with pytest.raises(ValueError):
+        socp.runtime_fused_mode("kernal", 16, 32)  # typo'd mode.
+    # Oversize: the VMEM-fits fallback is reflected in the label.
+    big = admm_kernel.MAX_FUSED_DIM * 4
+    assert socp.runtime_fused_mode("kernel_interpret", big, big) == "scan"
+    assert socp.runtime_fused_mode("pallas", big, big) == "scan"
+    # In-budget dims keep the kernel; "kernel" additionally downgrades
+    # off-TPU (this host) while the interpret twin runs anywhere.
+    assert socp.runtime_fused_mode("kernel_interpret", 16, 32, 24) \
+        == "kernel_interpret"
+    assert socp.runtime_fused_mode("kernel", 16, 32, 24) == "scan"
+    monkeypatch.setattr(socp, "_kernel_runs_offchip", lambda: False)
+    assert socp.runtime_fused_mode("kernel", 16, 32, 24) == "kernel"
+
+
+def test_resolve_precision_gate(monkeypatch):
+    """socp.resolve_precision: auto -> f32 (until the chip-round bf16
+    parity bars pass), TPU_AERIAL_PRECISION env force, junk raises."""
+    monkeypatch.delenv("TPU_AERIAL_PRECISION", raising=False)
+    assert socp.resolve_precision("auto") == "f32"
+    assert socp.resolve_precision(None) == "f32"
+    monkeypatch.setenv("TPU_AERIAL_PRECISION", "bf16")
+    assert socp.resolve_precision("auto") == "bf16"
+    assert socp.resolve_precision("f32") == "f32"  # explicit wins.
+    monkeypatch.setenv("TPU_AERIAL_PRECISION", "fp8")
+    with pytest.raises(ValueError):
+        socp.resolve_precision("auto")
+    with pytest.raises(ValueError):
+        socp.resolve_precision("int8")
+    # Config-build plumbing: the resolved value lands on the static field
+    # of BOTH controller configs (dd shares the base).
+    params, col, _ = setup.rqp_setup(4)
+    monkeypatch.setenv("TPU_AERIAL_PRECISION", "bf16")
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+    )
+    assert cfg.socp_precision == "bf16"
+    dcfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        socp_precision="f32",
+    )
+    assert dcfg.base.socp_precision == "f32"
+
+
+# ------------------------- bench bf16 A/B gate -------------------------
+
+
+def _patch_onchip(monkeypatch):
+    """Pretend the kernel path is live (no off-TPU downgrade) so the
+    gate logic is reachable on this CPU host."""
+    monkeypatch.setattr(socp, "_kernel_runs_offchip", lambda: False)
+
+
+def test_bench_bf16_gate_refuses_on_residual_bar(monkeypatch):
+    """bench._fused_ab_cell: a bf16 arm whose final consensus residual
+    fails the parity bar (>= 1e-2 N) REFUSES — the cell re-measures at
+    f32 and records the refusal on precision_resolved."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    _patch_onchip(monkeypatch)
+    calls = []
+
+    def fake_measure(controller, n, ns, fused, precision, n_steps=10):
+        calls.append(precision)
+        if precision == "bf16":
+            return 1000.0, 1.0, 0.5, 1e-2  # residual fails the bar.
+        return 800.0, 1.0, 2e-3, 1e-2
+
+    monkeypatch.setattr(bench, "_fused_measure", fake_measure)
+    v = bench._fused_ab_cell("cadmm", 16, 8, "kernel", precision="bf16")
+    assert calls == ["bf16", "f32"]
+    assert v["precision"] == "bf16"
+    assert v["precision_resolved"] == "f32"
+    assert v["bf16_refused"] is True
+    assert v["scenario_mpc_steps_per_sec"] == 800.0  # the usable rate.
+    assert v["bf16_rate_unusable"] == 1000.0
+    assert v["fused_resolved"] == "kernel"
+
+
+def test_bench_bf16_gate_inconclusive_when_f32_also_fails(monkeypatch):
+    """A cap-railed operating point (f32's own residual above the bar)
+    cannot indict bf16: the cell keeps the bf16 measurement and flags
+    the bar inconclusive instead of faking a refusal."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    _patch_onchip(monkeypatch)
+
+    def fake_measure(controller, n, ns, fused, precision, n_steps=10):
+        return (1000.0, 1.0, 0.5, 1e-2) if precision == "bf16" \
+            else (800.0, 1.0, 0.4, 1e-2)  # f32 fails the bar too.
+
+    monkeypatch.setattr(bench, "_fused_measure", fake_measure)
+    v = bench._fused_ab_cell("cadmm", 16, 8, "kernel", precision="bf16")
+    assert v["precision_resolved"] == "bf16"
+    assert v["res_bar_inconclusive"] is True
+    assert v["f32_final_consensus_res"] == 0.4
+    assert "bf16_refused" not in v
+    assert v["scenario_mpc_steps_per_sec"] == 1000.0
+
+
+def test_bench_bf16_gate_passes_under_bar(monkeypatch):
+    """The passing arm keeps bf16: one measurement, precision_resolved
+    stays bf16."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    _patch_onchip(monkeypatch)
+    monkeypatch.setattr(
+        bench, "_fused_measure",
+        lambda c, n, ns, f, p, n_steps=10: (1000.0, 1.0, 2e-3, 1e-2),
+    )
+    v = bench._fused_ab_cell("dd", 16, 8, "kernel", precision="bf16")
+    assert v["precision_resolved"] == "bf16"
+    assert "bf16_refused" not in v
+    assert v["final_consensus_res"] == 2e-3
+
+
+def test_bench_bf16_inert_on_cpu_rung(monkeypatch):
+    """Off-TPU (the real state of this host) the kernel downgrades to
+    scan, where the precision knob is inert: the cell must LABEL the
+    measurement f32/scan instead of claiming a bf16 rate."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_fused_measure",
+        lambda c, n, ns, f, p, n_steps=10: (700.0, 1.0, 2e-3, 1e-2),
+    )
+    v = bench._fused_ab_cell("cadmm", 16, 8, "kernel", precision="bf16")
+    assert v["fused_resolved"] == "scan"
+    assert v["precision_resolved"] == "f32"
+
+
+# --------------------------- run_health column -------------------------
+
+
+def test_run_health_solve_impl_column(tmp_path):
+    """The bench-health table renders a `solve impl` column from the
+    fused A/B cells' plain value fields — downgrades as kernel(scan),
+    bf16 refusals as /bf16(f32). Plain v4 fields, no schema bump."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import run_health
+
+    from tpu_aerial_transport.obs import export as export_mod
+
+    path = str(tmp_path / "rh.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("bench_cell", cell="cadmm_n16_fused_kernel",
+           value={"rung": "on-chip", "fused": "kernel",
+                  "fused_resolved": "kernel", "precision": "f32",
+                  "precision_resolved": "f32"})
+    w.emit("bench_cell", cell="cadmm_n16_fused_kernel_bf16",
+           value={"rung": "cpu-tagged", "fused": "kernel",
+                  "fused_resolved": "scan", "precision": "bf16",
+                  "precision_resolved": "f32"})
+    s = run_health.summarize(export_mod.read_events(path))
+    rows = {r[0]: r for r in s["backend"]["rungs"]}
+    assert rows["cadmm_n16_fused_kernel"][2] == "kernel"
+    assert rows["cadmm_n16_fused_kernel_bf16"][2] == "kernel(scan)/bf16(f32)"
+    # Ring cells keep their exchange-impl column untouched.
+    w.emit("bench_cell", cell="cadmm_n4_sharded_pallas_ring",
+           value={"rung": "cpu-tagged", "impl": "pallas_ring",
+                  "impl_resolved": "ring"})
+    s = run_health.summarize(export_mod.read_events(path))
+    rows = {r[0]: r for r in s["backend"]["rungs"]}
+    assert rows["cadmm_n4_sharded_pallas_ring"][1] == "pallas_ring(ring)"

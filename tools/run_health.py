@@ -236,11 +236,15 @@ def summarize(events: list[dict]) -> dict:
     # ACTUALLY ran at (bench cells carry it in their value dict, chunk
     # events as a top-level field).
     bevents = [e for e in events if e.get("event") == "backend_event"]
-    # (unit, impl, rung) rows: impl is the consensus-exchange impl the
-    # ring A/B cells (bench.py _sharded_ab_cell) carry in their value dict
-    # — "impl(resolved)" when a pallas_ring cell downgraded off-TPU. Plain
-    # v2 bench_cell fields; no schema change.
-    rungs: list[tuple[str, str, str]] = []
+    # (unit, impl, solve, rung) rows: impl is the consensus-exchange impl
+    # the ring A/B cells (bench.py _sharded_ab_cell) carry in their value
+    # dict — "impl(resolved)" when a pallas_ring cell downgraded off-TPU —
+    # and solve is the inner-solve impl the fused A/B cells
+    # (bench.py _fused_ab_cell) carry: the fused mode, rendered
+    # "kernel(scan)" when the whole-solve kernel downgraded off-TPU, with
+    # a "/bf16" (or "/bf16(f32)" after a parity-bar refusal) storage
+    # suffix. Plain v4 bench_cell value fields; no schema change.
+    rungs: list[tuple[str, str, str, str]] = []
     for e in cells:
         v = e.get("value")
         if isinstance(v, dict) and "rung" in v:
@@ -248,10 +252,18 @@ def summarize(events: list[dict]) -> dict:
             resolved = v.get("impl_resolved", impl)
             if resolved and resolved != impl:
                 impl = f"{impl}({resolved})"
-            rungs.append((e["cell"], impl, v["rung"]))
+            solve = v.get("fused", "")
+            fr = v.get("fused_resolved", solve)
+            if fr and fr != solve:
+                solve = f"{solve}({fr})"
+            prec = v.get("precision")
+            if prec and prec != "f32":
+                pr = v.get("precision_resolved", prec)
+                solve += f"/{prec}" if pr == prec else f"/{prec}({pr})"
+            rungs.append((e["cell"], impl, solve, v["rung"]))
     for e in chunks:
         if "rung" in e:
-            rungs.append((f"chunk {e['chunk']}", "", e["rung"]))
+            rungs.append((f"chunk {e['chunk']}", "", "", e["rung"]))
     if bevents or rungs:
         kinds: dict[str, int] = {}
         for e in bevents:
@@ -459,10 +471,11 @@ def render(summary: dict) -> None:
                       f"(ran at {e.get('rung', '?')}): "
                       f"{(e.get('detail') or '')[:120]}")
         if be["rungs"]:
-            print("\n| unit | exchange impl | rung |")
-            print("|---|---|---|")
-            for unit, impl, rung in be["rungs"]:
-                print(f"| {unit} | {impl or '—'} | {rung} |")
+            print("\n| unit | exchange impl | solve impl | rung |")
+            print("|---|---|---|---|")
+            for unit, impl, solve, rung in be["rungs"]:
+                print(f"| {unit} | {impl or '—'} | {solve or '—'} | "
+                      f"{rung} |")
 
 
 def _latency_stats(xs: list[float]) -> dict | None:

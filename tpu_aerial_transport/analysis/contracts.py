@@ -331,6 +331,80 @@ def _build_socp_interpret():
     return fn, make_args
 
 
+@_register("ops.admm_kernel:fused_solve_interpret")
+def _build_fused_solve_interpret():
+    """The whole-solve mega-kernel through the padded tier (the hot
+    callers' configuration): TC104 is ENFORCED here — no tile waiver —
+    because solve_socp_padded rounds every operator edge to the sublane
+    tile, so every long contraction the kernel stages (d, m_p) is
+    8-aligned by construction."""
+    from tpu_aerial_transport.ops import socp
+
+    def fn(P, q, A, lb, ub):
+        # The mega-kernel engages only under a batch axis (the unbatched
+        # path is plain scan — see socp._fused_solve_runner).
+        return jax.vmap(
+            lambda Pb, qb: socp.solve_socp_padded(
+                Pb, qb, A, lb, ub, n_box=6, soc_dims=(4,), iters=8,
+                fused="kernel_interpret",
+            )
+        )(P, q)
+
+    def make_args():
+        P, q, A, lb, ub = _socp_problem()
+        return (jnp.tile(P[None], (2, 1, 1)), jnp.tile(q[None], (2, 1)),
+                A, lb, ub)
+
+    return fn, make_args
+
+
+@_register(
+    "ops.admm_kernel:fused_solve_pallas",
+    lowering_only="Mosaic whole-solve kernel: no CPU execution — the "
+    "compiled broadcast-reduce body only runs on a TPU. Unlike the "
+    "remote-DMA ring it carries NO entrypoints.LOWERING_WAIVERS row: "
+    "jax.export AOT-lowers it cleanly for the tpu target on this image "
+    "(measured — the earlier vmapped-dot body died in Mosaic at the "
+    "batched dot_general, which is why the compiled form exists), so "
+    "TC106 is enforced.",
+)
+def _build_fused_solve_pallas():
+    """The REAL compiled kernel (interpret=False, exact_dot=False) on the
+    C-ADMM-shaped padded dims: if its Mosaic lowering ever regresses —
+    e.g. a jax upgrade rejecting the broadcast-reduce body — TC106 fails
+    tier-1 on this CPU box instead of wedging the chip round."""
+    import numpy as np
+
+    from tpu_aerial_transport.ops import admm_kernel
+
+    B, nv, m, n_box, soc_dims = 8, 16, 32, 24, (4, 4)
+    d = nv + m
+
+    def fn(K2, Minv, A, P, q, rho, lb, ub, shift, x, y, z):
+        return admm_kernel.fused_solve_lanes(
+            x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift,
+            nv=nv, n_box=n_box, soc_dims=soc_dims, iters=4, alpha=1.6,
+            interpret=False,
+        )
+
+    def make_args():
+        rng = np.random.default_rng(0)
+        f32 = jnp.float32
+        return (
+            jnp.asarray(rng.standard_normal((B, d, d)) * 0.1, f32),
+            jnp.asarray(rng.standard_normal((B, nv, nv)) * 0.1, f32),
+            jnp.asarray(rng.standard_normal((B, m, nv)) * 0.1, f32),
+            jnp.asarray(rng.standard_normal((B, nv, nv)) * 0.1, f32),
+            jnp.asarray(rng.standard_normal((B, nv)), f32),
+            jnp.ones((B, m), f32), -jnp.ones((B, n_box), f32),
+            jnp.ones((B, n_box), f32), jnp.zeros((B, m), f32),
+            jnp.zeros((B, nv), f32), jnp.zeros((B, m), f32),
+            jnp.zeros((B, m), f32),
+        )
+
+    return fn, make_args
+
+
 @_register("ops.socp:solve_socp_padded")
 def _build_socp_padded():
     from tpu_aerial_transport.ops import socp

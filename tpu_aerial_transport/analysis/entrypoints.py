@@ -47,7 +47,9 @@ TRACED_FUNCTIONS: dict[str, tuple[str, ...]] = {
         "hat", "hat_square", "expm_so3", "log_so3", "polar_project",
         "polar_project_svd", "rotation_from_z", "rotation_a_to_b",
     ),
-    "tpu_aerial_transport/ops/admm_kernel.py": ("admm_chunk_lanes",),
+    "tpu_aerial_transport/ops/admm_kernel.py": (
+        "admm_chunk_lanes", "fused_solve_lanes",
+    ),
     "tpu_aerial_transport/models/rqp.py": (
         "forward_dynamics", "integrate_state", "integrate",
     ),
@@ -101,6 +103,16 @@ CONTRACT_ENTRYPOINTS: dict[str, str] = {
         "tile-aligned conic-QP solve (padded-operator tier)",
     "ops.admm_kernel:solve_socp_interpret":
         "fused ADMM chunk kernel (Pallas, interpret mode)",
+    "ops.admm_kernel:fused_solve_interpret":
+        "whole-solve ADMM mega-kernel through solve_socp_padded "
+        "(fused='kernel_interpret': w2 build + iterations + residual "
+        "reduction in one pallas_call, interpret mode — the bitwise-vs-"
+        "scan twin; TC104-enforced on the padded tier)",
+    "ops.admm_kernel:fused_solve_pallas":
+        "whole-solve ADMM mega-kernel, compiled broadcast-reduce form "
+        "(fused_solve_lanes interpret=False — TPU-only execution; TC106 "
+        "off-chip jax.export lowering ENFORCED, no waiver: the compiled "
+        "form AOT-lowers cleanly for the tpu target on this image)",
     "harness.rollout:rollout": "nominal two-rate receding-horizon rollout",
     "harness.rollout:rollout_donated":
         "donation-clean jitted rollout (carries updated in place)",

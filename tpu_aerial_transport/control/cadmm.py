@@ -135,10 +135,24 @@ class RQPCADMMConfig:
     solve_retry_iters: int = struct.field(pytree_node=False, default=4)
     max_f_ang: float = struct.field(pytree_node=False, default=jnp.pi / 6)
     # Inner-chunk execution mode forwarded to ops/socp.py solve_socp
-    # ("auto" | "scan" | "pallas" | "interpret"): "pallas" runs each fixed-
-    # iteration ADMM chunk as one fused TPU kernel with the per-agent
-    # operators VMEM-resident (ops/admm_kernel.py).
+    # ("auto" | "scan" | "pallas" | "interpret" | "kernel" |
+    # "kernel_interpret"): "pallas" runs each fixed-iteration ADMM chunk as
+    # one fused TPU kernel with the per-agent operators VMEM-resident
+    # (ops/admm_kernel.py); "kernel" runs the WHOLE inner solve — w2
+    # build + every iteration + exit residuals — as one mega-kernel
+    # (admm_kernel.fused_solve_lanes; downgrades to scan off-TPU at trace
+    # time, so the same config serves CPU fallbacks). The sharded mesh,
+    # pods, and serving tiers inherit whichever mode this field holds with
+    # zero extra plumbing — it rides the config into every solve_socp call.
     socp_fused: str = struct.field(pytree_node=False, default="auto")
+    # Operator storage precision on the "kernel" fused paths ("f32" |
+    # "bf16" = bf16-storage / f32-accumulation of the per-agent KKT
+    # operators — halves the kernel's HBM payload). Resolved at config
+    # build time (socp.resolve_precision; "auto" -> f32 until the chip
+    # round's *_fused_kernel_bf16 A/B cells pass the consensus-residual
+    # parity bar). Inert off the kernel paths — the scan program is
+    # bit-identical under either value (asserted).
+    socp_precision: str = struct.field(pytree_node=False, default="f32")
     # Tolerance-chunked inner solves: when inner_tol > 0, each agent QP runs
     # its ADMM iterations in chunks of ``inner_check_every`` and stops as
     # soon as primal AND dual residuals drop below ``inner_tol`` (ops/socp.py
@@ -193,6 +207,7 @@ def make_config(
     tau_incr: float = 1.0,
     rho_max: float = 2.0,
     socp_fused: str = "auto",
+    socp_precision: str = "auto",
     inner_tol: float = 0.0,
     inner_check_every: int = 10,
     solve_retry_iters: int = 4,
@@ -255,6 +270,9 @@ def make_config(
         # Resolved here (config build time, outside jit) so the mode is an
         # explicit static field rather than a trace-time backend probe.
         socp_fused=socp.resolve_fused(socp_fused),
+        # "auto" resolved here too (socp.resolve_precision: env force,
+        # else f32 until the chip-round bf16 parity bars pass).
+        socp_precision=socp.resolve_precision(socp_precision),
         inner_tol=inner_tol,
         inner_check_every=inner_check_every,
         solve_retry_iters=solve_retry_iters,
@@ -1180,6 +1198,7 @@ def control(
                 P_, q_, A_, lb_, ub_,
                 n_box=n_box, soc_dims=(4, 4), iters=iters,
                 warm=warm_, shift=shift_, op=op_, fused=cfg.socp_fused,
+                precision=cfg.socp_precision,
                 tol=cfg.inner_tol,
                 check_every=(cfg.inner_check_every if cfg.inner_tol > 0
                              else 0),

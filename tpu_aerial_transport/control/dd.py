@@ -67,6 +67,7 @@ def make_config(
     k_smooth: float = 0.0,
     dt: float = 1e-3,
     socp_fused: str = "auto",
+    socp_precision: str = "auto",
     inner_tol: float = 0.0,
     inner_check_every: int = 10,
     solve_retry_iters: int = 4,
@@ -94,6 +95,7 @@ def make_config(
         params, collision_radius, max_deceleration,
         n_env_cbfs=n_env_cbfs, max_iter=max_iter, inner_iters=inner_iters,
         k_smooth=k_smooth, dt=dt, socp_fused=socp_fused,
+        socp_precision=socp_precision,
         inner_tol=inner_tol, inner_check_every=inner_check_every,
         solve_retry_iters=solve_retry_iters, pad_operators=pad_operators,
         track_agent_stats=track_agent_stats,
@@ -617,6 +619,7 @@ def control(
             P_, q_, A_, lb_, ub_,
             n_box=n_box, soc_dims=(4, 4), iters=base.inner_iters,
             warm=warm_, shift=shift_, op=op_, fused=base.socp_fused,
+            precision=base.socp_precision,
             tol=base.inner_tol,
             check_every=(base.inner_check_every if base.inner_tol > 0
                          else 0),

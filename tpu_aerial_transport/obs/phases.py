@@ -22,6 +22,12 @@ PREFIX = "tat."
 QP_BUILD = "qp_build"          # per-agent QP matrix assembly + KKT ops.
 CBF_ROWS = "cbf_rows"          # env CBF row construction (forest sweep).
 LOCAL_SOLVE = "local_solve"    # per-agent conic QP solves (inner ADMM).
+FUSED_SOLVE = "fused_solve"    # whole-solve ADMM mega-kernel dispatch
+#                                (ops/admm_kernel.fused_solve_lanes via
+#                                solve_socp fused="kernel"; nested inside
+#                                tat.local_solve — innermost wins, so the
+#                                kernel's share separates from the XLA-side
+#                                solve plumbing around it).
 CONSENSUS = "consensus"        # consensus mean/residual all-reduce.
 CONSENSUS_EXCHANGE = "consensus_exchange"  # the cross-device exchange itself
 #                                (psum/ppermute/ring kernel; parallel/ring.py).
@@ -40,9 +46,9 @@ PODS_STEP = "pods_step"        # 2-D (scenario, agent) pods-mesh shard_map
 #                                controllers' fine scopes inside win.
 
 PHASES = (
-    QP_BUILD, CBF_ROWS, LOCAL_SOLVE, CONSENSUS, CONSENSUS_EXCHANGE,
-    DUAL_UPDATE, DYNAMICS, PAD, FAULTS, FALLBACK, TELEMETRY, SHARDED_STEP,
-    SERVING_CHUNK, PODS_STEP,
+    QP_BUILD, CBF_ROWS, LOCAL_SOLVE, FUSED_SOLVE, CONSENSUS,
+    CONSENSUS_EXCHANGE, DUAL_UPDATE, DYNAMICS, PAD, FAULTS, FALLBACK,
+    TELEMETRY, SHARDED_STEP, SERVING_CHUNK, PODS_STEP,
 )
 
 

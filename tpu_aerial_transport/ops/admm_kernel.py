@@ -47,17 +47,82 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANE_TILE = 128
 SUBLANE_TILE = 8  # f32 sublane tile; see ops/socp.py's padded-operator tier.
+
+# VMEM residency budget the fused kernels size themselves against. 16 MB is
+# the per-core VMEM of the current TPU generations (v4/v5e/v5p all ship
+# 16 MB cores); ~2 MB is held back for Mosaic's own scratch, semaphores,
+# and the non-operator vectors, leaving 14 MB for the double-buffered
+# operator blocks the grid pipeline keeps in flight. Both fused kernels'
+# size guards (:data:`MAX_FUSED_DIM` for the chunk kernel,
+# :func:`fused_solve_fits` for the whole-solve kernel) are DERIVED from
+# this bound + the tile math below — change the budget here, not the
+# guards (they were hand-recomputed once per layout change before this,
+# 96 -> 112 at the padded-operator tier, and drifted).
+VMEM_BYTES = 16 * 2**20
+VMEM_BUDGET_BYTES = 14 * 2**20
+
+
+def _max_dim_under(bytes_per_lane, lanes: int = LANE_TILE,
+                   budget: int = VMEM_BUDGET_BYTES) -> int:
+    """Largest operator edge ``d`` (a SUBLANE_TILE multiple — the padded
+    tier guarantees callers' edges are) whose per-grid-cell residency,
+    DOUBLE-buffered by the pallas pipeline (the next cell's blocks prefetch
+    while the current cell computes), stays under ``budget``:
+
+        2 x bytes_per_lane(d) x lanes <= budget.
+    """
+    d = SUBLANE_TILE
+    while 2 * bytes_per_lane(d + SUBLANE_TILE) * lanes <= budget:
+        d += SUBLANE_TILE
+    return d
+
+
+def chunk_kernel_bytes_per_lane(d: int) -> int:
+    """Per-lane VMEM bytes of the chunk kernel's dominant resident: the
+    (d, d) f32 K2 operator (the O(d) vectors ride inside the budget's
+    2 MB holdback)."""
+    return 4 * d * d
+
+
 # Above this operator edge the per-lane K2 tile no longer fits VMEM
-# residency (block bytes = 4 d^2 LANE_TILE, double-buffered by the pipeline;
-# d = 450 for centralized n = 64 would need ~100 MB): callers fall back to
-# scan. Recomputed for the PADDED operator tier (ops/socp.py pad_qp rounds
-# every edge to SUBLANE_TILE, so the hot dims are now d = 48 for the
-# reduced C-ADMM QPs and d = 56 for DD at the default 10 env-CBF rows, and
-# every block is exact-tile (d % 8 == 0 sublanes x LANE_TILE lanes) —
-# no Mosaic-side row padding): the budget is ~14 MB of the ~16 MB VMEM for
-# the double-buffered K2 blocks, 2 x 4 d^2 x 128 <= 14 MB -> d <= 116,
-# rounded DOWN to the sublane tile.
-MAX_FUSED_DIM = 112
+# residency (d = 450 for centralized n = 64 would need ~100 MB): callers
+# fall back to scan. Derived from the budget above — with the PADDED
+# operator tier (ops/socp.py pad_qp rounds every edge to SUBLANE_TILE, so
+# the hot dims are d = 48 for the reduced C-ADMM QPs and d = 56 for DD at
+# the default 10 env-CBF rows, every block exact-tile) the derivation
+# gives 2 x 4 d^2 x 128 <= 14 MB -> d <= 119, floored to the sublane
+# tile = 112, matching the value hand-recomputed at the padded tier
+# (tests/test_fused_solve.py pins the boundary).
+MAX_FUSED_DIM = _max_dim_under(chunk_kernel_bytes_per_lane)
+
+# Folded-batch tile of the whole-solve kernel (one grid cell = this many
+# lanes of the agent x scenario batch, batch-FIRST blocks — see
+# fused_solve_lanes).
+SOLVE_BATCH_TILE = LANE_TILE
+
+
+def fused_solve_bytes_per_lane(nv: int, m: int, n_box: int) -> int:
+    """Per-lane f32 VMEM bytes of the whole-solve kernel's residents: the
+    iterated K2 ((d, d)) plus the qp-build/residual operators fused in —
+    Minv and P ((nv, nv) each), A ((m, nv)) — and the per-lane vectors
+    (q, rho, bounds, shift, the (x, y, z) carry and its output twin, the
+    2-wide residual row)."""
+    d = nv + m
+    mats = d * d + 2 * nv * nv + m * nv
+    vecs = nv + m + 2 * n_box + m + (nv + 2 * m)
+    outs = (nv + 2 * m) + 2
+    return 4 * (mats + vecs + outs)
+
+
+def fused_solve_fits(nv: int, m: int, n_box: int | None = None) -> bool:
+    """Whether one (nv, m) solve's operators fit the whole-solve kernel's
+    double-buffered VMEM residency at :data:`SOLVE_BATCH_TILE` lanes per
+    grid cell (the :data:`MAX_FUSED_DIM` criterion, recomputed for this
+    kernel's larger resident set). Callers above the bound fall back to
+    scan (ops/socp.py applies the guard at trace time)."""
+    n_box = m if n_box is None else n_box
+    return (2 * fused_solve_bytes_per_lane(nv, m, n_box) * SOLVE_BATCH_TILE
+            <= VMEM_BUDGET_BYTES)
 
 
 def _admm_chunk_kernel(
@@ -207,3 +272,264 @@ def admm_chunk_lanes(
 
     unT = lambda a: jnp.moveaxis(a, -1, 0)[:B]
     return unT(xo), unT(yo), unT(zo)
+
+
+# ----------------------------------------------------------------------
+# Whole-solve mega-kernel: qp-build tail + fused K2 iteration + cone
+# projection + residual reduction in ONE pallas_call (ops/socp.py
+# fused="kernel" / "kernel_interpret").
+# ----------------------------------------------------------------------
+
+def _fused_solve_kernel(
+    K2_ref, Minv_ref, A_ref, P_ref, q_ref, rho_ref, lb_ref, ub_ref,
+    shift_ref, x0_ref, y0_ref, z0_ref,
+    xo_ref, yo_ref, zo_ref, res_ref,
+    *, nv: int, n_box: int, soc_dims: tuple, iters: int, alpha: float,
+    has_shift: bool, exact_dot: bool,
+):
+    """One grid cell: a SOLVE_BATCH_TILE-wide slab of complete ADMM solves.
+
+    Blocks are batch-FIRST (``(T, rows...)`` with T = SOLVE_BATCH_TILE).
+    Two realizations of the per-lane matvecs, selected by the static
+    ``exact_dot``:
+
+    - ``exact_dot=True`` (the interpret twin): the body is ``jax.vmap`` of
+      the scan path's own per-instance functions (``socp._admm_step``, the
+      w2 build, the residual inf-norms), so the traced per-lane ops —
+      dot_generals with a leading batch dim, elementwise projections — are
+      IDENTICAL to what the controllers' nested vmaps stage around
+      ``lax.scan``. Interpret mode is therefore bitwise-equal to the scan
+      path per iteration BY CONSTRUCTION (asserted in
+      tests/test_fused_solve.py), not by tolerance. Mosaic cannot lower
+      this form ("Only 2D tensors supported in dot" at the batched
+      dot_general — measured via jax.export on this image), so it is the
+      interpreter-only twin.
+    - ``exact_dot=False`` (the compiled form): the same math with every
+      per-lane matvec transcribed to a broadcast-multiply + last-axis
+      reduction (``sum(M * v[:, None, :], -1)``) — the chunk kernel's VPU
+      idiom, which jax.export AOT-lowers cleanly for the TPU target
+      (measured on this image; the entry carries NO lowering waiver).
+      Same order of operations per lane up to the reduction order of the
+      matvec accumulations, so it agrees with the reference to f32
+      rounding — the numerics contract the chunk kernel already set; its
+      numerics stay CPU-testable by running it under the interpreter
+      (``fused_solve_lanes(..., interpret=True, exact_dot=False)``).
+
+    On a real chip Mosaic maps the leading batch dim to the grid-cell-
+    internal loop and the trailing (rows, cols) dims to (sublane, lane)
+    tiles — the padded tier's d % 8 == 0 edges keep the sublane axis
+    exact-tile. If the chip round shows the lanes-last layout scheduling
+    better, it becomes a variant behind the same gate and the A/B cells
+    arbitrate.
+
+    What is resident per lane across ALL ``iters`` iterations (read from
+    HBM exactly once per solve instead of once per iteration): K2
+    ((d, d) — the iterated operator), Minv + A (the per-iteration
+    qp-build tail ``w2 = [Minv q; A Minv q]`` runs on-chip), P + A again
+    for the exit residuals. bf16 storage (fused_solve_lanes
+    ``precision="bf16"``) halves the operator payload; the kernel upcasts
+    to f32 before every contraction, so accumulation is always f32.
+    """
+    f32 = jnp.float32
+    K2 = K2_ref[...].astype(f32)
+    Minv = Minv_ref[...].astype(f32)
+    A = A_ref[...].astype(f32)
+    P = P_ref[...].astype(f32)
+    q = q_ref[...]
+    rho = rho_ref[...]
+    lb = lb_ref[...]
+    ub = ub_ref[...]
+    shift = shift_ref[...] if has_shift else None
+
+    from tpu_aerial_transport.ops import socp as socp_mod
+
+    if exact_dot:
+        # qp-build tail, fused: w2 = [Minv q ; A Minv q] — the same two
+        # matvecs solve_socp's scan path runs in XLA once per solve call
+        # (i.e. once per consensus iteration), vmapped over the lane slab.
+        def build_w2(Minv_, A_, q_):
+            wq = Minv_ @ q_
+            return jnp.concatenate([wq, A_ @ wq])
+
+        w2 = jax.vmap(build_w2)(Minv, A, q)
+
+        step = functools.partial(
+            socp_mod._admm_step, nv=nv, n_box=n_box,
+            soc_dims=tuple(soc_dims), alpha=alpha,
+        )
+        if has_shift:
+            vstep = jax.vmap(
+                lambda c, K2_, w2_, rho_, lb_, ub_, s_:
+                step(c, K2_, w2_, rho_, lb_, ub_, s_)
+            )
+
+            def body(_, carry):
+                return vstep(carry, K2, w2, rho, lb, ub, shift)
+        else:
+            vstep = jax.vmap(
+                lambda c, K2_, w2_, rho_, lb_, ub_:
+                step(c, K2_, w2_, rho_, lb_, ub_, None)
+            )
+
+            def body(_, carry):
+                return vstep(carry, K2, w2, rho, lb, ub)
+
+        def res_pair(x, y, z):
+            def res_one(A_, P_, q_, x_, y_, z_):
+                prim = jnp.max(jnp.abs(A_ @ x_ - z_))
+                dual = jnp.max(jnp.abs(P_ @ x_ + q_ + A_.T @ y_))
+                return prim, dual
+
+            return jax.vmap(res_one)(A, P, q, x, y, z)
+    else:
+        # Compiled transcription: per-lane matvec as broadcast-multiply +
+        # last-axis reduction. The cone projection is batch-generic
+        # (elementwise + last-axis concatenates), so the REAL
+        # socp._project_cone runs here, not a copy.
+        def mv(M, v):  # (T, r, c) x (T, c) -> (T, r)
+            return jnp.sum(M * v[:, None, :], axis=-1)
+
+        wq = mv(Minv, q)
+        w2 = jnp.concatenate([wq, mv(A, wq)], axis=-1)
+
+        def body(_, carry):
+            x, y, z = carry
+            u = jnp.concatenate([x, rho * z - y], axis=-1)
+            v = mv(K2, u) - w2
+            x_new, Ax = v[:, :nv], v[:, nv:]
+            Ax_rel = alpha * Ax + (1 - alpha) * z
+            z_new = socp_mod._project_cone(
+                Ax_rel + y / rho, lb, ub, n_box, tuple(soc_dims), shift
+            )
+            y_new = y + rho * (Ax_rel - z_new)
+            return (x_new, y_new, z_new)
+
+        def res_pair(x, y, z):
+            prim = jnp.max(jnp.abs(mv(A, x) - z), axis=-1)
+            # A^T y per lane: reduce A's row axis against y.
+            ATy = jnp.sum(A * y[:, :, None], axis=1)
+            dual = jnp.max(jnp.abs(mv(P, x) + q + ATy), axis=-1)
+            return prim, dual
+
+    x, y, z = lax.fori_loop(
+        0, iters, body, (x0_ref[...], y0_ref[...], z0_ref[...]),
+        unroll=False,
+    )
+    xo_ref[...] = x
+    yo_ref[...] = y
+    zo_ref[...] = z
+
+    # Residual reduction (solve_socp's exit ``residuals`` — max is
+    # order-exact under any schedule).
+    prim, dual = res_pair(x, y, z)
+    res_ref[...] = jnp.stack([prim, dual], axis=-1)
+
+
+def _pad_batch(a, B_pad, fill=0.0):
+    B = a.shape[0]
+    if B == B_pad:
+        return a
+    pad = [(0, B_pad - B)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, pad, constant_values=fill)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("nv", "n_box", "soc_dims", "iters", "alpha",
+                     "precision", "interpret", "exact_dot"),
+)
+def fused_solve_lanes(
+    x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift=None,
+    *, nv: int, n_box: int, soc_dims: tuple, iters: int, alpha: float,
+    precision: str = "f32", interpret: bool = False,
+    exact_dot: bool | None = None,
+):
+    """Run whole batched solves through :func:`_fused_solve_kernel`: args
+    are batch-first ``(B, rows...)`` as produced by the vmap folding in
+    ops/socp.py's ``_fused_solve_runner``; returns
+    ``(x, y, z, prim_res, dual_res)`` batch-first. ``exact_dot`` defaults
+    to ``interpret`` — the bitwise vmapped-dot body under the interpreter,
+    the Mosaic-lowerable broadcast-reduce body when compiled (see the
+    kernel docstring); pass it explicitly to test the compiled form's
+    numerics under the interpreter.
+
+    ``precision="bf16"`` stores the operator matrices (K2, Minv, A, P) in
+    bfloat16 — halving the HBM->VMEM operator payload, the dominant
+    traffic of the bandwidth-bound inner loop — while every contraction
+    accumulates in f32 (the kernel upcasts before use). Vectors
+    (q, rho, bounds, carries) stay f32: they are O(d) against the O(d^2)
+    operators, and the carry is the precision-critical fixed-point state.
+
+    Padded lanes (B rounded up to SOLVE_BATCH_TILE) run on zero operators
+    with rho = 1 — every intermediate stays finite — and are sliced off.
+    """
+    B = x.shape[0]
+    m = rho.shape[-1]
+    d = nv + m
+    has_shift = shift is not None
+    if exact_dot is None:
+        exact_dot = interpret
+    B_pad = max(
+        SOLVE_BATCH_TILE,
+        ((B + SOLVE_BATCH_TILE - 1) // SOLVE_BATCH_TILE) * SOLVE_BATCH_TILE,
+    )
+    if precision not in ("f32", "bf16"):
+        raise ValueError(
+            f"precision={precision!r}: expected 'f32' or 'bf16'"
+        )
+    dtype = x.dtype
+    store = jnp.bfloat16 if precision == "bf16" else dtype
+
+    K2p = _pad_batch(K2.astype(store), B_pad)
+    Minvp = _pad_batch(Minv.astype(store), B_pad)
+    Ap = _pad_batch(A.astype(store), B_pad)
+    Pp = _pad_batch(P.astype(store), B_pad)
+    qp_ = _pad_batch(q, B_pad)
+    rhop = _pad_batch(rho, B_pad, 1.0)
+    lbp = _pad_batch(lb, B_pad)
+    ubp = _pad_batch(ub, B_pad)
+    xp = _pad_batch(x, B_pad)
+    yp = _pad_batch(y, B_pad)
+    zp = _pad_batch(z, B_pad)
+    inputs = [K2p, Minvp, Ap, Pp, qp_, rhop, lbp, ubp]
+    if has_shift:
+        inputs.append(_pad_batch(shift, B_pad))
+    else:
+        # Unread placeholder (has_shift is static): keeps the kernel's ref
+        # list fixed-arity without staging a z + 0 add that could flip
+        # signed zeros vs the scan path's shift=None branch.
+        inputs.append(jnp.zeros((B_pad, m), dtype))
+    inputs += [xp, yp, zp]
+
+    grid = (B_pad // SOLVE_BATCH_TILE,)
+
+    def spec(rows):
+        shape = (SOLVE_BATCH_TILE,) + rows
+        ntrail = len(rows)
+        return pl.BlockSpec(
+            shape, lambda i: (i,) + (0,) * ntrail, memory_space=pltpu.VMEM
+        )
+
+    kernel = functools.partial(
+        _fused_solve_kernel,
+        nv=nv, n_box=n_box, soc_dims=tuple(soc_dims), iters=iters,
+        alpha=alpha, has_shift=has_shift, exact_dot=exact_dot,
+    )
+    xo, yo, zo, res = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            spec((d, d)), spec((nv, nv)), spec((m, nv)), spec((nv, nv)),
+            spec((nv,)), spec((m,)), spec((n_box,)), spec((n_box,)),
+            spec((m,)), spec((nv,)), spec((m,)), spec((m,)),
+        ],
+        out_specs=[spec((nv,)), spec((m,)), spec((m,)), spec((2,))],
+        out_shape=[
+            jax.ShapeDtypeStruct((B_pad, nv), dtype),
+            jax.ShapeDtypeStruct((B_pad, m), dtype),
+            jax.ShapeDtypeStruct((B_pad, m), dtype),
+            jax.ShapeDtypeStruct((B_pad, 2), dtype),
+        ],
+        interpret=interpret,
+    )(*inputs)
+    return xo[:B], yo[:B], zo[:B], res[:B, 0], res[:B, 1]

@@ -72,10 +72,29 @@ INF = 1e20  # "infinity" bound; keeps arithmetic finite in f32... used via clipp
 SUBLANE_TILE = 8
 
 # What ``fused="auto"`` resolves to on a non-CPU backend when the
-# TPU_AERIAL_FUSED env var does not say otherwise. Stays "scan" until the
-# Pallas chunk kernel is validated on the real chip; the A/B criterion for
-# flipping the default is in :func:`resolve_fused`'s docstring.
+# TPU_AERIAL_FUSED env var does not say otherwise. Stays "scan" until a
+# Pallas tier (the chunk kernel or the whole-solve mega-kernel) is
+# validated on the real chip; the A/B criteria for flipping the default
+# are in :func:`resolve_fused`'s docstring.
 _AUTO_FUSED_NONCPU = "scan"
+
+# The full fused-mode vocabulary ``solve_socp`` accepts. "pallas" /
+# "interpret" run the fixed-iteration chunks through the lanes-last chunk
+# kernel (ops/admm_kernel.py admm_chunk_lanes; K2 resident across one
+# chunk); "kernel" / "kernel_interpret" run the WHOLE solve — per-solve w2
+# build, every iteration's K2 apply + cone projection, and the exit
+# residual reduction — through the batch-first mega-kernel
+# (admm_kernel.fused_solve_lanes; all operators resident across the full
+# inner budget). The *_interpret twins are the CPU-testable Pallas
+# interpreter realizations of the same kernels.
+FUSED_MODES = ("auto", "scan", "pallas", "interpret", "kernel",
+               "kernel_interpret")
+
+# Storage precision of the fused-kernel operator payload (see
+# :func:`resolve_precision`): "f32", or "bf16" = bf16-storage /
+# f32-accumulation of K2/Minv/A/P on the "kernel" paths (inert on
+# scan/pallas — asserted HLO-identical in tests/test_fused_solve.py).
+PRECISIONS = ("f32", "bf16")
 
 
 class KKTOp(NamedTuple):
@@ -265,7 +284,7 @@ def padded_kkt_operator(P, A, lb, ub, shift=None, *, n_box: int,
 @partial(
     jax.jit,
     static_argnames=("n_box", "soc_dims", "iters", "check_every", "tol",
-                     "fused", "alpha", "rho", "sigma"),
+                     "fused", "alpha", "rho", "sigma", "precision"),
 )
 def solve_socp_padded(
     P: jnp.ndarray,
@@ -286,6 +305,7 @@ def solve_socp_padded(
     shift: jnp.ndarray | None = None,
     pqp: PaddedKKTOp | None = None,
     fused: str = "auto",
+    precision: str = "f32",
 ) -> SOCPSolution:
     """Tile-aligned :func:`solve_socp`: pads the problem to its bucket
     (:func:`padded_dims`), solves on the padded layout, and returns the
@@ -309,6 +329,7 @@ def solve_socp_padded(
         n_box=n_box_p, soc_dims=tuple(soc_dims), iters=iters, rho=rho,
         sigma=sigma, alpha=alpha, warm=warm_p, check_every=check_every,
         tol=tol, shift=pqp.shift, op=pqp.op, fused=fused,
+        precision=precision,
     )
     return unpad_solution(sol, nv, n_box, n_box_p)
 
@@ -433,15 +454,91 @@ def _fused_chunk_runner(nv: int, n_box: int, soc_dims: tuple, iters: int,
     return single
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_solve_runner(nv: int, n_box: int, soc_dims: tuple, iters: int,
+                        alpha: float, interpret: bool, has_shift: bool,
+                        precision: str, with_res: bool):
+    """Build the vmap-folding runner for the WHOLE-solve mega-kernel
+    (admm_kernel.fused_solve_lanes — fused="kernel"/"kernel_interpret").
+
+    Returns ``(x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift) ->
+    (x, y, z[, prim_res, dual_res])`` running the per-solve w2 build,
+    ``iters`` ADMM iterations, and (``with_res``) the exit residual
+    reduction in one kernel. Same batching discipline as
+    :func:`_fused_chunk_runner`: unbatched calls take the plain scan path
+    (a lone solve gains nothing from a kernel); every enclosing ``vmap``
+    axis — agents, then Monte-Carlo scenarios — FOLDS into the kernel's
+    leading batch axis via the recursive ``custom_vmap`` pair. ``shift``
+    is a fixed-arity placeholder when ``has_shift`` is False (the scan
+    twin and the kernel both skip the cone-shift adds statically, so a
+    shiftless solve cannot pick up ``z + 0`` signed-zero flips)."""
+    from tpu_aerial_transport.ops import admm_kernel
+
+    kw = dict(nv=nv, n_box=n_box, soc_dims=soc_dims, alpha=alpha)
+    n_out = 5 if with_res else 3
+
+    @jax.custom_batching.custom_vmap
+    def batched(x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift):
+        # Leading batch axis on every arg.
+        xo, yo, zo, prim, dual = admm_kernel.fused_solve_lanes(
+            x, y, z, K2, Minv, A, P, q, rho, lb, ub,
+            shift if has_shift else None,
+            iters=iters, precision=precision, interpret=interpret, **kw,
+        )
+        if with_res:
+            return xo, yo, zo, prim, dual
+        return xo, yo, zo
+
+    @batched.def_vmap
+    def _batched_rule(axis_size, in_batched, *args):
+        # Fold the new (leading) vmap axis into the existing batch axis.
+        folded = []
+        for a, b in zip(args, in_batched):
+            if not b:
+                a = jnp.broadcast_to(a[None], (axis_size,) + a.shape)
+            folded.append(a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]))
+        outs = batched(*folded)
+        unfold = lambda o: o.reshape((axis_size, -1) + o.shape[1:])
+        return tuple(unfold(o) for o in outs), (True,) * n_out
+
+    @jax.custom_batching.custom_vmap
+    def single(x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift):
+        # The scan path's own per-instance program (bitwise twin of the
+        # kernel body's vmapped functions).
+        wq = Minv @ q
+        w2 = jnp.concatenate([wq, A @ wq])
+        s = shift if has_shift else None
+
+        def stepf(c, _):
+            return _admm_step(c, K2, w2, rho, lb, ub, s, **kw), None
+
+        x, y, z = lax.scan(stepf, (x, y, z), None, length=iters)[0]
+        if with_res:
+            prim = jnp.max(jnp.abs(A @ x - z))
+            dual = jnp.max(jnp.abs(P @ x + q + A.T @ y))
+            return x, y, z, prim, dual
+        return x, y, z
+
+    @single.def_vmap
+    def _single_rule(axis_size, in_batched, *args):
+        lifted = [
+            a if b else jnp.broadcast_to(a[None], (axis_size,) + a.shape)
+            for a, b in zip(args, in_batched)
+        ]
+        return batched(*lifted), (True,) * n_out
+
+    return single
+
+
 def resolve_fused(fused: str) -> str:
     """Resolve ``"auto"`` to the backend default: "scan" on CPU (the Pallas
-    kernel has no useful CPU lowering); elsewhere the ``TPU_AERIAL_FUSED``
-    env var (``pallas`` | ``scan`` | ``auto``/unset) and then the in-code
-    default ``_AUTO_FUSED_NONCPU``. Controllers call this at CONFIG BUILD
-    time (outside jit) so the chosen mode is an explicit static config field
-    — resolving inside a jitted function would bake the first backend seen
-    into a trace cache keyed only on the "auto" string (stale if the
-    process later switches platforms).
+    kernels have no useful CPU lowering); elsewhere the ``TPU_AERIAL_FUSED``
+    env var (``pallas`` | ``scan`` | ``kernel`` | ``auto``/unset) and then
+    the in-code default ``_AUTO_FUSED_NONCPU``. Controllers call this at
+    CONFIG BUILD time (outside jit) so the chosen mode is an explicit
+    static config field — resolving inside a jitted function would bake
+    the first backend seen into a trace cache keyed only on the "auto"
+    string (stale if the process later switches platforms).
 
     **A/B criterion for flipping the non-CPU default to "pallas"** (kept
     here so the ops A/B and the flip live together): on a live chip,
@@ -453,6 +550,21 @@ def resolve_fused(fused: str) -> str:
     opt in per-process with ``TPU_AERIAL_FUSED=pallas`` (or per-config via
     ``socp_fused="pallas"``) without a code change.
 
+    **A/B criterion for flipping the non-CPU default to "kernel"** (the
+    whole-solve mega-kernel, admm_kernel.fused_solve_lanes): on a live
+    chip, (1) the interpret-parity suite (tests/test_fused_solve.py) stays
+    green and the on-chip kernel/scan solutions agree to the same f32 bar,
+    (2) the sweep's ``{cadmm,dd}_n{16,64}_fused_kernel`` cells beat their
+    ``_fused_scan`` twins by >= 15% (it must beat the chunk kernel too, or
+    "pallas" wins instead), and (3) ``op_profile --by-phase`` shows the
+    local_solve + qp_build share of op self-time (84% on the round-1
+    headline trace) shrinking — the HBM re-read traffic the kernel exists
+    to delete actually went away. The ``_fused_kernel_bf16`` twins
+    additionally require the consensus-residual parity bar
+    (< the config's res_tol, the paper's 1e-2 N) before bf16 storage can
+    default anywhere — bench.py's bf16 arm refuses (re-measures at f32)
+    when that bar fails.
+
     The env var is consulted HERE only — i.e. at config-build time, the
     documented resolution point. ``solve_socp`` called directly with
     ``fused="auto"`` resolves backend-only (:func:`_resolve_fused`): an
@@ -463,25 +575,109 @@ def resolve_fused(fused: str) -> str:
     (or pass an explicit mode)."""
     if fused == "auto" and jax.default_backend() != "cpu":
         env = os.environ.get("TPU_AERIAL_FUSED", "").strip().lower()
-        if env in ("pallas", "scan"):
+        if env in ("pallas", "scan", "kernel"):
             return env
         if env not in ("", "auto"):
             raise ValueError(
-                f"TPU_AERIAL_FUSED={env!r}: expected 'pallas', 'scan' or "
-                "'auto'"
+                f"TPU_AERIAL_FUSED={env!r}: expected 'pallas', 'scan', "
+                "'kernel' or 'auto'"
             )
     return _resolve_fused(fused)
+
+
+def resolve_precision(precision: str | None = "auto") -> str:
+    """Resolve the fused-kernel operator storage precision at CONFIG BUILD
+    time (the :func:`resolve_fused` idiom): ``"auto"`` (or None) consults
+    the ``TPU_AERIAL_PRECISION`` env var (``f32`` | ``bf16`` |
+    ``auto``/unset) and otherwise stays ``"f32"`` — bf16 storage halves
+    the kernel's HBM operator payload (the tile machinery already pads
+    every edge to the (8, 128) discipline; bf16 doubles the lane payload)
+    but only becomes a default candidate after the chip round's
+    ``*_fused_kernel_bf16`` A/B cells pass the consensus-residual parity
+    bar (see :func:`resolve_fused`'s kernel flip criterion). Explicit
+    values pass through validated. The knob is inert off the "kernel"
+    fused paths (asserted HLO-identical on scan)."""
+    if precision is None:
+        precision = "auto"
+    if precision == "auto":
+        env = os.environ.get("TPU_AERIAL_PRECISION", "").strip().lower()
+        if env in PRECISIONS:
+            return env
+        if env not in ("", "auto"):
+            raise ValueError(
+                f"TPU_AERIAL_PRECISION={env!r}: expected one of "
+                f"{PRECISIONS} or 'auto'"
+            )
+        return "f32"
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"precision={precision!r}: expected one of {PRECISIONS} or "
+            "'auto'"
+        )
+    return precision
+
+
+def _kernel_runs_offchip() -> bool:
+    """Trace-time host query backing the ``fused="kernel"`` off-TPU
+    downgrade (the ``ring._resolve_impl`` precedent): the mega-kernel has
+    no CPU/GPU lowering, so a config forced to "kernel" still compiles —
+    as the scan path — when the program lands off-TPU (e.g. the backend
+    guard's CPU fallback rung re-running a sweep cell). Uses
+    ``ring.effective_platform`` so a ``jax.default_device(cpu)`` fallback
+    context is honored (``jax.default_backend()`` would still report the
+    wedged chip)."""
+    from tpu_aerial_transport.parallel import ring
+
+    return ring.effective_platform() != "tpu"
 
 
 def _resolve_fused(fused: str) -> str:
     """solve_socp-internal "auto" resolution: backend-only, NO env read
     (see resolve_fused — env reads under trace go stale in the jit cache).
+    Rejects anything outside :data:`FUSED_MODES` — a typo'd mode must be
+    a clear ValueError here, not an opaque Mosaic lowering failure from
+    falling into the chunk-kernel branch.
     """
+    if fused not in FUSED_MODES:
+        raise ValueError(
+            f"fused={fused!r}: expected one of {FUSED_MODES}"
+        )
     if fused == "auto":
         return (
             "scan" if jax.default_backend() == "cpu" else _AUTO_FUSED_NONCPU
         )
     return fused
+
+
+def runtime_fused_mode(fused: str, nv: int, m: int,
+                       n_box: int | None = None) -> str:
+    """The mode :func:`solve_socp` will ACTUALLY run for ``fused`` at
+    operator dims ``(nv, m)`` on this host: "auto" backend resolution,
+    the "kernel" off-TPU trace-time downgrade, and the VMEM-residency
+    fallbacks (``fused_solve_fits`` for the whole-solve kernel,
+    ``MAX_FUSED_DIM`` for the chunk kernel). ONE resolver shared by
+    solve_socp's dispatch and by anything that must LABEL a measurement
+    with the mode that really ran (bench.py's fused A/B cells record it
+    as ``fused_resolved`` — a cell whose dims silently fell back to scan
+    must not be read as a kernel verdict)."""
+    # Host-side strings only (the ring._resolve_impl pattern), never a
+    # traced value.
+    mode = _resolve_fused(fused)
+    if mode == "kernel" and _kernel_runs_offchip():  # jaxlint: disable=JL005
+        mode = "scan"
+    if mode in ("kernel", "kernel_interpret"):
+        from tpu_aerial_transport.ops import admm_kernel
+
+        if not admm_kernel.fused_solve_fits(
+            nv, m, m if n_box is None else n_box
+        ):
+            mode = "scan"
+    elif mode != "scan":
+        from tpu_aerial_transport.ops import admm_kernel
+
+        if nv + m > admm_kernel.MAX_FUSED_DIM:
+            mode = "scan"
+    return mode
 
 
 def resolve_pad_operators(pad: bool | None) -> bool:
@@ -503,7 +699,7 @@ def resolve_pad_operators(pad: bool | None) -> bool:
     # Python-level cache key), and it is an algorithm constant at every call
     # site — a traced alpha would also break the scan/pallas parity contract.
     static_argnames=("n_box", "soc_dims", "iters", "check_every", "tol",
-                     "fused", "alpha"),
+                     "fused", "alpha", "precision"),
 )
 def solve_socp(
     P: jnp.ndarray,
@@ -524,6 +720,7 @@ def solve_socp(
     shift: jnp.ndarray | None = None,
     op: KKTOp | None = None,
     fused: str = "auto",
+    precision: str = "f32",
 ) -> SOCPSolution:
     """Solve one conic QP. All array args may carry leading batch axes only via
     ``vmap`` (this function itself is single-instance).
@@ -548,9 +745,18 @@ def solve_socp(
         single iterations), "pallas" (the fused TPU chunk kernel,
         ops/admm_kernel.py: K2 resident in VMEM across iterations, enclosing
         vmap axes folded into kernel lanes), "interpret" (same kernel via the
-        Pallas interpreter — CPU-testable), or "auto". Solves too big for
-        VMEM residency (nv + m > admm_kernel.MAX_FUSED_DIM, e.g. centralized
-        n = 64) fall back to "scan" regardless.
+        Pallas interpreter — CPU-testable), "kernel" (the whole-solve
+        mega-kernel, admm_kernel.fused_solve_lanes: per-solve w2 build +
+        every iteration + the exit residual reduction in ONE pallas_call,
+        all operators VMEM-resident; downgrades to scan off-TPU at trace
+        time), "kernel_interpret" (its CPU-testable interpreter twin —
+        bitwise-equal to scan, tests/test_fused_solve.py), or "auto".
+        Solves too big for VMEM residency (admm_kernel.MAX_FUSED_DIM /
+        fused_solve_fits, e.g. centralized n = 64) fall back to "scan"
+        regardless.
+      precision: operator storage on the "kernel" paths — "f32", or "bf16"
+        (bf16-storage / f32-accumulation of K2/Minv/A/P; halves the HBM
+        operator payload). Inert on scan/pallas paths.
     """
     m, nv = A.shape
     assert m == n_box + sum(soc_dims)
@@ -577,8 +783,23 @@ def solve_socp(
             [op.sigma * op.Minv, op.MinvAT], axis=-1
         )  # (nv, nv+m)
         K2 = jnp.concatenate([K, A @ K], axis=0)  # (nv + m, nv + m)
-    wq = op.Minv @ q
-    w2 = jnp.concatenate([wq, A @ wq])  # (nv + m,)
+
+    # Mode resolution runs before any mode-dependent ops are staged:
+    # "auto" backend resolution, the "kernel" off-TPU trace-time
+    # downgrade (the ring._resolve_impl precedent — a backend-guard CPU
+    # re-run of a kernel-configured cell still measures a working solve),
+    # and the VMEM-residency fallbacks, all in the ONE shared resolver so
+    # measurement labels (bench fused_resolved) cannot drift from
+    # dispatch.
+    fused_mode = runtime_fused_mode(fused, nv, m, n_box)
+    solve_kernel = fused_mode in ("kernel", "kernel_interpret")
+
+    if not solve_kernel:
+        # w2 build (the per-solve qp-build tail). The whole-solve kernel
+        # runs these two matvecs INSIDE the pallas_call from (Minv, A, q)
+        # so the operator read that feeds them stays VMEM-resident.
+        wq = op.Minv @ q
+        w2 = jnp.concatenate([wq, A @ wq])  # (nv + m,)
 
     if warm is None:
         x0 = jnp.zeros((nv,), dtype)
@@ -596,19 +817,29 @@ def solve_socp(
     # solves stalling at 1.6e-2 primal vs 2e-3 from the projected start).
     z0 = _project_cone(z0, lb, ub, n_box, soc_dims, shift)
 
-    fused_mode = _resolve_fused(fused)
-    if fused_mode != "scan":
-        from tpu_aerial_transport.ops import admm_kernel
-
-        if nv + m > admm_kernel.MAX_FUSED_DIM:
-            fused_mode = "scan"
-
     step_kw = dict(nv=nv, n_box=n_box, soc_dims=tuple(soc_dims), alpha=alpha)
 
-    def step(carry, _):
-        return _admm_step(carry, K2, w2, rho_vec, lb, ub, shift, **step_kw), None
+    if solve_kernel:
+        interp = fused_mode == "kernel_interpret"
+        # Fixed-arity placeholder when shift is None — the runner's
+        # has_shift static keeps both the kernel and its scan twin on the
+        # shiftless branch (no z + 0 signed-zero drift).
+        shift_k = shift if shift is not None else jnp.zeros((m,), dtype)
+        kernel_args = (K2, op.Minv, A, P, q, rho_vec, lb, ub, shift_k)
 
-    if fused_mode == "scan":
+        def run_chunk(carry, k):
+            runner = _fused_solve_runner(
+                nv, n_box, tuple(soc_dims), k, alpha, interp,
+                shift is not None, precision, False,
+            )
+            with phases.scope(phases.FUSED_SOLVE):
+                return runner(*carry, *kernel_args)
+    elif fused_mode == "scan":
+
+        def step(carry, _):
+            return _admm_step(
+                carry, K2, w2, rho_vec, lb, ub, shift, **step_kw
+            ), None
 
         def run_chunk(carry, k):
             return lax.scan(step, carry, None, length=k)[0]
@@ -652,6 +883,19 @@ def solve_socp(
             carry = lax.cond(
                 above_tol(carry), lambda c: run_chunk(c, rem), lambda c: c, carry
             )
+    elif solve_kernel:
+        # Fixed-iteration whole-solve kernel: the exit residual reduction
+        # rides INSIDE the pallas_call (with_res=True) — nothing of the
+        # solve touches HBM between the operator read and the solution
+        # write. The tolerance-chunked branch above keeps its XLA-side
+        # residual checks (the while_loop cond needs them between chunks).
+        runner = _fused_solve_runner(
+            nv, n_box, tuple(soc_dims), iters, alpha, interp,
+            shift is not None, precision, True,
+        )
+        with phases.scope(phases.FUSED_SOLVE):
+            x, y, z, prim, dual = runner(x0, y0, z0, *kernel_args)
+        return SOCPSolution(x=x, y=y, z=z, prim_res=prim, dual_res=dual)
     else:
         carry = run_chunk((x0, y0, z0), iters)
 
