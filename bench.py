@@ -253,7 +253,7 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
                   force_fixed_iters: bool = False, inner_tol: float = 0.0,
                   substep_unroll: int = 1,
                   pad_operators: bool | None = None,
-                  socp_precision: str = "auto"):
+                  socp_precision: str = "auto", effort: str = "auto"):
     # Default inner ADMM budgets are the measured knees. C-ADMM: 20 — below
     # it the warm-started agent solves miss the 5e-3 primal tolerance and
     # fall back to equilibrium forces (visible as an exactly-zero consensus
@@ -275,7 +275,7 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             inner_iters=inner_iters if inner_iters is not None else 20,
             socp_fused=socp_fused, socp_precision=socp_precision,
             inner_tol=inner_tol,
-            pad_operators=pad_operators,
+            pad_operators=pad_operators, effort=effort,
             # res_tol = 0 can never be met (inf-norm >= 0), so the consensus
             # loop runs to exactly max_iter + 1 iterations — the fixed-count
             # mode _measured_iter_ms differences.
@@ -300,7 +300,7 @@ def make_mpc_step(controller: str, n: int, max_iter: int = 20,
             inner_iters=inner_iters if inner_iters is not None else 40,
             socp_fused=socp_fused, socp_precision=socp_precision,
             inner_tol=inner_tol,
-            pad_operators=pad_operators,
+            pad_operators=pad_operators, effort=effort,
             **({"prim_inf_tol": 0.0} if force_fixed_iters else {}),
         )
         cs0 = dd.init_dd_state(params, cfg)
@@ -379,13 +379,16 @@ def build(controller="cadmm", n=N_AGENTS, n_scenarios=N_SCENARIOS,
     def rollout(css, states, n_steps):
         def body(carry, _):
             cs, s = carry
-            cs, s, _ = batched_step(cs, s)
-            return (cs, s), None
+            cs, s, stats = batched_step(cs, s)
+            # Per-step per-lane consensus iterations ride out of the scan
+            # so any cell built on this rollout can record the
+            # iters_mean/p99 effort fields (solver-effort observability).
+            return (cs, s), stats.iters
 
-        (css, states), _ = jax.lax.scan(
+        (css, states), iters_seq = jax.lax.scan(
             body, (css, states), None, length=n_steps
         )
-        return css, states
+        return css, states, iters_seq
 
     return jax.jit(rollout, static_argnames="n_steps"), css, states
 
@@ -744,6 +747,26 @@ def scaling(out_path: str = SCALING_PATH):
     }), flush=True)
 
 
+def _iters_stats(iters_seq) -> dict:
+    """Solver-effort fields from a rollout's per-step (x per-lane)
+    consensus-iteration sequence: mean, exact p99, and the log2-bucket
+    histogram (obs.telemetry.iter_histogram — the ONE bucketing
+    implementation, right-closed like the in-jit accumulators, so bench
+    cells and the telemetry effort section read on the same axis)."""
+    from tpu_aerial_transport.obs import telemetry as telemetry_mod
+
+    it = np.asarray(iters_seq).reshape(-1)
+    it = it[it >= 0]
+    if not it.size:
+        return {}
+    return {
+        "iters_mean": float(it.mean()),
+        "iters_p99": float(np.percentile(it, 99)),
+        "iters_hist": [int(v) for v in telemetry_mod.iter_histogram(it)],
+        "iters_buckets": list(telemetry_mod.ITER_BUCKETS),
+    }
+
+
 def _batched(controller, n, n_scenarios, n_steps=10, socp_fused="auto",
              buckets=0, inner_tol=0.0, substep_unroll=1,
              pad_operators=None):
@@ -752,8 +775,11 @@ def _batched(controller, n, n_scenarios, n_steps=10, socp_fused="auto",
                               inner_tol=inner_tol,
                               substep_unroll=substep_unroll,
                               pad_operators=pad_operators)
-    return measure(step, css, states, jax.devices()[0], n_steps,
-                   n_scenarios)  # -> (rate, compile_wall_s)
+    rate, compile_wall_s, out = measure(
+        step, css, states, jax.devices()[0], n_steps, n_scenarios,
+        return_last=True,
+    )
+    return rate, compile_wall_s, _iters_stats(out[2])
 
 
 def _fused_measure(controller, n, n_scenarios, fused, precision,
@@ -826,7 +852,12 @@ def _fused_ab_cell(controller, n, n_scenarios, fused, precision="f32"):
             socp_fused=fused, socp_precision=precision,
         )
         _, _, nv_p, n_box_p, m_p = dd_mod._qp_dims(dims_cfg)
-    fused_resolved = socp_mod.runtime_fused_mode(fused, nv_p, m_p, n_box_p)
+    # Chunking folded into the shared resolver (the fused cells run
+    # unchunked — inner_tol 0 — but the label and dispatch must share
+    # the one decision either way).
+    fused_resolved = socp_mod.runtime_fused_mode(
+        fused, nv_p, m_p, n_box_p, check_every=0, tol=0.0
+    )
     # Off the kernel path the precision knob is inert (bit-identical scan
     # program — asserted in tests/test_fused_solve.py): resolve it to f32
     # up front so a CPU-rung bf16 cell is labeled as the f32 scan
@@ -874,6 +905,130 @@ def _fused_ab_cell(controller, n, n_scenarios, fused, precision="f32"):
                 "res_bar_inconclusive": True,
                 "f32_final_consensus_res": res32,
             })
+    return value
+
+
+def _effort_measure(controller, n, n_scenarios, effort, n_steps=10):
+    """Measure one effort-A/B arm: the batched rollout with the consensus
+    controllers' effort knob pinned to ``effort``, returning the rate,
+    compile wall, per-step x per-lane consensus-iteration sequence, the
+    per-step inner-iteration totals (adaptive arm only — fixed stages no
+    accounting; its inner effort is the static budget), and the final
+    worst-lane consensus residual (the equal-quality bar input)."""
+    adaptive = effort == "adaptive"
+    mpc_step, cs0, state0 = make_mpc_step(controller, n, effort=effort)
+    states = _scenario_batch(state0, n_scenarios)
+    css = jax.vmap(lambda _: cs0)(jnp.arange(n_scenarios))
+    batched_step = jax.vmap(mpc_step)
+
+    def rollout(css, states, n_steps):
+        def body(carry, _):
+            cs, s = carry
+            cs, s, stats = batched_step(cs, s)
+            extras = (stats.iters, jnp.max(stats.solve_res))
+            if adaptive:
+                extras = extras + (stats.inner_iters,)
+            return (cs, s), extras
+
+        (css, states), extras = jax.lax.scan(
+            body, (css, states), None, length=n_steps
+        )
+        return (css, states) + extras
+
+    step = jax.jit(rollout, static_argnames="n_steps")
+    rate, compile_wall_s, out = measure(
+        step, css, states, jax.devices()[0], n_steps, n_scenarios,
+        return_last=True,
+    )
+    iters_seq = np.asarray(out[2])
+    final_res = float(np.asarray(out[3])[-1])
+    inner_seq = np.asarray(out[4]) if adaptive else None
+    return rate, compile_wall_s, iters_seq, inner_seq, final_res
+
+
+def _effort_ab_cell(controller, n, n_scenarios, effort):
+    """Adaptive-solver-effort A/B cell (the controllers' ``effort`` knob,
+    socp.resolve_effort): fixed vs adaptive twins at the same operating
+    point, recording the rate, the consensus-iteration histogram fields
+    (``iters_mean``/``iters_p99``/``iters_hist`` — the straggler-spread
+    evidence the flip criterion reads), the adaptive arm's inner-effort
+    accounting, and the final consensus residual against the paper's
+    1e-2 N bar (an adaptive "win" above the bar its fixed twin meets is
+    a quality regression, not a flip candidate — the criterion is
+    written at socp.resolve_effort). ``effort``/``effort_resolved``
+    follow the impl/impl_resolved convention; effort has no backend
+    downgrade, so they differ only for "auto"."""
+    from tpu_aerial_transport.control import cadmm as cadmm_mod
+    from tpu_aerial_transport.control import dd as dd_mod
+    from tpu_aerial_transport.ops import socp as socp_mod
+
+    effort_resolved = socp_mod.resolve_effort(effort)
+    # Label the solve impl the cell ACTUALLY dispatches through the ONE
+    # shared resolver, WITH the chunking mode the adaptive arm forces
+    # (check_every/tol — the tolerance-chunked early-exit path; fixed
+    # arms run unchunked unless inner_tol says otherwise): the
+    # fused_resolved label and solve_socp's dispatch share the decision.
+    params, col, *_ = _setup(n)
+    if controller == "cadmm":
+        dims_cfg = cadmm_mod.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            effort=effort_resolved,
+        )
+        base_cfg = dims_cfg
+        _, _, nv_p, n_box_p, m_p = cadmm_mod._qp_dims(dims_cfg, n)
+        default_tol = base_cfg.solver_tol
+    else:
+        dims_cfg = dd_mod.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            effort=effort_resolved,
+        )
+        base_cfg = dims_cfg.base
+        _, _, nv_p, n_box_p, m_p = dd_mod._qp_dims(dims_cfg)
+        default_tol = dd_mod.ADAPTIVE_GATE_TOL  # gate-only default.
+    # The chunking the controller ACTUALLY dispatches with (read from
+    # the config, not re-hardcoded here — the label and the dispatch
+    # must come from the same values).
+    tol_eff = (base_cfg.inner_tol if base_cfg.inner_tol > 0
+               else default_tol)
+    adaptive = effort_resolved == "adaptive"
+    fused_resolved = socp_mod.runtime_fused_mode(
+        "auto", nv_p, m_p, n_box_p,
+        check_every=(base_cfg.inner_check_every if adaptive else 0),
+        tol=(tol_eff if adaptive else 0.0),
+    )
+    rate, compile_wall_s, iters_seq, inner_seq, final_res = _effort_measure(
+        controller, n, n_scenarios, effort_resolved
+    )
+    value = {
+        "scenario_mpc_steps_per_sec": rate,
+        "agent_mpc_steps_per_sec": rate * n,
+        "compile_wall_s": compile_wall_s,
+        "effort": effort,
+        "effort_resolved": effort_resolved,
+        "fused": "auto",
+        "fused_resolved": fused_resolved,
+        "final_consensus_res": final_res,
+        # The equal-quality bar for the flip criterion: the consensus
+        # loop's own stop tolerance (the paper's res_tol = 1e-2 N).
+        "res_bar": 1e-2,
+        **_iters_stats(iters_seq),
+    }
+    if inner_seq is not None:
+        from tpu_aerial_transport.obs import telemetry as telemetry_mod
+
+        # PER-SOLVE effort (inner total / consensus iters / n agents —
+        # the telemetry accumulators' scale-free axis).
+        per_solve = inner_seq.reshape(-1) / np.maximum(
+            np.asarray(iters_seq).reshape(-1), 1
+        ) / n
+        value.update({
+            "inner_iters_mean_per_step": float(inner_seq.mean()),
+            "inner_per_solve_mean": float(per_solve.mean()),
+            "inner_per_solve_p99": float(np.percentile(per_solve, 99)),
+            "inner_hist": [
+                int(v) for v in telemetry_mod.iter_histogram(per_solve)
+            ],
+        })
     return value
 
 
@@ -1537,7 +1692,7 @@ def sweep(resume: bool = False, platform: str | None = None):
         return {**value, "rung": ran_at}
 
     def _batched_cell(kw) -> dict:
-        rate, compile_wall_s = _batched(
+        rate, compile_wall_s, iters_stats = _batched(
             kw["controller"], kw["n"], kw["n_scenarios"],
             socp_fused=kw.get("socp_fused", "auto"),
             buckets=kw.get("buckets", 0),
@@ -1546,7 +1701,8 @@ def sweep(resume: bool = False, platform: str | None = None):
             pad_operators=kw.get("pad_operators"))
         return {"scenario_mpc_steps_per_sec": rate,
                 "agent_mpc_steps_per_sec": rate * kw["n"],
-                "compile_wall_s": compile_wall_s}
+                "compile_wall_s": compile_wall_s,
+                **iters_stats}
 
     # Consensus-exchange A/B cells (parallel/ring.py) — run FIRST with the
     # other decision cells: the next chip round reads the
@@ -1610,6 +1766,29 @@ def sweep(resume: bool = False, platform: str | None = None):
                 try:
                     record(key, guarded_cell(
                         key, _fused_ab_cell, ctrl, n_f, ns_f, **kw,
+                    ))
+                except Exception as e:
+                    record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    # Adaptive-solver-effort A/B cells (the controllers' effort knob,
+    # socp.resolve_effort — the "converged lanes shouldn't pay for
+    # stragglers" decision cells): fixed vs adaptive twins at n in
+    # {16, 64} for both consensus controllers, recording rate + the
+    # consensus-iteration histogram fields + the equal-quality residual
+    # bar. Meaningful on ANY backend — adaptivity is pure XLA on the scan
+    # path (the kernel path additionally keeps its in-kernel early exit
+    # on-chip), so a CPU round is a real A/B, not just a baseline row;
+    # the flip criterion is written at socp.resolve_effort.
+    for ctrl in ("cadmm", "dd"):
+        for n_f, ns_f in ((16, 64), (64, 16)):
+            for eff in ("fixed", "adaptive"):
+                key = f"{ctrl}_n{n_f}_effort_{eff}"
+                if not want(key) or (key in results
+                                     and "error" not in results[key]):
+                    continue
+                try:
+                    record(key, guarded_cell(
+                        key, _effort_ab_cell, ctrl, n_f, ns_f, eff,
                     ))
                 except Exception as e:
                     record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
@@ -1896,7 +2075,8 @@ def sweep(resume: bool = False, platform: str | None = None):
     for key in [k for k in results
                 if "batch" in k or "swarm" in k or "fused" in k
                 or "innertol" in k or "sharded" in k or "donate" in k
-                or "coldstart" in k or "serving" in k or "pods" in k]:
+                or "coldstart" in k or "serving" in k or "pods" in k
+                or "effort" in k]:
         r = results[key]
         if "error" in r:
             print(f"| {key} | ERROR: {r['error']} | — | — |")

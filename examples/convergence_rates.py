@@ -8,6 +8,15 @@ vs iteration curves with min/max bands. Here the samples are one ``vmap`` batch
 instead of a sequential Python loop.
 
 Usage: python examples/convergence_rates.py [--samples 100] [--iters 25]
+
+``--effort fixed|adaptive|ab`` switches to the adaptive-solver-effort
+A/B: instead of the tolerance-0 residual curves, run the batch at the
+paper's real stop tolerance (1e-2 N) with the controllers' ``effort``
+knob pinned, and print the consensus-iteration histograms (plus the
+adaptive arm's inner-effort histogram) — the straggler-spread evidence
+the chip-round flip criterion at ``socp.resolve_effort`` reads, and the
+exact corpus the ROADMAP's amortized-warm-start follow-up would train
+on. ``ab`` runs both arms and prints them side by side.
 """
 
 from __future__ import annotations
@@ -17,6 +26,87 @@ import argparse
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _effort_ab(args) -> None:
+    """The --effort mode: per-sample iteration-count histograms at the
+    real stop tolerance, fixed vs adaptive."""
+    from tpu_aerial_transport.control import cadmm, centralized, dd
+    from tpu_aerial_transport.harness import setup
+    from tpu_aerial_transport.obs import telemetry as telemetry_mod
+
+    params, col, state0 = setup.rqp_setup(args.n)
+    f_eq = centralized.equilibrium_forces(params)
+    keys = jax.random.split(jax.random.PRNGKey(0), args.samples)
+    accs = jax.vmap(lambda k: 0.5 * jax.random.normal(k, (3,)))(keys)
+    edges = list(telemetry_mod.ITER_BUCKETS)
+    labels = [f"<={e}" for e in edges] + [f">{edges[-1]}"]
+
+    def hist_line(values):
+        # The shared right-closed bucketing (v <= edge), so these lines
+        # read on the same axis as the telemetry accumulators and the
+        # bench cells' iters_hist fields.
+        h = telemetry_mod.iter_histogram(values)
+        parts = [f"{lab}: {int(c)}" for lab, c in zip(labels, h) if c > 0]
+        return ", ".join(parts) or "(empty)"
+
+    modes = ("fixed", "adaptive") if args.effort == "ab" else (args.effort,)
+    summary = {}
+    for effort in modes:
+        acfg = cadmm.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=args.iters, inner_iters=80, effort=effort,
+        )
+        dcfg = dd.make_config(
+            params, col.collision_radius, col.max_deceleration,
+            max_iter=args.iters, inner_iters=80, effort=effort,
+        )
+
+        def cadmm_run(acc):
+            astate = cadmm.init_cadmm_state(params, acfg)
+            _, _, stats = cadmm.control(
+                params, acfg, f_eq, astate, state0, (acc, jnp.zeros(3))
+            )
+            return stats.iters, stats.solve_res, stats.inner_iters
+
+        def dd_run(acc):
+            dstate = dd.init_dd_state(params, dcfg)
+            _, _, stats = dd.control(
+                params, dcfg, f_eq, dstate, state0, (acc, jnp.zeros(3))
+            )
+            return stats.iters, stats.solve_res, stats.inner_iters
+
+        print(f"\n== effort={effort} ({args.samples} samples, "
+              f"max_iter={args.iters}, res_tol 1e-2 N) ==")
+        for label, run in (("C-ADMM", cadmm_run), ("DD", dd_run)):
+            iters, res, inner = jax.jit(jax.vmap(run))(accs)
+            iters = np.asarray(iters)
+            res = np.asarray(res)
+            row = {
+                "iters_mean": float(iters.mean()),
+                "iters_p99": float(np.percentile(iters, 99)),
+                "res_max": float(res.max()),
+            }
+            print(f"{label}: consensus iters mean {row['iters_mean']:.1f} "
+                  f"p99 {row['iters_p99']:.0f}, worst residual "
+                  f"{row['res_max']:.2e} N")
+            print(f"  consensus-iteration histogram: {hist_line(iters)}")
+            if np.asarray(inner).size:
+                # Per-solve effort (the telemetry accumulators' axis).
+                per = np.asarray(inner) / np.maximum(iters, 1) / args.n
+                row["inner_per_solve_mean"] = float(per.mean())
+                print(f"  inner iters/solve: mean {per.mean():.1f} "
+                      f"p99 {np.percentile(per, 99):.0f}")
+                print(f"  inner-effort histogram: {hist_line(per)}")
+            summary[f"{label}_{effort}"] = row
+    if args.json:
+        import json
+
+        with open(args.json, "w") as fh:
+            json.dump({"n": args.n, "samples": args.samples,
+                       "iters": args.iters, "mode": "effort_ab",
+                       **summary}, fh, indent=1)
+        print(f"\neffort summary saved to {args.json}")
 
 
 def main() -> None:
@@ -31,7 +121,16 @@ def main() -> None:
     p.add_argument("--json", default=None, metavar="PATH",
                    help="also write per-iteration median/min/max residuals "
                         "for both solvers as JSON")
+    p.add_argument("--effort", choices=["fixed", "adaptive", "ab"],
+                   default=None,
+                   help="adaptive-solver-effort A/B: run at the real stop "
+                        "tolerance and print iteration histograms instead "
+                        "of the tolerance-0 residual curves")
     args = p.parse_args()
+
+    if args.effort:
+        _effort_ab(args)
+        return
 
     from tpu_aerial_transport.control import cadmm, centralized, dd
     from tpu_aerial_transport.harness import setup

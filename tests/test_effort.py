@@ -1,0 +1,625 @@
+"""Adaptive solver effort (ISSUE 13): in-kernel early exit, per-lane
+convergence freezing, the consensus-level ``effort`` knob, and the
+iteration-effort telemetry.
+
+Oracles, strongest first:
+
+1. **Bitwise per-lane semantics** of the tolerance-chunked path: lane i
+   of the batched ``check_every/tol`` solve equals lane i of the batched
+   FIXED-iteration solve run to lane i's own effective iteration count
+   (``report_iters``) — each lane's result depends only on its own
+   convergence schedule, never on how long the loop drains other lanes.
+   (A truly unbatched program is NOT the bitwise oracle on XLA-CPU:
+   batched and unbatched matmuls reduce in different orders — measured
+   ~1e-7 — which is exactly why the per-lane contract is stated against
+   the batched fixed-iteration program.)
+2. **Bitwise kernel parity**: the in-kernel early-exit form
+   (``fused="kernel_interpret"`` + check_every/tol) ≡ the scan path,
+   solutions AND per-lane effective iteration counts, with and without
+   the consensus-effort ``active`` gate — in ONE pallas_call.
+3. **Zero-cost contract**: ``effort="fixed"`` compiles byte-identical
+   HLO (every adaptive branch is Python-level); adaptive results match
+   fixed within the paper's 1e-2 N consensus-residual tolerance,
+   nominal AND alive-masked, cadmm AND dd.
+4. **Telemetry/observability**: consensus-/inner-iteration histograms
+   accumulate in-jit, roll up across lanes, and render in run_health's
+   solver-effort section + bench-table columns.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.control import cadmm, centralized, dd
+from tpu_aerial_transport.control.types import SolverStats
+from tpu_aerial_transport.harness import setup
+from tpu_aerial_transport.obs import telemetry as telemetry_mod
+from tpu_aerial_transport.ops import socp
+from tpu_aerial_transport.resilience import faults as faults_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------- problem builders --------------------------
+
+
+def _problems(B=5, nv=8, n_box=6, soc=(4,), seed=0):
+    rng = np.random.default_rng(seed)
+
+    def one():
+        L = rng.standard_normal((nv, nv))
+        P = jnp.asarray(L @ L.T + np.eye(nv), jnp.float32)
+        q = jnp.asarray(rng.standard_normal(nv), jnp.float32)
+        m = n_box + sum(soc)
+        A = jnp.asarray(rng.standard_normal((m, nv)) * 0.5, jnp.float32)
+        lb = jnp.asarray(rng.uniform(-2.0, -0.5, n_box), jnp.float32)
+        ub = jnp.asarray(rng.uniform(0.5, 2.0, n_box), jnp.float32)
+        shift = jnp.zeros((m,), jnp.float32).at[n_box].set(3.0)
+        return P, q, A, lb, ub, shift
+
+    return [jnp.stack(x) for x in zip(*[one() for _ in range(B)])]
+
+
+def _solve_batch(args, mode, iters=30, tol=0.0, check_every=0,
+                 active=None, report_iters=False):
+    Ps, qs, As, lbs, ubs, shifts = args
+
+    def f(P_, q_, A_, lb_, ub_, s_, *act):
+        return socp.solve_socp_padded(
+            P_, q_, A_, lb_, ub_, n_box=6, soc_dims=(4,), iters=iters,
+            shift=s_, fused=mode, tol=tol, check_every=check_every,
+            active=act[0] if act else None, report_iters=report_iters,
+        )
+
+    if active is not None:
+        return jax.vmap(f)(Ps, qs, As, lbs, ubs, shifts, active)
+    return jax.vmap(f)(Ps, qs, As, lbs, ubs, shifts)
+
+
+def _assert_bitwise(out, ref, fields=("x", "y", "z", "prim_res",
+                                      "dual_res")):
+    for name in fields:
+        a = np.asarray(getattr(out, name))
+        b = np.asarray(getattr(ref, name))
+        assert np.array_equal(a, b), (
+            f"{name} differs (max abs {np.abs(a - b).max()})"
+        )
+
+
+# ------------------- per-lane tolerance-chunk semantics -----------------
+
+
+def test_tol_chunked_per_lane_bitwise_vs_own_schedule():
+    """Lane i of the batched tol-chunked solve == lane i of the batched
+    fixed-iteration solve run to lane i's own effective count, BITWISE —
+    the per-lane freezing never contaminates a converged lane while the
+    loop drains stragglers (satellite: vmapped check_every/tol
+    regression)."""
+    args = _problems()
+    sol, eff = _solve_batch(args, "scan", tol=1e-3, check_every=7,
+                            report_iters=True)
+    eff = np.asarray(eff)
+    assert len(set(eff.tolist())) > 1, (
+        "test problems must have an iteration-count spread"
+    )
+    for i, e in enumerate(eff.tolist()):
+        ref = _solve_batch(args, "scan", iters=int(e))
+        for name in ("x", "y", "z"):
+            a = np.asarray(getattr(sol, name))[i]
+            b = np.asarray(getattr(ref, name))[i]
+            assert np.array_equal(a, b), (name, i, e)
+
+
+def test_batched_while_runs_until_worst_lane():
+    """The cost model the consensus tier attacks, documented: the batched
+    tolerance-chunked while_loop runs until the WORST lane — but a
+    fast-converging lane's result and effective count are bitwise
+    independent of the stragglers sharing its batch (same-shape batch
+    with the straggler replaced by a clone of the fast lane)."""
+    args = _problems()
+    _, eff = _solve_batch(args, "scan", tol=1e-3, check_every=7,
+                          report_iters=True)
+    eff = np.asarray(eff)
+    fast = int(np.argmin(eff))
+    slow = int(np.argmax(eff))
+    assert eff[fast] < eff[slow], "need a straggler spread"
+    # Wall-clock cost model: the while_loop's vmap batching keeps the
+    # whole batch iterating while ANY lane is active, so the global chunk
+    # count is max over lanes — eff[slow] here. Each lane only ACCUMULATES
+    # its own eff[i] chunks (frozen selects after that), which is what the
+    # histograms measure and the adaptive consensus tier exploits.
+    clone = [
+        jnp.stack([a[i] if i != slow else a[fast]
+                   for i in range(a.shape[0])]) for a in args
+    ]
+    sol_mixed, eff_mixed = _solve_batch(args, "scan", tol=1e-3,
+                                        check_every=7, report_iters=True)
+    sol_clone, eff_clone = _solve_batch(clone, "scan", tol=1e-3,
+                                        check_every=7, report_iters=True)
+    assert int(np.asarray(eff_clone)[fast]) == int(eff[fast])
+    for name in ("x", "y", "z"):
+        a = np.asarray(getattr(sol_mixed, name))[fast]
+        b = np.asarray(getattr(sol_clone, name))[fast]
+        assert np.array_equal(a, b), name
+
+
+# ------------------------- in-kernel early exit -------------------------
+
+
+def test_kernel_earlyexit_bitwise_vs_scan():
+    """The in-kernel early-exit form (interpret twin) ≡ the scan path's
+    tolerance-chunked loop BITWISE: solutions, exit residuals, and the
+    per-lane effective iteration counts."""
+    args = _problems()
+    ref, eff_ref = _solve_batch(args, "scan", tol=1e-3, check_every=7,
+                                report_iters=True)
+    out, eff_out = _solve_batch(args, "kernel_interpret", tol=1e-3,
+                                check_every=7, report_iters=True)
+    _assert_bitwise(out, ref)
+    assert np.array_equal(np.asarray(eff_ref), np.asarray(eff_out))
+
+
+def test_kernel_earlyexit_single_pallas_call():
+    """A tolerance-chunked kernel solve stages exactly ONE pallas_call —
+    the label-drift fix: before the in-kernel exit, the same config
+    staged an XLA while_loop re-launching the kernel (re-streaming the
+    operators from HBM) once per chunk."""
+    Ps, qs, As, lbs, ubs, shifts = _problems(B=2)
+
+    def fn(P_, q_, A_, lb_, ub_, s_):
+        return socp.solve_socp_padded(
+            P_, q_, A_, lb_, ub_, n_box=6, soc_dims=(4,), iters=30,
+            shift=s_, fused="kernel_interpret", tol=1e-3, check_every=7,
+        )
+
+    jaxpr = str(jax.make_jaxpr(jax.vmap(fn))(Ps, qs, As, lbs, ubs, shifts))
+    assert jaxpr.count("pallas_call") == 1
+    # ... and the chunk loop lives INSIDE it: no XLA-side while wrapping
+    # the kernel (the jaxpr's only while ops are within the kernel body,
+    # which the count above already pins to one launch).
+
+
+def test_kernel_earlyexit_active_gate_bitwise():
+    """The consensus-effort gate: gated-off lanes are 0-effective-
+    iteration pass-throughs on BOTH realizations, bitwise, and gated-on
+    lanes are untouched by their gated-off neighbors."""
+    args = _problems()
+    act = jnp.array([True, False, True, False, True])
+    ref, eff_ref = _solve_batch(args, "scan", tol=1e-3, check_every=7,
+                                active=act, report_iters=True)
+    out, eff_out = _solve_batch(args, "kernel_interpret", tol=1e-3,
+                                check_every=7, active=act,
+                                report_iters=True)
+    _assert_bitwise(out, ref)
+    eff = np.asarray(eff_out)
+    assert np.array_equal(eff, np.asarray(eff_ref))
+    assert eff[1] == 0 and eff[3] == 0 and eff[0] > 0
+    # Gated-on lanes match the ungated solve bitwise (no cross-lane
+    # contamination from the pass-through neighbors).
+    full, eff_full = _solve_batch(args, "scan", tol=1e-3, check_every=7,
+                                  report_iters=True)
+    for i in (0, 2, 4):
+        assert np.array_equal(np.asarray(out.x)[i], np.asarray(full.x)[i])
+        assert eff[i] == np.asarray(eff_full)[i]
+
+
+def test_compiled_earlyexit_form_matches_exact_f32():
+    """The Mosaic-lowerable broadcast-reduce body of the early-exit
+    kernel (exact_dot=False, run under the interpreter) agrees with the
+    bitwise exact_dot body to f32 rounding — the PR-12 numerics contract
+    extended to the while-loop form. Effective iteration counts must
+    stay close (residual thresholds under different rounding may flip a
+    lane by one chunk at most)."""
+    from tpu_aerial_transport.ops import admm_kernel
+
+    Ps, qs, As, lbs, ubs, shifts = _problems()
+    B = Ps.shape[0]
+    nv_p, n_box_p = socp.padded_dims(8, 6, (4,))
+    m_p = n_box_p + 4
+    pqps = jax.vmap(
+        lambda P_, A_, lb_, ub_, s_: socp.padded_kkt_operator(
+            P_, A_, lb_, ub_, s_, n_box=6, soc_dims=(4,)
+        )
+    )(Ps, As, lbs, ubs, shifts)
+    qs_p = jnp.pad(qs, ((0, 0), (0, nv_p - 8)))
+    z0 = jax.vmap(
+        lambda lb_, ub_, s_: socp._project_cone(
+            jnp.zeros((m_p,)), lb_, ub_, n_box_p, (4,), s_
+        )
+    )(pqps.lb, pqps.ub, pqps.shift)
+    rho_v = jax.vmap(
+        lambda lb_, ub_: socp.make_rho_vec(m_p, n_box_p, lb_, ub_, 0.4)
+    )(pqps.lb, pqps.ub)
+
+    def run(exact_dot):
+        return admm_kernel.fused_solve_lanes(
+            jnp.zeros((B, nv_p)), jnp.zeros((B, m_p)), z0,
+            pqps.op.K2, pqps.op.Minv, pqps.A, pqps.P, qs_p, rho_v,
+            pqps.lb, pqps.ub, pqps.shift, jnp.ones((B,), bool),
+            nv=nv_p, n_box=n_box_p, soc_dims=(4,), iters=30, alpha=1.6,
+            check_every=7, tol=1e-3, interpret=True, exact_dot=exact_dot,
+        )
+
+    exact, compiled = run(True), run(False)
+    for a, b in zip(exact[:5], compiled[:5]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=1e-4
+        )
+    assert np.abs(
+        np.asarray(exact[5]).astype(int) - np.asarray(compiled[5]).astype(int)
+    ).max() <= 7  # at most one check_every chunk of threshold flip.
+
+
+def test_active_requires_tol_path():
+    """A fixed-iteration solve cannot express the pass-through: active=
+    without check_every/tol is a clear ValueError on both entry points."""
+    P, q, A, lb, ub, _ = [a[0] for a in _problems(B=1)]
+    with pytest.raises(ValueError):
+        socp.solve_socp(
+            P, q, A, lb, ub, n_box=6, soc_dims=(4,), iters=8,
+            active=jnp.ones((), bool),
+        )
+    from tpu_aerial_transport.ops import admm_kernel
+
+    with pytest.raises(ValueError):
+        admm_kernel.fused_solve_lanes(
+            jnp.zeros((2, 8)), jnp.zeros((2, 10)), jnp.zeros((2, 10)),
+            jnp.zeros((2, 18, 18)), jnp.zeros((2, 8, 8)),
+            jnp.zeros((2, 10, 8)), jnp.zeros((2, 8, 8)), jnp.zeros((2, 8)),
+            jnp.ones((2, 10)), jnp.zeros((2, 6)), jnp.ones((2, 6)),
+            None, jnp.ones((2,), bool),
+            nv=8, n_box=6, soc_dims=(4,), iters=8, alpha=1.6,
+        )
+
+
+# ----------------------- resolver + config plumbing ---------------------
+
+
+def test_resolve_effort_gate(monkeypatch):
+    """socp.resolve_effort: auto -> fixed (until the chip-round flip
+    criterion), TAT_EFFORT env force, junk raises; the resolved value
+    lands on the static field of BOTH controller configs."""
+    monkeypatch.delenv("TAT_EFFORT", raising=False)
+    assert socp.resolve_effort("auto") == "fixed"
+    assert socp.resolve_effort(None) == "fixed"
+    monkeypatch.setenv("TAT_EFFORT", "adaptive")
+    assert socp.resolve_effort("auto") == "adaptive"
+    assert socp.resolve_effort("fixed") == "fixed"  # explicit wins.
+    monkeypatch.setenv("TAT_EFFORT", "lazy")
+    with pytest.raises(ValueError):
+        socp.resolve_effort("auto")
+    with pytest.raises(ValueError):
+        socp.resolve_effort("turbo")
+    params, col, _ = setup.rqp_setup(4)
+    monkeypatch.setenv("TAT_EFFORT", "adaptive")
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration
+    )
+    assert cfg.effort == "adaptive"
+    dcfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration, effort="fixed"
+    )
+    assert dcfg.base.effort == "fixed"
+
+
+def test_runtime_fused_mode_takes_chunking():
+    """The shared resolver accepts the solve's chunking mode (the
+    label-drift fold): labels are stable across it today — both kernel
+    forms exist — and a tol-chunked kernel config still resolves
+    "kernel"-family, which now IS one pallas_call."""
+    assert socp.runtime_fused_mode(
+        "kernel_interpret", 16, 32, 24, check_every=10, tol=1e-3
+    ) == "kernel_interpret"
+    assert socp.runtime_fused_mode(
+        "scan", 16, 32, 24, check_every=10, tol=1e-3
+    ) == "scan"
+
+
+# ----------------------- controller-level contracts ---------------------
+
+
+def _step_hlo(ctrl, effort):
+    params, col, state = setup.rqp_setup(4)
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+    f_eq = centralized.equilibrium_forces(params)
+    mod = cadmm if ctrl == "cadmm" else dd
+    cfg = mod.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=2, inner_iters=4, pad_operators=True, effort=effort,
+    )
+    init = (cadmm.init_cadmm_state if ctrl == "cadmm"
+            else dd.init_dd_state)
+    cs = init(params, cfg)
+    return jax.jit(
+        lambda a, s: mod.control(params, cfg, f_eq, a, s, acc_des)
+    ).lower(cs, state).as_text()
+
+
+@pytest.mark.parametrize("ctrl", ["cadmm", "dd"])
+def test_effort_fixed_identical_hlo(ctrl):
+    """The zero-cost contract (the no_faults()/telemetry=None pattern):
+    effort="fixed" and the knob-default "auto" config lower byte-
+    identical HLO — every adaptive branch is Python-level, so shipping
+    the knob cannot perturb a fixed deployment — while "adaptive"
+    genuinely changes the program (sanity that the knob is live)."""
+    fixed = _step_hlo(ctrl, "fixed")
+    assert fixed == _step_hlo(ctrl, "auto")
+    assert fixed != _step_hlo(ctrl, "adaptive")
+
+
+def _run_ctrl_batch(ctrl, effort, health):
+    n = 4
+    params, col, state = setup.rqp_setup(n)
+    acc_des = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+    mod = cadmm if ctrl == "cadmm" else dd
+    cfg = mod.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        max_iter=8, inner_iters=20, inner_check_every=5,
+        pad_operators=True, effort=effort,
+    )
+    f_eq = centralized.equilibrium_forces(
+        params, alive=None if health is None else health.alive
+    )
+    if ctrl == "cadmm":
+        cs = cadmm.init_cadmm_state(params, cfg)
+        if health is not None:
+            cs = cs.replace(held=cs.f)
+    else:
+        cs = dd.init_dd_state(params, cfg)
+        if health is not None:
+            cs = cs.replace(held_f=cs.f, held_lam_F=cs.lam_F,
+                            held_lam_M=cs.lam_M)
+    vls = jnp.stack([
+        jnp.array([0.2, 0.1, 0.0]), jnp.array([-0.1, 0.3, 0.1]),
+        jnp.array([0.0, 0.0, -0.2]),
+    ])
+    states = jax.vmap(lambda v: state.replace(vl=v))(vls)
+    css = jax.vmap(lambda _: cs)(vls)
+
+    def one(ast, st):
+        return mod.control(
+            params, cfg, f_eq, ast, st, acc_des, health=health
+        )
+
+    f, _, stats = jax.jit(jax.vmap(one))(css, states)
+    return np.asarray(f), stats
+
+
+_HEALTH = faults_mod.FaultStep(
+    alive=jnp.array([False, True, True, True]),
+    thrust_scale=jnp.array([0.0, 1.0, 1.0, 1.0], jnp.float32),
+    msg_ok=jnp.array([False, True, False, True]),
+)
+
+
+@pytest.mark.parametrize("masked", [False, True],
+                         ids=["nominal", "alive-masked"])
+@pytest.mark.parametrize("ctrl", ["cadmm", "dd"])
+def test_adaptive_matches_fixed_within_res_bar(ctrl, masked):
+    """Acceptance: per-lane adaptive results match the fixed-iteration
+    solve within the paper's 1e-2 N consensus-residual tolerance —
+    nominal AND alive-masked, cadmm AND dd — and the adaptive arm's
+    effort accounting is populated and bounded by the static budget."""
+    health = _HEALTH if masked else None
+    f_fix, st_fix = _run_ctrl_batch(ctrl, "fixed", health)
+    f_ada, st_ada = _run_ctrl_batch(ctrl, "adaptive", health)
+    # Equal-quality bar: the adaptive arm converges to the same consensus
+    # tolerance (its residual under the paper's bar wherever fixed's is),
+    # and the applied forces agree within that bar.
+    res_a = np.asarray(st_ada.solve_res)
+    res_f = np.asarray(st_fix.solve_res)
+    assert np.all(res_a[res_f < 1e-2] < 1e-2)
+    assert np.abs(f_ada - f_fix).max() < 1e-2
+    # Effort accounting: populated scalar per lane, positive, and never
+    # above the static worst case (n agents x inner budget x outer
+    # iterations actually run).
+    inner = np.asarray(st_ada.inner_iters)
+    assert inner.shape == (3,)
+    iters = np.asarray(st_ada.iters)
+    assert np.all(inner > 0)
+    assert np.all(inner <= 4 * 20 * np.maximum(iters, 1))
+    # Fixed stays on the "not tracked" sentinel — no accounting staged.
+    assert st_fix.inner_iters.shape == (3, 0)
+
+
+# ----------------------------- telemetry --------------------------------
+
+
+def _stats(iters, inner=None):
+    return SolverStats(
+        iters=jnp.asarray(iters, jnp.int32),
+        solve_res=jnp.asarray(1e-3, jnp.float32),
+        collision=jnp.zeros((), bool),
+        min_env_dist=jnp.asarray(1.0, jnp.float32),
+        ok_frac=jnp.ones(()),
+        **({} if inner is None
+           else {"inner_iters": jnp.asarray(inner, jnp.int32)}),
+    )
+
+
+def test_telemetry_effort_histograms():
+    """The consensus-/inner-iteration histograms accumulate in-jit with
+    the documented bucket semantics and render in summary()'s effort
+    block."""
+    cfg = telemetry_mod.TelemetryConfig()
+    tel = telemetry_mod.init_telemetry(cfg)
+    for iters, inner in ((3, 60), (3, 30), (17, 340), (1, 4)):
+        tel = telemetry_mod.update(cfg, tel, _stats(iters, inner))
+    hist = np.asarray(tel.consensus_hist)
+    # Buckets (1, 2, 4, 8, 16, 32, ...): 3 -> "<=4" (idx 2) twice,
+    # 17 -> "<=32" (idx 5), 1 -> "<=1" (idx 0).
+    assert hist[2] == 2 and hist[5] == 1 and hist[0] == 1
+    assert hist.sum() == 4
+    assert int(tel.inner_iters_sum) == 60 + 30 + 340 + 4
+    # Inner histogram buckets inner/consensus-iter: 20, 10, 20, 4 —
+    # "<=32" (idx 5) twice, "<=16" (idx 4) once, "<=4" (idx 2) once.
+    ih = np.asarray(tel.inner_hist)
+    assert ih.sum() == 4
+    assert ih[5] == 2 and ih[4] == 1 and ih[2] == 1
+    s = telemetry_mod.summary(tel)
+    eff = s["effort"]
+    assert eff["consensus_hist"] == [int(v) for v in hist]
+    assert eff["iters_mean"] == pytest.approx((3 + 3 + 17 + 1) / 4)
+    assert eff["iters_p99"] == 32  # bucket-edge upper bound.
+    assert eff["inner_iters_sum"] == 434
+    # n_agents defaulted 0 -> per-solve normalizer 1.
+    assert eff["inner_per_solve_mean"] == pytest.approx(434 / 24)
+    # Per-agent normalization: the same stream at n_agents=10 buckets
+    # per-SOLVE values (2, 1, 2, 0.4) instead of saturating large-fleet
+    # totals, and the overflow bucket's percentile is None (JSON-safe),
+    # never Infinity.
+    tel10 = telemetry_mod.init_telemetry(cfg, n_agents=10)
+    for iters, inner in ((3, 60), (3, 30), (17, 340), (1, 4)):
+        tel10 = telemetry_mod.update(cfg, tel10, _stats(iters, inner))
+    ih10 = np.asarray(tel10.inner_hist)
+    assert ih10[1] == 2 and ih10[0] == 2  # <=2 twice, <=1 twice.
+    assert telemetry_mod.hist_percentile(
+        [0] * (telemetry_mod.N_ITER_BUCKETS - 1) + [5], 0.99
+    ) is None
+
+
+def test_telemetry_sentinel_iters_excluded_and_host_hist_aligned():
+    """The centralized controller's iters = -1 sentinel never lands in
+    the consensus histogram (a centralized rollout must not render a
+    bogus solver-effort section), and the HOST-side bucketing
+    (iter_histogram — what bench cells and the example print) places
+    edge values in the SAME right-closed buckets as the in-jit
+    accumulator."""
+    cfg = telemetry_mod.TelemetryConfig()
+    tel = telemetry_mod.init_telemetry(cfg)
+    tel = telemetry_mod.update(cfg, tel, _stats(-1))
+    assert int(np.asarray(tel.consensus_hist).sum()) == 0
+    # run_health's section guard keys on a non-empty histogram.
+    assert sum(telemetry_mod.summary(tel)["effort"]["consensus_hist"]) == 0
+    # Edge values: host and in-jit bucketing agree (np.histogram's
+    # left-closed bins would shift every power-of-two observation).
+    for v in (1, 2, 4, 8, 16, 17, 3000):
+        host = int(np.argmax(telemetry_mod.iter_histogram([v])))
+        injit = int(telemetry_mod.iter_bucket_index(jnp.asarray(v)))
+        assert host == injit, v
+
+
+def test_telemetry_effort_untracked_and_rollup():
+    """Untracked stats (the (0,) sentinel) leave the inner accumulators
+    alone; the batched cross-lane roll-up sums histograms and recomputes
+    the means."""
+    cfg = telemetry_mod.TelemetryConfig()
+    tel = telemetry_mod.init_telemetry(cfg)
+    tel = telemetry_mod.update(cfg, tel, _stats(5))
+    assert int(np.asarray(tel.inner_hist).sum()) == 0
+    assert int(tel.inner_iters_sum) == 0
+    assert "inner_iters_sum" not in telemetry_mod.summary(tel)["effort"]
+
+    def lane(iters, inner):
+        t = telemetry_mod.init_telemetry(cfg)
+        return telemetry_mod.update(cfg, t, _stats(iters, inner))
+
+    batched = jax.tree.map(
+        lambda *xs: jnp.stack(xs), lane(3, 60), lane(17, 340)
+    )
+    s = telemetry_mod.summary(batched)
+    assert s["lanes"] == 2
+    eff = s["effort"]
+    assert sum(eff["consensus_hist"]) == 2
+    assert eff["inner_iters_sum"] == 400
+    assert eff["iters_mean"] == pytest.approx(10.0)
+
+
+def test_run_health_effort_section_and_columns(tmp_path):
+    """run_health renders the solver-effort telemetry section and the
+    bench table's effort + iters columns from plain v4 cell fields."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import run_health
+
+    from tpu_aerial_transport.obs import export as export_mod
+
+    path = str(tmp_path / "rh.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("bench_cell", cell="cadmm_n16_effort_adaptive",
+           value={"rung": "cpu-tagged", "effort": "adaptive",
+                  "effort_resolved": "adaptive", "iters_mean": 5.25,
+                  "iters_p99": 9.0})
+    w.emit("bench_cell", cell="cadmm_n16_effort_fixed",
+           value={"rung": "cpu-tagged", "effort": "auto",
+                  "effort_resolved": "fixed", "iters_mean": 5.25})
+    s = run_health.summarize(export_mod.read_events(path))
+    rows = {r[0]: r for r in s["backend"]["rungs"]}
+    row = rows["cadmm_n16_effort_adaptive"]
+    assert row[4] == "adaptive" and row[5] == "5.2/9"
+    assert rows["cadmm_n16_effort_fixed"][4] == "auto(fixed)"
+    # The telemetry effort section renders without crashing and carries
+    # the histogram line (capsys-free: render to stdout via capsys would
+    # couple to pytest plugins; summarize()'s dict is the contract and
+    # render() is exercised on it below).
+    cfg = telemetry_mod.TelemetryConfig()
+    tel = telemetry_mod.update(
+        cfg, telemetry_mod.init_telemetry(cfg), _stats(3, 60)
+    )
+    w.emit("rollout_summary",
+           logs={"steps": 1, "rung_hist": [1, 0, 0, 0],
+                 "min_env_dist": 1.0, "collision_steps": 0,
+                 "residual": {"max": None}},
+           telemetry=telemetry_mod.summary(tel))
+    s = run_health.summarize(export_mod.read_events(path))
+    assert s["telemetry"]["effort"]["consensus_hist"][2] == 1
+    run_health.render(s)  # must not raise on the new sections.
+
+
+def test_logs_summary_consensus_iters():
+    """obs.export.logs_summary carries the exact consensus-iteration
+    digest (additive fields, schema-legal)."""
+    from tpu_aerial_transport.obs import export as export_mod
+
+    class Logs:
+        fallback_rung = np.zeros((4,), np.int32)
+        solve_res = np.full((4,), 1e-3, np.float32)
+        min_env_dist = np.ones((4,), np.float32)
+        collision = np.zeros((4,), bool)
+        quarantined = np.zeros((4,), bool)
+        iters = np.array([2, 4, 9, -1], np.int32)
+
+    out = export_mod.logs_summary(Logs())
+    ci = out["consensus_iters"]
+    assert ci["count"] == 3  # centralized's -1 excluded.
+    assert ci["mean"] == pytest.approx(5.0)
+    assert ci["max"] == 9
+
+
+# ----------------------------- bench cell -------------------------------
+
+
+def test_bench_effort_ab_cell(monkeypatch):
+    """bench._effort_ab_cell records the effort/effort_resolved pair, the
+    iteration-histogram fields, the residual quality bar, and — adaptive
+    arm only — the inner-effort fields (monkeypatched measurement, the
+    bf16-gate test idiom)."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    iters_seq = np.array([[3, 9], [3, 17]], np.int32)
+    inner_seq = np.array([[60, 180], [60, 340]], np.int32)
+
+    def fake_measure(controller, n, ns, effort, n_steps=10):
+        inner = inner_seq if effort == "adaptive" else None
+        return 1000.0, 1.0, iters_seq, inner, 2e-3
+
+    monkeypatch.setattr(bench, "_effort_measure", fake_measure)
+    v = bench._effort_ab_cell("cadmm", 16, 8, "adaptive")
+    assert v["effort"] == "adaptive"
+    assert v["effort_resolved"] == "adaptive"
+    # The solve label rides the ONE shared resolver with the chunking
+    # folded in ("auto" resolves to scan on this CPU host).
+    assert v["fused_resolved"] == "scan"
+    assert v["final_consensus_res"] == 2e-3 and v["res_bar"] == 1e-2
+    assert v["iters_mean"] == pytest.approx(iters_seq.mean())
+    assert v["iters_p99"] >= 9
+    assert sum(v["iters_hist"]) == 4
+    assert v["inner_iters_mean_per_step"] == pytest.approx(inner_seq.mean())
+    assert "inner_hist" in v and "inner_per_solve_mean" in v
+    v = bench._effort_ab_cell("dd", 16, 8, "fixed")
+    assert v["effort_resolved"] == "fixed"
+    assert "inner_hist" not in v

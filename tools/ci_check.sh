@@ -24,23 +24,27 @@ fail=0
 echo "== jaxlint (Tier A) =="
 python tools/jaxlint.py "${PATHS[@]}" || fail=1
 
-echo "== jaxlint --contracts --target tpu (ring + fused-kernel entrypoints) =="
+echo "== jaxlint --contracts --target tpu (ring + fused-kernel + effort entrypoints) =="
 # TC106 off-chip TPU lowering gate + Tier-B trace contracts over the
-# ring-exchange entrypoints (PR 7) and the whole-solve fused-ADMM kernel
+# ring-exchange entrypoints (PR 7), the whole-solve fused-ADMM kernel
 # entrypoints (PR 12: ops.admm_kernel:fused_solve_{interpret,pallas} —
 # the pallas entry's TC106 run is what catches a jax upgrade breaking
 # the compiled form's Mosaic lowering on a CPU box instead of at the
-# chip round). The ring entries need a >=4-device mesh, so force a
-# virtual-device CPU host through the ONE shared knob (utils/platform.py
-# TAT_VIRTUAL_DEVICES; default 4 here) — min_devices/waived entries
-# silently skip on 1-device boxes otherwise — and the gate is designed
-# to run off-chip (JAX_PLATFORMS=cpu even on a TPU box). The full
-# registry runs under `tools/jaxlint.py --contracts` / -m slow.
+# chip round), and the adaptive-effort entrypoints (PR 13:
+# ops.admm_kernel:fused_solve_earlyexit_{interpret,pallas} — the
+# in-kernel early-exit scf.while form — plus the adaptive consensus
+# steps control.{cadmm,dd}:control_adaptive). The ring entries need a
+# >=4-device mesh, so force a virtual-device CPU host through the ONE
+# shared knob (utils/platform.py TAT_VIRTUAL_DEVICES; default 4 here) —
+# min_devices/waived entries silently skip on 1-device boxes otherwise —
+# and the gate is designed to run off-chip (JAX_PLATFORMS=cpu even on a
+# TPU box). The full registry runs under `tools/jaxlint.py --contracts`
+# / -m slow.
 JAX_PLATFORMS=cpu \
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${TAT_VIRTUAL_DEVICES:-4}" \
 python tools/jaxlint.py --contracts --target tpu \
-    --only parallel.ring:consensus_exchange,parallel.ring:consensus_exchange_pallas,parallel.mesh:cadmm_control_sharded_ring,ops.admm_kernel:fused_solve_interpret,ops.admm_kernel:fused_solve_pallas \
-    tpu_aerial_transport/parallel/ring.py tpu_aerial_transport/ops/admm_kernel.py || fail=1
+    --only parallel.ring:consensus_exchange,parallel.ring:consensus_exchange_pallas,parallel.mesh:cadmm_control_sharded_ring,ops.admm_kernel:fused_solve_interpret,ops.admm_kernel:fused_solve_pallas,ops.admm_kernel:fused_solve_earlyexit_interpret,ops.admm_kernel:fused_solve_earlyexit_pallas,control.cadmm:control_adaptive,control.dd:control_adaptive \
+    tpu_aerial_transport/parallel/ring.py tpu_aerial_transport/ops/admm_kernel.py tpu_aerial_transport/control/cadmm.py tpu_aerial_transport/control/dd.py || fail=1
 
 echo "== pods 2-process parity smoke (tools/pods_local.py) =="
 # Bounded multi-process smoke of the pods tier (parallel/pods.py): 2

@@ -244,7 +244,7 @@ def summarize(events: list[dict]) -> dict:
     # "kernel(scan)" when the whole-solve kernel downgraded off-TPU, with
     # a "/bf16" (or "/bf16(f32)" after a parity-bar refusal) storage
     # suffix. Plain v4 bench_cell value fields; no schema change.
-    rungs: list[tuple[str, str, str, str]] = []
+    rungs: list[tuple] = []
     for e in cells:
         v = e.get("value")
         if isinstance(v, dict) and "rung" in v:
@@ -260,10 +260,23 @@ def summarize(events: list[dict]) -> dict:
             if prec and prec != "f32":
                 pr = v.get("precision_resolved", prec)
                 solve += f"/{prec}" if pr == prec else f"/{prec}({pr})"
-            rungs.append((e["cell"], impl, solve, v["rung"]))
+            # Solver-effort columns (the effort A/B cells, bench.py
+            # _effort_ab_cell; plain v4 value fields, no schema bump):
+            # the knob ("fixed(adaptive)" when request != resolved) and
+            # the measured consensus-iteration mean/p99 any
+            # rollout-shaped cell may carry.
+            effort = v.get("effort", "")
+            er = v.get("effort_resolved", effort)
+            if er and er != effort:
+                effort = f"{effort}({er})"
+            im, ip = v.get("iters_mean"), v.get("iters_p99")
+            iters = "" if im is None else (
+                f"{im:.1f}" + ("" if ip is None else f"/{ip:g}")
+            )
+            rungs.append((e["cell"], impl, solve, v["rung"], effort, iters))
     for e in chunks:
         if "rung" in e:
-            rungs.append((f"chunk {e['chunk']}", "", "", e["rung"]))
+            rungs.append((f"chunk {e['chunk']}", "", "", e["rung"], "", ""))
     if bevents or rungs:
         kinds: dict[str, int] = {}
         for e in bevents:
@@ -342,6 +355,33 @@ def render(summary: dict) -> None:
                         key=lambda i: tel["agent_fail_steps"][i])
             print(f"- per-agent solve failures: {tel['agent_fail_steps']} "
                   f"(worst: agent {worst})")
+        eff = tel.get("effort")
+        if eff and sum(eff.get("consensus_hist", [])):
+            # Solver-effort histograms (adaptive-effort observability;
+            # obs.telemetry ITER_BUCKETS log2 grid).
+            print("\n## solver effort (iteration histograms)")
+            print(f"- consensus iters/step: mean {_fmt(eff['iters_mean'])}"
+                  f", p99 <= {_fmt(eff['iters_p99'])}")
+            if "inner_iters_sum" in eff:
+                print(f"- inner iters total: {eff['inner_iters_sum']} "
+                      f"(per solve: mean "
+                      f"{_fmt(eff.get('inner_per_solve_mean'))}, "
+                      f"p99 <= "
+                      f"{_fmt(eff.get('inner_per_solve_p99'))})")
+            edges = [str(b) for b in eff["buckets"]] + [
+                f">{eff['buckets'][-1]}"
+            ]
+            rows = [("consensus", eff["consensus_hist"])]
+            if "inner_hist" in eff:
+                rows.append(("inner/solve", eff["inner_hist"]))
+            print("| histogram | " + " | ".join(
+                f"<={e}" if not e.startswith(">") else e for e in edges
+            ) + " |")
+            print("|" + "---|" * (len(edges) + 1))
+            for label, hist in rows:
+                print(f"| {label} | " + " | ".join(
+                    str(c) for c in hist
+                ) + " |")
     elif logs:
         print("\n## safety margins (from log digests)")
         print(f"- min env/CBF margin: {_fmt(logs['min_env_dist'])} m")
@@ -471,11 +511,14 @@ def render(summary: dict) -> None:
                       f"(ran at {e.get('rung', '?')}): "
                       f"{(e.get('detail') or '')[:120]}")
         if be["rungs"]:
-            print("\n| unit | exchange impl | solve impl | rung |")
-            print("|---|---|---|---|")
-            for unit, impl, solve, rung in be["rungs"]:
+            print("\n| unit | exchange impl | solve impl | effort | "
+                  "iters mean/p99 | rung |")
+            print("|---|---|---|---|---|---|")
+            for unit, impl, solve, rung, *rest in be["rungs"]:
+                effort = rest[0] if rest else ""
+                iters = rest[1] if len(rest) > 1 else ""
                 print(f"| {unit} | {impl or '—'} | {solve or '—'} | "
-                      f"{rung} |")
+                      f"{effort or '—'} | {iters or '—'} | {rung} |")
 
 
 def _latency_stats(xs: list[float]) -> dict | None:

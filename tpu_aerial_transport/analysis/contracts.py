@@ -405,6 +405,137 @@ def _build_fused_solve_pallas():
     return fn, make_args
 
 
+@_register("ops.admm_kernel:fused_solve_earlyexit_interpret")
+def _build_fused_solve_earlyexit_interpret():
+    """The in-kernel early-exit mega-kernel through the padded tier:
+    check_every=3 over iters=8 exercises BOTH the whole-cell while loop
+    (n_full=2) and the masked remainder chunk (rem=2); report_iters
+    covers the effective-iteration output. TC104 enforced — no tile
+    waiver (padded tier, like the fixed-iteration twin)."""
+    from tpu_aerial_transport.ops import socp
+
+    def fn(P, q, A, lb, ub):
+        return jax.vmap(
+            lambda Pb, qb: socp.solve_socp_padded(
+                Pb, qb, A, lb, ub, n_box=6, soc_dims=(4,), iters=8,
+                check_every=3, tol=1e-3, fused="kernel_interpret",
+                report_iters=True,
+            )
+        )(P, q)
+
+    def make_args():
+        P, q, A, lb, ub = _socp_problem()
+        return (jnp.tile(P[None], (2, 1, 1)), jnp.tile(q[None], (2, 1)),
+                A, lb, ub)
+
+    return fn, make_args
+
+
+@_register(
+    "ops.admm_kernel:fused_solve_earlyexit_pallas",
+    lowering_only="Mosaic whole-solve early-exit kernel: no CPU "
+    "execution — the compiled broadcast-reduce body with the scf.while "
+    "chunk loop only runs on a TPU. NO entrypoints.LOWERING_WAIVERS "
+    "row: jax.export AOT-lowers the while-loop form (per-lane masks, "
+    "int32 iteration output, f32 gate input) cleanly for the tpu "
+    "target on this image, so TC106 is enforced — a jax upgrade "
+    "breaking Mosaic's scf.while support fails tier-1 on a CPU box "
+    "instead of wedging the chip round.",
+)
+def _build_fused_solve_earlyexit_pallas():
+    """The REAL compiled early-exit kernel (interpret=False,
+    exact_dot=False) on the C-ADMM-shaped padded dims, with the
+    consensus-effort gate input wired (has_active=True — the fullest
+    signature the adaptive tier dispatches)."""
+    import numpy as np
+
+    from tpu_aerial_transport.ops import admm_kernel
+
+    B, nv, m, n_box, soc_dims = 8, 16, 32, 24, (4, 4)
+    d = nv + m
+
+    def fn(K2, Minv, A, P, q, rho, lb, ub, shift, x, y, z, active):
+        return admm_kernel.fused_solve_lanes(
+            x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift, active,
+            nv=nv, n_box=n_box, soc_dims=soc_dims, iters=8, alpha=1.6,
+            check_every=3, tol=1e-3, interpret=False,
+        )
+
+    def make_args():
+        rng = np.random.default_rng(0)
+        f32 = jnp.float32
+        return (
+            jnp.asarray(rng.standard_normal((B, d, d)) * 0.1, f32),
+            jnp.asarray(rng.standard_normal((B, nv, nv)) * 0.1, f32),
+            jnp.asarray(rng.standard_normal((B, m, nv)) * 0.1, f32),
+            jnp.asarray(rng.standard_normal((B, nv, nv)) * 0.1, f32),
+            jnp.asarray(rng.standard_normal((B, nv)), f32),
+            jnp.ones((B, m), f32), -jnp.ones((B, n_box), f32),
+            jnp.ones((B, n_box), f32), jnp.zeros((B, m), f32),
+            jnp.zeros((B, nv), f32), jnp.zeros((B, m), f32),
+            jnp.zeros((B, m), f32),
+            jnp.ones((B,), bool),
+        )
+
+    return fn, make_args
+
+
+def _adaptive_cfg_kw():
+    # inner_check_every=2 over inner_iters=4 exercises the gated chunk
+    # loop + remainder inside a real consensus step at lint-host sizes.
+    return dict(
+        max_iter=2, inner_iters=4, pad_operators=True,
+        effort="adaptive", inner_check_every=2,
+    )
+
+
+@_register("control.cadmm:control_adaptive")
+def _build_cadmm_adaptive():
+    """The adaptive-effort C-ADMM step (effort='adaptive' resolved at
+    make_config): the consensus loop's per-lane converged gate threads
+    into tolerance-chunked early-exit inner solves and the effort
+    accounting lands on SolverStats.inner_iters. pad_operators pinned
+    True (TC104 checks the tile-target program on the CPU lint host)."""
+    from tpu_aerial_transport.control import cadmm, centralized
+
+    params, col, state = _rqp_bits(4)
+    cfg = cadmm.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        **_adaptive_cfg_kw(),
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    plan = cadmm.make_plan(params, cfg)
+
+    def fn(cs, s, a):
+        return cadmm.control(params, cfg, f_eq, cs, s, a, plan=plan)
+
+    def make_args():
+        return (cadmm.init_cadmm_state(params, cfg), _rqp_bits(4)[2], _acc())
+
+    return fn, make_args
+
+
+@_register("control.dd:control_adaptive")
+def _build_dd_adaptive():
+    from tpu_aerial_transport.control import centralized, dd
+
+    params, col, state = _rqp_bits(4)
+    cfg = dd.make_config(
+        params, col.collision_radius, col.max_deceleration,
+        **_adaptive_cfg_kw(),
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    plan = dd.make_dd_plan(params, cfg)
+
+    def fn(cs, s, a):
+        return dd.control(params, cfg, f_eq, cs, s, a, plan=plan)
+
+    def make_args():
+        return (dd.init_dd_state(params, cfg), _rqp_bits(4)[2], _acc())
+
+    return fn, make_args
+
+
 @_register("ops.socp:solve_socp_padded")
 def _build_socp_padded():
     from tpu_aerial_transport.ops import socp

@@ -113,6 +113,29 @@ CONTRACT_ENTRYPOINTS: dict[str, str] = {
         "(fused_solve_lanes interpret=False — TPU-only execution; TC106 "
         "off-chip jax.export lowering ENFORCED, no waiver: the compiled "
         "form AOT-lowers cleanly for the tpu target on this image)",
+    "ops.admm_kernel:fused_solve_earlyexit_interpret":
+        "in-kernel early-exit mega-kernel through solve_socp_padded "
+        "(fused='kernel_interpret' + check_every/tol: per-lane converged "
+        "freezing, whole-grid-cell loop exit, and the effective-"
+        "iteration report in ONE pallas_call, interpret mode — the "
+        "bitwise-vs-scan twin of the tolerance-chunked path; "
+        "TC104-enforced on the padded tier)",
+    "ops.admm_kernel:fused_solve_earlyexit_pallas":
+        "in-kernel early-exit mega-kernel, compiled broadcast-reduce "
+        "form with the scf.while chunk loop + consensus-effort gate "
+        "input (fused_solve_lanes check_every/tol/active, "
+        "interpret=False — TPU-only execution; TC106 off-chip jax.export "
+        "lowering ENFORCED, no waiver: the while-loop form AOT-lowers "
+        "cleanly for the tpu target on this image — the PR-12 "
+        "precedent)",
+    "control.cadmm:control_adaptive":
+        "C-ADMM consensus control step with effort='adaptive' "
+        "(socp.resolve_effort): tolerance-chunked early-exit inner "
+        "solves gated by the consensus loop's own per-lane converged "
+        "state, SolverStats.inner_iters effort accounting",
+    "control.dd:control_adaptive":
+        "dual-decomposition control step with effort='adaptive' (the "
+        "cadmm twin: gated early-exit inner solves + effort accounting)",
     "harness.rollout:rollout": "nominal two-rate receding-horizon rollout",
     "harness.rollout:rollout_donated":
         "donation-clean jitted rollout (carries updated in place)",

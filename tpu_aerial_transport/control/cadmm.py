@@ -163,6 +163,19 @@ class RQPCADMMConfig:
     # (fixed-iteration solves, bit-identical to the historical path).
     inner_tol: float = struct.field(pytree_node=False, default=0.0)
     inner_check_every: int = struct.field(pytree_node=False, default=10)
+    # Consensus-level solver effort (ops/socp.py resolve_effort; "fixed" |
+    # "adaptive"). "adaptive" runs the inner solves tolerance-chunked with
+    # per-lane early exit (in-kernel on the fused "kernel" paths — one
+    # pallas_call per solve, operators read from HBM once) and threads the
+    # consensus loop's own per-scenario converged state into them, so a
+    # converged lane inside a vmapped batch stops paying full-budget
+    # re-solves while the loop drains stragglers; per-step effort lands on
+    # SolverStats.inner_iters for the telemetry histograms. "fixed" (the
+    # resolved default) stages NOTHING — byte-identical HLO to a pre-knob
+    # config (asserted in tests/test_effort.py). The make_config default
+    # is resolved at config build time ("auto" -> TAT_EFFORT env, else
+    # fixed); this field always holds the RESOLVED name.
+    effort: str = struct.field(pytree_node=False, default="fixed")
     # Tile-aligned operator layout (ops/socp.py padded tier): pad every
     # per-agent QP edge — variables and constraint rows — to the next
     # SUBLANE_TILE (8) multiple and run the inner ADMM on the padded
@@ -214,6 +227,7 @@ def make_config(
     pad_operators: bool | None = None,
     track_agent_stats: bool = False,
     consensus_impl: str = "auto",
+    effort: str = "auto",
 ) -> RQPCADMMConfig:
     """Defaults are reference-conservative (max_iter mirrors the reference's
     100-iteration cap). For warm-started receding-horizon use, the measured
@@ -284,6 +298,10 @@ def make_config(
         # socp_fused/pad_operators above: allreduce on CPU, ring on tiled
         # backends (parallel/ring.py resolve_consensus).
         consensus_impl=ring.resolve_consensus(consensus_impl),
+        # "auto" resolved here too (socp.resolve_effort: TAT_EFFORT env
+        # force, else "fixed" until the chip round's effort A/B cells
+        # pass the flip criterion written in its docstring).
+        effort=socp.resolve_effort(effort),
     )
 
 
@@ -1102,7 +1120,8 @@ def control(
             return (pk, (P, q0, A, lb, ub, shift),
                     socp.kkt_operator(P, A, rho_vec))
 
-        def primal_solve(solve_one, data, rho_k, lam, f_mean, warm):
+        def primal_solve(solve_one, data, rho_k, lam, f_mean, warm,
+                         lane_active):
             pk, (P, q0, A, lb, ub, shift), op = data
             delta = lam - rho_k * f_mean[None, :, :]  # (n_local, n, 3)
             dperm = jnp.take_along_axis(delta, pk.perm[:, :, None], axis=1)
@@ -1120,7 +1139,8 @@ def control(
                                  jnp.einsum("ajv,av->aj", pk.Mu, d_v)),
             ], axis=1)
             q = q0.at[:, :nv].add(q_delta)
-            sols = solve_one(P, q, A, lb, ub, shift, op, warm)
+            sols, eff = solve_one(P, q, A, lb, ub, shift, op, warm,
+                                  lane_active)
             c, u = sols.x[:, :9], sols.x[:, 9:12]
             ut = jnp.einsum("ij,aj->ai", Rl.T, u)
             d6 = (e0s[None, :] - jnp.einsum("kc,ac->ak", Ecc, c)
@@ -1136,7 +1156,7 @@ def control(
             )
             f_perm = jnp.concatenate([u[:, None, :], v], axis=1)
             f_new = jnp.take_along_axis(f_perm, pk.inv_perm[:, :, None], axis=1)
-            return f_new, sols
+            return f_new, sols, eff
     else:
         onehots = jax.nn.one_hot(agent_ids, n, dtype=dtype)
 
@@ -1151,14 +1171,16 @@ def control(
             )(lb, ub)
             return (P, q0, A, lb, ub, shift), socp.kkt_operator(P, A, rho_vec)
 
-        def primal_solve(solve_one, data, rho_k, lam, f_mean, warm):
+        def primal_solve(solve_one, data, rho_k, lam, f_mean, warm,
+                         lane_active):
             (P, q0, A, lb, ub, shift), op = data
             # Augmented linear term <lam_i, f> - rho <f_mean, f>.
             q_extra = (lam - rho_k * f_mean[None, :, :]).reshape(n_local, 3 * n)
             q = q0.at[:, 9:nv].add(q_extra)
-            sols = solve_one(P, q, A, lb, ub, shift, op, warm)
+            sols, eff = solve_one(P, q, A, lb, ub, shift, op, warm,
+                                  lane_active)
             f_new = sols.x[:, 9:nv].reshape(n_local, n, 3)
-            return f_new, sols
+            return f_new, sols, eff
 
     # rho schedule (reference :565-567, :657): precompute the per-agent QP
     # data + KKT operators for every distinct rho the capped schedule visits,
@@ -1192,17 +1214,55 @@ def control(
         def rho_at(it):
             return rho_arr[jnp.minimum(it, n_rho - 1)]
 
+    # Consensus-level adaptive effort (cfg.effort, socp.resolve_effort):
+    # every branch below is PYTHON-level, so effort="fixed" stages the
+    # exact pre-knob program (byte-identical HLO — asserted in
+    # tests/test_effort.py, the no_faults()/telemetry=None contract).
+    adaptive = cfg.effort == "adaptive"
+    if adaptive:
+        # Adaptive forces the tolerance-chunked early-exit solve path (a
+        # fixed-iteration scan cannot express a 0-effective-iteration
+        # pass-through); the stop tolerance defaults to the solve-success
+        # gate itself so "converged" means "would pass solver_tol".
+        inner_tol_eff = cfg.inner_tol if cfg.inner_tol > 0 else cfg.solver_tol
+        inner_check_eff = cfg.inner_check_every
+
     def make_solve(iters):
+        if not adaptive:
+            vs = jax.vmap(
+                lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_:
+                socp.solve_socp(
+                    P_, q_, A_, lb_, ub_,
+                    n_box=n_box, soc_dims=(4, 4), iters=iters,
+                    warm=warm_, shift=shift_, op=op_, fused=cfg.socp_fused,
+                    precision=cfg.socp_precision,
+                    tol=cfg.inner_tol,
+                    check_every=(cfg.inner_check_every if cfg.inner_tol > 0
+                                 else 0),
+                )
+            )
+
+            def solve(P_, q_, A_, lb_, ub_, shift_, op_, warm_, active):
+                del active  # fixed effort: no gating ops staged.
+                return vs(P_, q_, A_, lb_, ub_, shift_, op_, warm_), None
+
+            return solve
+
+        # The per-scenario converged gate broadcasts over the agent axis
+        # (in_axes None): a gated-off scenario's agent solves are all
+        # 0-effective-iteration pass-throughs; eff is the per-agent
+        # effective iteration count for SolverStats.inner_iters.
         return jax.vmap(
-            lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_: socp.solve_socp(
+            lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_, act_:
+            socp.solve_socp(
                 P_, q_, A_, lb_, ub_,
                 n_box=n_box, soc_dims=(4, 4), iters=iters,
                 warm=warm_, shift=shift_, op=op_, fused=cfg.socp_fused,
                 precision=cfg.socp_precision,
-                tol=cfg.inner_tol,
-                check_every=(cfg.inner_check_every if cfg.inner_tol > 0
-                             else 0),
-            )
+                tol=inner_tol_eff, check_every=inner_check_eff,
+                active=act_, report_iters=True,
+            ),
+            in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None),
         )
 
     solve_cold = make_solve(cfg.inner_iters)
@@ -1210,12 +1270,29 @@ def control(
     two_phase = warm_iters != cfg.inner_iters
     solve_warm = make_solve(warm_iters) if two_phase else solve_cold
 
+    def _continue_pred(it, res, ok_last, fail_count):
+        """The outer loop's continue predicate — shared by ``cond`` and
+        the adaptive-effort lane gate so the two cannot drift."""
+        return (((res >= cfg.res_tol)
+                 | ((ok_last < 1.0) & (fail_count <= retry_cap)))
+                & (it <= cfg.max_iter))
+
     def _consensus_iter_impl(solve_one, carry):
         (f, lam, f_mean, warm, it, res, err_buf, okf, _ok_last,
-         fail_count) = carry
+         fail_count) = carry[:10]
+        if adaptive:
+            # The lane's own would-continue bit (under vmap the outer
+            # while_loop body runs for every lane while ANY lane is
+            # active; this gate is what lets a converged lane's solves
+            # pass through at 0 effective iterations instead of paying
+            # the stragglers' budget).
+            lane_active = _continue_pred(it, res, _ok_last, fail_count)
+        else:
+            lane_active = None
         with phases.scope(phases.LOCAL_SOLVE):
-            f_new, sols = primal_solve(
-                solve_one, qp_at(it), rho_at(it), lam, f_mean, warm
+            f_new, sols, eff = primal_solve(
+                solve_one, qp_at(it), rho_at(it), lam, f_mean, warm,
+                lane_active,
             )
         # Failed agents fall back to equilibrium forces (reference :491-494).
         ok = (sols.prim_res < cfg.solver_tol)[:, None, None] & jnp.all(
@@ -1293,8 +1370,14 @@ def control(
         # CONSECUTIVE failing iterations: reset on fully-ok ones so a
         # late-onset failure episode always gets the full retry budget.
         fail_count = jnp.where(ok_last < 1.0, fail_count + 1, 0)
-        return (f_new, lam_new, f_mean_new, sols, it, res_new, err_buf, okf,
-                ok_last, fail_count)
+        out = (f_new, lam_new, f_mean_new, sols, it, res_new, err_buf, okf,
+               ok_last, fail_count)
+        if adaptive:
+            # Effective inner iterations actually spent this consensus
+            # iteration (summed over this shard's agents) — the solver-
+            # effort accounting behind SolverStats.inner_iters.
+            out = out + (carry[10] + jnp.sum(eff),)
+        return out
 
     # Per-lane batch semantics: no manual freeze is needed — lax.while_loop's
     # batching rule re-evaluates the full per-lane cond inside the body and
@@ -1306,7 +1389,9 @@ def control(
     retry_cap = cfg.solve_retry_iters or cfg.max_iter
 
     def cond(carry):
-        *_, it, res, _buf, _okf, ok_last, fail_count = carry
+        # Positional indexing (the adaptive-effort carry appends an
+        # inner-iteration accumulator at the end): it=4, res=5,
+        # ok_last=8, fail_count=9.
         # Keep iterating while any agent's solve is still failing, even at
         # consensus agreement: fallback copies agree trivially (all
         # equilibrium), so a residual-only exit would declare convergence
@@ -1315,9 +1400,7 @@ def control(
         # solve_retry_iters (default 4) FAILING iterations — counted from
         # failure onset, not from iteration 0, so late-onset failures get
         # the full budget.
-        return (((res >= cfg.res_tol)
-                 | ((ok_last < 1.0) & (fail_count <= retry_cap)))
-                & (it <= cfg.max_iter))
+        return _continue_pred(carry[4], carry[5], carry[8], carry[9])
 
     err_buf0 = jnp.full((cfg.max_iter + 1,), jnp.nan, dtype)
     init = (
@@ -1325,6 +1408,8 @@ def control(
         jnp.zeros((), jnp.int32), jnp.asarray(jnp.inf, dtype), err_buf0,
         jnp.ones((), dtype), jnp.ones((), dtype), jnp.zeros((), jnp.int32),
     )
+    if adaptive:
+        init = init + (jnp.zeros((), jnp.int32),)  # inner-iteration total.
     if not two_phase:
         carry = init
     else:
@@ -1336,10 +1421,11 @@ def control(
         # vmap it becomes a select that executes both solver branches for
         # every lane.)
         carry = consensus_iter(solve_cold, init)
-    (f, lam, f_mean, warm, iters, res, err_buf, ok_frac,
-     _ok_last, _fail_count) = lax.while_loop(
+    carry = lax.while_loop(
         cond, lambda c: consensus_iter(solve_warm, c), carry
     )
+    (f, lam, f_mean, warm, iters, res, err_buf, ok_frac,
+     _ok_last, _fail_count) = carry[:10]
 
     # Applied forces: agent i applies its own column (reference :669-675).
     f_app = f[jnp.arange(n_local), agent_ids, :]
@@ -1361,6 +1447,16 @@ def control(
         err_seq=err_buf,
         ok_frac=ok_frac,
     )
+    if adaptive:
+        # Whole-fleet effective inner iterations this step (exchanged
+        # once, outside the loop; f32 exchange is exact far past any
+        # realistic count and keeps the ring impls dtype-uniform).
+        inner_tot = carry[10]
+        if axis_name is not None:
+            inner_tot = _exch(inner_tot.astype(dtype), "sum").astype(
+                jnp.int32
+            )
+        stats = stats.replace(inner_iters=inner_tot)
     if cfg.track_agent_stats:
         # Exit-time per-agent QP residuals for solve-health telemetry
         # (obs.telemetry track_agents): the final warm start's prim_res,

@@ -44,6 +44,15 @@ from tpu_aerial_transport.ops import lie, socp
 from tpu_aerial_transport.parallel import ring
 
 
+# Stop tolerance of DD's GATE-ONLY adaptive-effort default (see
+# control()'s make_solve note): effectively never reached by a
+# warm-started solve, so DD's default adaptivity is the bias-free
+# consensus-level gate alone. Named so callers that must LABEL the
+# dispatch (bench._effort_ab_cell's shared-resolver call) read the same
+# constant control() dispatches with.
+ADAPTIVE_GATE_TOL = 1e-6
+
+
 @struct.dataclass
 class RQPDDConfig:
     """DD constants (reference ``_set_controller_constants``, rqp_dd.py:197-241 and
@@ -74,6 +83,7 @@ def make_config(
     pad_operators: bool | None = None,
     track_agent_stats: bool = False,
     consensus_impl: str = "auto",
+    effort: str = "auto",
 ) -> RQPDDConfig:
     """Defaults are reference-conservative. For warm-started receding-horizon
     use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
@@ -100,6 +110,7 @@ def make_config(
         solve_retry_iters=solve_retry_iters, pad_operators=pad_operators,
         track_agent_stats=track_agent_stats,
         consensus_impl=consensus_impl,
+        effort=effort,
     )
     return RQPDDConfig(base=base, prim_inf_tol=prim_inf_tol)
 
@@ -614,26 +625,79 @@ def control(
 
     G_local = jax.vmap(lambda r: lie.hat(r) @ state.Rl.T)(r_com_local)
 
-    solve_one = jax.vmap(
-        lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_: socp.solve_socp(
-            P_, q_, A_, lb_, ub_,
-            n_box=n_box, soc_dims=(4, 4), iters=base.inner_iters,
-            warm=warm_, shift=shift_, op=op_, fused=base.socp_fused,
-            precision=base.socp_precision,
-            tol=base.inner_tol,
-            check_every=(base.inner_check_every if base.inner_tol > 0
-                         else 0),
+    # Consensus-level adaptive effort (base.effort, socp.resolve_effort):
+    # Python-level branches only, so effort="fixed" stages the exact
+    # pre-knob program (the cadmm.control contract; asserted in
+    # tests/test_effort.py).
+    adaptive = base.effort == "adaptive"
+    if not adaptive:
+        _solve_v = jax.vmap(
+            lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_: socp.solve_socp(
+                P_, q_, A_, lb_, ub_,
+                n_box=n_box, soc_dims=(4, 4), iters=base.inner_iters,
+                warm=warm_, shift=shift_, op=op_, fused=base.socp_fused,
+                precision=base.socp_precision,
+                tol=base.inner_tol,
+                check_every=(base.inner_check_every if base.inner_tol > 0
+                             else 0),
+            )
         )
-    )
+
+        def solve_one(P_, q_, A_, lb_, ub_, shift_, op_, warm_, active):
+            del active  # fixed effort: no gating ops staged.
+            return _solve_v(P_, q_, A_, lb_, ub_, shift_, op_, warm_), None
+    else:
+        # Tolerance-chunked solves with the per-scenario converged gate
+        # broadcast over the agent axis (see the matching cadmm.control
+        # make_solve). DD's default is GATE-ONLY — a 1e-6 tolerance the
+        # warm-started solves essentially never hit — NOT C-ADMM's
+        # solver_tol: the quasi-Newton dual ascent is biased by
+        # tolerance-missed primal optima (the make_config k_smooth note),
+        # and the bias is SCALE-dependent — measured: at 5e-3 the n=4
+        # cold-start A/B rails the outer cap (mean 24.5 vs 2.0 outer
+        # iterations, residual 0.105 vs the 1e-2 bar); 5e-4 repairs n=4
+        # (res 8.9e-3, ~3x less inner effort) but still breaks the n=64
+        # bench cell (outer 20.9 vs 3.1, residual 0.178 vs 0.009). The
+        # consensus-level gate is bias-FREE at any scale (a gated lane's
+        # outputs are discarded by the outer freeze regardless), so it is
+        # the only adaptivity DD enables by default; callers who want DD
+        # inner early exit at a scale they have validated opt in via
+        # inner_tol.
+        _dd_tol = (base.inner_tol if base.inner_tol > 0
+                   else ADAPTIVE_GATE_TOL)
+        solve_one = jax.vmap(
+            lambda P_, q_, A_, lb_, ub_, shift_, op_, warm_, act_:
+            socp.solve_socp(
+                P_, q_, A_, lb_, ub_,
+                n_box=n_box, soc_dims=(4, 4), iters=base.inner_iters,
+                warm=warm_, shift=shift_, op=op_, fused=base.socp_fused,
+                precision=base.socp_precision,
+                tol=_dd_tol, check_every=base.inner_check_every,
+                active=act_, report_iters=True,
+            ),
+            in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None),
+        )
 
     # Solver-failure fallbacks (reference :486-489): equilibrium forces and the
     # aggregates they imply.
     fallback_F = jnp.sum(f_eq, axis=0)[None, :] - f_eq_local
     fallback_M = -jnp.einsum("ij,njk,nk->ni", params.JT_inv, G_local, f_eq_local)
 
+    def _continue_pred(it, err, ok_last, fail_count):
+        """The dual-ascent loop's continue predicate — shared by ``cond``
+        and the adaptive-effort lane gate so the two cannot drift."""
+        return (((err >= cfg.prim_inf_tol)
+                 | ((ok_last < 1.0) & (fail_count <= retry_cap)))
+                & (it <= base.max_iter))
+
     def dd_iter(carry):
         (f, F, M, lam_F, lam_M, warm, it, err, err_buf, okf, _ok_last,
-         fail_count) = carry
+         fail_count) = carry[:12]
+        if adaptive:
+            # The lane's own would-continue bit (see cadmm.control).
+            lane_active = _continue_pred(it, err, _ok_last, fail_count)
+        else:
+            lane_active = None
         # Price assembly (the all-gather, reference :716-722) — two psum
         # reductions over the agent axis. With health, each agent's
         # NETWORK-VISIBLE price contribution is its held (stale) value
@@ -662,7 +726,8 @@ def control(
             q = (q0.at[:, 9:12].add(c_f).at[:, 12:15].add(c_F)
                  .at[:, 15:18].add(c_M))
         with phases.scope(phases.LOCAL_SOLVE):
-            sols = solve_one(P, q, A, lb, ub, shift, op, warm)
+            sols, eff = solve_one(P, q, A, lb, ub, shift, op, warm,
+                                  lane_active)
         x = sols.x
         ok = (sols.prim_res < base.solver_tol) & jnp.all(
             jnp.isfinite(x), axis=-1
@@ -743,8 +808,13 @@ def control(
         ok_last = _sum_over_agents(ok.astype(dtype)) / n
         okf = jnp.minimum(okf, ok_last)  # worst-iteration success fraction.
         fail_count = jnp.where(ok_last < 1.0, fail_count + 1, 0)  # consecutive.
-        return (f_new, F_new, M_new, lam_F_new, lam_M_new, warm_new, it,
-                err_new, err_buf, okf, ok_last, fail_count)
+        out = (f_new, F_new, M_new, lam_F_new, lam_M_new, warm_new, it,
+               err_new, err_buf, okf, ok_last, fail_count)
+        if adaptive:
+            # Effective inner iterations spent this dual-ascent iteration
+            # (this shard's agents) — see the matching cadmm.control note.
+            out = out + (carry[12] + jnp.sum(eff),)
+        return out
 
     # Per-lane batch semantics: lax.while_loop's batching rule already
     # selects old-vs-new carry per lane from the full per-lane cond, so
@@ -754,16 +824,16 @@ def control(
     retry_cap = base.solve_retry_iters or base.max_iter
 
     def cond(carry):
-        *_, it, err, _buf, _okf, ok_last, fail_count = carry
+        # Positional indexing (the adaptive-effort carry appends an
+        # inner-iteration accumulator at the end): it=6, err=7,
+        # ok_last=10, fail_count=11.
         # Solve failures keep the loop alive even at primal feasibility:
         # fallback values can satisfy the consensus equations trivially
         # while the failed agents' true solves still need retries (see the
         # matching note in cadmm.control's cond; bounded by
         # solve_retry_iters (default 4) FAILING iterations, counted from
         # failure onset).
-        return (((err >= cfg.prim_inf_tol)
-                 | ((ok_last < 1.0) & (fail_count <= retry_cap)))
-                & (it <= base.max_iter))
+        return _continue_pred(carry[6], carry[7], carry[10], carry[11])
 
     err_buf0 = jnp.full((base.max_iter + 1,), jnp.nan, dtype)
     init = (
@@ -772,8 +842,11 @@ def control(
         err_buf0, jnp.ones((), dtype), jnp.ones((), dtype),
         jnp.zeros((), jnp.int32),
     )
+    if adaptive:
+        init = init + (jnp.zeros((), jnp.int32),)  # inner-iteration total.
+    carry = lax.while_loop(cond, dd_iter, init)
     (f, F, M, lam_F, lam_M, warm, iters, err, err_buf, ok_frac,
-     _ok_last, _fail_count) = lax.while_loop(cond, dd_iter, init)
+     _ok_last, _fail_count) = carry[:12]
 
     if health is not None:
         # Delivered-snapshot updates (see the matching cadmm.control note).
@@ -798,6 +871,15 @@ def control(
         err_seq=err_buf,
         ok_frac=ok_frac,
     )
+    if adaptive:
+        # Whole-fleet effective inner iterations this step (see the
+        # matching cadmm.control note on the f32 exchange).
+        inner_tot = carry[12]
+        if axis_name is not None:
+            inner_tot = _exch(inner_tot.astype(dtype), "sum").astype(
+                jnp.int32
+            )
+        stats = stats.replace(inner_iters=inner_tot)
     if base.track_agent_stats:
         # Exit-time per-agent QP residuals for solve-health telemetry
         # (see the matching cadmm.control block).

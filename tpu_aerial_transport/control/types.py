@@ -44,6 +44,16 @@ class SolverStats:
     agent_solve_res: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.zeros((0,))
     )
+    # Total effective inner ADMM iterations this control step (summed over
+    # agents and consensus iterations) for the solver-effort telemetry
+    # histograms (obs.telemetry). Populated ONLY by the consensus
+    # controllers under ``effort="adaptive"`` (a Python-level branch, so
+    # the fixed-effort program is byte-identical to the pre-knob one);
+    # the (0,) default means "not tracked" — the agent_solve_res sentinel
+    # convention.
+    inner_iters: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.zeros((0,), jnp.int32)
+    )
 
 
 @struct.dataclass

@@ -189,6 +189,11 @@ def logs_summary(logs, quantiles=(0.5, 0.9, 0.99)) -> dict:
     rung = np.asarray(logs.fallback_rung).reshape(-1)
     res = np.asarray(logs.solve_res).reshape(-1).astype(np.float64)
     res = res[np.isfinite(res)]
+    # Exact per-step consensus-iteration digest (the solver-effort view;
+    # the centralized controller reports -1 and is excluded). Additive
+    # fields — schema-legal within the current version.
+    it = np.asarray(logs.iters).reshape(-1)
+    it = it[it >= 0]
     out = {
         "steps": int(rung.size),
         "rung_hist": [
@@ -211,6 +216,12 @@ def logs_summary(logs, quantiles=(0.5, 0.9, 0.99)) -> dict:
                 )
                 for p in quantiles
             },
+        },
+        "consensus_iters": {
+            "count": int(it.size),
+            "mean": float(it.mean()) if it.size else None,
+            "p99": float(np.percentile(it, 99)) if it.size else None,
+            "max": int(it.max()) if it.size else None,
         },
     }
     return out

@@ -44,6 +44,41 @@ from flax import struct
 # Fallback-ladder rung count (resilience.rollout RUNG_* constants 0-3).
 N_RUNGS = 4
 
+# Solver-effort histogram buckets (log2-spaced upper edges; the last
+# bucket is the > ITER_BUCKETS[-1] overflow). Static: any config's
+# max_iter / inner budget lands in the same fixed-shape accumulators, so
+# the carry structure never depends on the controller. Bucket i counts
+# observations v with ITER_BUCKETS[i-1] < v <= ITER_BUCKETS[i].
+ITER_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048)
+N_ITER_BUCKETS = len(ITER_BUCKETS) + 1
+
+
+def iter_bucket_index(v) -> jnp.ndarray:
+    """Static-shape bucket index for one iteration-count observation
+    (int or float — the inner-effort stream is a per-consensus-iteration
+    RATIO, bucketed un-floored so the in-jit histogram agrees with the
+    host-side :func:`iter_histogram` on the same values)."""
+    edges = jnp.asarray(ITER_BUCKETS, jnp.asarray(v).dtype)
+    return jnp.sum((v > edges).astype(jnp.int32))
+
+
+def _iter_one_hot(v) -> jnp.ndarray:
+    return (iter_bucket_index(v)
+            == jnp.arange(N_ITER_BUCKETS)).astype(jnp.int32)
+
+
+def iter_histogram(values) -> np.ndarray:
+    """Host-side histogram on the :data:`ITER_BUCKETS` grid with the SAME
+    right-closed bucket semantics as :func:`iter_bucket_index`
+    (bucket i counts v <= ITER_BUCKETS[i], first match) — the one
+    implementation bench cells and examples share so their histograms
+    and the in-jit telemetry accumulators read on the same axis
+    (np.histogram's left-closed bins would shift every edge-valued
+    observation one bucket)."""
+    v = np.asarray(values).reshape(-1)
+    idx = np.searchsorted(np.asarray(ITER_BUCKETS), v, side="left")
+    return np.bincount(idx, minlength=N_ITER_BUCKETS)
+
 
 @struct.dataclass
 class TelemetryConfig:
@@ -81,6 +116,15 @@ class TelemetryState:
     steps: jnp.ndarray  # () int32 — HL steps accumulated.
     rung_hist: jnp.ndarray  # (N_RUNGS,) int32 fallback-rung counts.
     iters_sum: jnp.ndarray  # () int32 — total consensus iterations.
+    # Solver-effort histograms (adaptive-effort observability; log2
+    # buckets, :data:`ITER_BUCKETS`): per-step consensus iteration counts,
+    # and — when the controller tracks it (effort="adaptive" populates
+    # SolverStats.inner_iters) — per-step inner ADMM iterations PER SOLVE
+    # (per consensus iteration per agent — see ``n_agents``), plus the
+    # raw inner-iteration total.
+    consensus_hist: jnp.ndarray  # (N_ITER_BUCKETS,) int32.
+    inner_hist: jnp.ndarray  # (N_ITER_BUCKETS,) int32.
+    inner_iters_sum: jnp.ndarray  # () int32.
     ok_frac_min: jnp.ndarray  # () worst-step solve-success fraction.
     min_env_dist: jnp.ndarray  # () running min CBF/env margin.
     collision_steps: jnp.ndarray  # () int32.
@@ -100,6 +144,12 @@ class TelemetryState:
     quantiles: tuple = struct.field(
         pytree_node=False, default=(0.5, 0.9, 0.99)
     )
+    # Fleet size (static; init_telemetry's n_agents): normalizes the
+    # inner-effort histogram to PER-SOLVE iterations — an agents-summed
+    # total would saturate the static bucket grid at large n (64 x 40
+    # already overflows 2048, the pods tier by 100x). 0 = unknown,
+    # treated as 1.
+    n_agents: int = struct.field(pytree_node=False, default=0)
 
 
 def no_telemetry() -> TelemetryConfig:
@@ -117,9 +167,13 @@ def init_telemetry(
     na = n_agents if cfg.track_agents else 0
     return TelemetryState(
         quantiles=tuple(cfg.quantiles),
+        n_agents=int(n_agents),
         steps=jnp.zeros((), jnp.int32),
         rung_hist=jnp.zeros((N_RUNGS,), jnp.int32),
         iters_sum=jnp.zeros((), jnp.int32),
+        consensus_hist=jnp.zeros((N_ITER_BUCKETS,), jnp.int32),
+        inner_hist=jnp.zeros((N_ITER_BUCKETS,), jnp.int32),
+        inner_iters_sum=jnp.zeros((), jnp.int32),
         ok_frac_min=jnp.ones((), dtype),
         min_env_dist=jnp.asarray(jnp.inf, dtype),
         collision_steps=jnp.zeros((), jnp.int32),
@@ -242,13 +296,48 @@ def update(
 
     quar = (jnp.zeros((), bool) if quarantined is None
             else quarantined.astype(bool))
+    # Solver-effort histograms. Consensus: every step's iteration count;
+    # the centralized controller's sentinel iters = -1 is EXCLUDED from
+    # the histogram (the logs_summary `it >= 0` rule — clipping it into
+    # bucket 0 would render a bogus "solver effort" section for a
+    # controller with no consensus loop) while iters_sum keeps its
+    # pre-existing clip-at-0 semantics. Inner: only when the controller
+    # tracks effort (SolverStats.inner_iters is a populated scalar under
+    # effort="adaptive"; the (0,) default means "not tracked" — same
+    # sentinel convention as agent_solve_res), as inner iterations PER
+    # SOLVE (per consensus iteration per agent) — the per-QP effort the
+    # adaptive tier actually modulates, and scale-free across fleets.
+    iters_step = jnp.maximum(stats.iters.astype(jnp.int32), 0)
+    consensus_hist = tel.consensus_hist + _iter_one_hot(iters_step) * (
+        stats.iters.astype(jnp.int32) >= 0
+    ).astype(jnp.int32)
+    inner = getattr(stats, "inner_iters", None)
+    inner_tracked = inner is not None and inner.ndim == 0
+    if inner_tracked:
+        inner_step = jnp.maximum(inner.astype(jnp.int32), 0)
+        # Un-floored PER-SOLVE ratio (inner total / consensus iters /
+        # fleet size): the bench cells and the example bucket the SAME
+        # float value (iter_bucket_index handles floats), so the three
+        # surfaces genuinely read on one axis — and the value is
+        # scale-free (an agents-summed total saturates the static
+        # bucket grid at large n).
+        inner_hist = tel.inner_hist + _iter_one_hot(
+            inner_step.astype(dtype)
+            / (jnp.maximum(iters_step, 1) * max(tel.n_agents, 1))
+        )
+        inner_sum = tel.inner_iters_sum + inner_step
+    else:
+        inner_hist = tel.inner_hist
+        inner_sum = tel.inner_iters_sum
     return TelemetryState(
         quantiles=tel.quantiles,
+        n_agents=tel.n_agents,
         steps=tel.steps + 1,
         rung_hist=rung_hist,
-        iters_sum=tel.iters_sum + jnp.maximum(
-            stats.iters.astype(jnp.int32), 0
-        ),
+        iters_sum=tel.iters_sum + iters_step,
+        consensus_hist=consensus_hist,
+        inner_hist=inner_hist,
+        inner_iters_sum=inner_sum,
         ok_frac_min=jnp.minimum(
             tel.ok_frac_min, stats.ok_frac.astype(dtype)
         ),
@@ -339,6 +428,52 @@ def residual_percentiles(
     return out
 
 
+def hist_percentile(hist, p: float):
+    """Bucket-edge percentile estimate from an :data:`ITER_BUCKETS`
+    histogram (host-side): the upper edge of the first bucket whose
+    cumulative count reaches ``p`` of the total — conservative (an upper
+    bound within the log2 grid). None on an empty histogram AND on the
+    overflow bucket (an infinite upper bound has no JSON spelling —
+    ``json.dumps(inf)`` emits the non-standard ``Infinity`` token into
+    the metrics jsonl; readers render None as "—")."""
+    hist = np.asarray(hist)
+    total = int(hist.sum())
+    if not total:
+        return None
+    cum = np.cumsum(hist)
+    idx = int(np.searchsorted(cum, p * total))
+    if idx >= len(ITER_BUCKETS):
+        return None  # overflow bucket: no finite upper edge.
+    return ITER_BUCKETS[idx]
+
+
+def _effort_summary(tel: TelemetryState) -> dict:
+    """JSON-ready solver-effort block (the adaptive-effort observability
+    section run_health renders): consensus-iteration histogram + mean /
+    bucket-p99, and — when the controller tracked it — the PER-SOLVE
+    inner-iteration histogram (inner total / consensus iters / fleet
+    size — scale-free on the static bucket grid) and totals."""
+    steps = int(np.asarray(tel.steps))
+    iters_sum = int(np.asarray(tel.iters_sum))
+    inner_sum = int(np.asarray(tel.inner_iters_sum))
+    out = {
+        "buckets": list(ITER_BUCKETS),
+        "consensus_hist": [int(v) for v in np.asarray(tel.consensus_hist)],
+        "iters_mean": (iters_sum / steps) if steps else None,
+        "iters_p99": hist_percentile(tel.consensus_hist, 0.99),
+    }
+    if int(np.asarray(tel.inner_hist).sum()) or inner_sum:
+        na = max(tel.n_agents, 1)
+        out["inner_hist"] = [int(v) for v in np.asarray(tel.inner_hist)]
+        out["inner_iters_sum"] = inner_sum
+        out["n_agents"] = tel.n_agents
+        out["inner_per_solve_mean"] = (
+            inner_sum / (iters_sum * na) if iters_sum else None
+        )
+        out["inner_per_solve_p99"] = hist_percentile(tel.inner_hist, 0.99)
+    return out
+
+
 def summary(tel: TelemetryState, cfg: TelemetryConfig | None = None) -> dict:
     """Render an accumulator (device arrays or a host/numpy snapshot copy)
     to the JSON-ready dict ``obs.export`` embeds in metrics events.
@@ -366,6 +501,7 @@ def summary(tel: TelemetryState, cfg: TelemetryConfig | None = None) -> dict:
         "min_env_dist": float(np.asarray(tel.min_env_dist)),
         "collision_steps": int(np.asarray(tel.collision_steps)),
         "quarantine_steps": int(np.asarray(tel.quarantine_steps)),
+        "effort": _effort_summary(tel),
         "residual": {
             "count": count,
             "min": float(np.asarray(tel.res_min)) if count else None,
@@ -384,6 +520,37 @@ def summary(tel: TelemetryState, cfg: TelemetryConfig | None = None) -> dict:
     return out
 
 
+def _rollup_effort(per: list[dict], iters_sums: list[int]) -> dict:
+    """Cross-lane roll-up of per-lane effort blocks: histograms sum (every
+    lane shares the static :data:`ITER_BUCKETS` grid), means recompute
+    from the EXACT per-lane integer totals (``iters_sums`` — the lanes'
+    ``iters_sum`` accumulators; reconstructing them from the float means
+    would drift and silently assume steps == histogram count)."""
+    nb = N_ITER_BUCKETS
+    hist = [sum(p["consensus_hist"][i] for p in per) for i in range(nb)]
+    steps = sum(h for h in hist)
+    iters_sum = sum(iters_sums)
+    out = {
+        "buckets": list(ITER_BUCKETS),
+        "consensus_hist": hist,
+        "iters_mean": (iters_sum / steps) if steps else None,
+        "iters_p99": hist_percentile(hist, 0.99),
+    }
+    inners = [p for p in per if "inner_hist" in p]
+    if inners:
+        ih = [sum(p["inner_hist"][i] for p in inners) for i in range(nb)]
+        isum = sum(p["inner_iters_sum"] for p in inners)
+        na = max(inners[0].get("n_agents", 0), 1)  # lanes share a fleet.
+        out["inner_hist"] = ih
+        out["inner_iters_sum"] = isum
+        out["n_agents"] = inners[0].get("n_agents", 0)
+        out["inner_per_solve_mean"] = (
+            isum / (iters_sum * na) if iters_sum else None
+        )
+        out["inner_per_solve_p99"] = hist_percentile(ih, 0.99)
+    return out
+
+
 def _batched_summary(tel: TelemetryState) -> dict:
     """Cross-lane roll-up of a batched accumulator (see :func:`summary`)."""
     lanes = _lane_summaries(tel)
@@ -397,6 +564,9 @@ def _batched_summary(tel: TelemetryState) -> dict:
             sum(p["rung_hist"][i] for p in per) for i in range(N_RUNGS)
         ],
         "iters_sum": sum(p["iters_sum"] for p in per),
+        "effort": _rollup_effort(
+            [p["effort"] for p in per], [p["iters_sum"] for p in per]
+        ),
         "ok_frac_min": min(p["ok_frac_min"] for p in per),
         "min_env_dist": min(p["min_env_dist"] for p in per),
         "collision_steps": sum(p["collision_steps"] for p in per),

@@ -281,11 +281,10 @@ def admm_chunk_lanes(
 # ----------------------------------------------------------------------
 
 def _fused_solve_kernel(
-    K2_ref, Minv_ref, A_ref, P_ref, q_ref, rho_ref, lb_ref, ub_ref,
-    shift_ref, x0_ref, y0_ref, z0_ref,
-    xo_ref, yo_ref, zo_ref, res_ref,
-    *, nv: int, n_box: int, soc_dims: tuple, iters: int, alpha: float,
+    *refs,
+    nv: int, n_box: int, soc_dims: tuple, iters: int, alpha: float,
     has_shift: bool, exact_dot: bool,
+    check_every: int = 0, tol: float = 0.0, has_active: bool = False,
 ):
     """One grid cell: a SOLVE_BATCH_TILE-wide slab of complete ADMM solves.
 
@@ -329,7 +328,35 @@ def _fused_solve_kernel(
     for the exit residuals. bf16 storage (fused_solve_lanes
     ``precision="bf16"``) halves the operator payload; the kernel upcasts
     to f32 before every contraction, so accumulation is always f32.
+
+    **In-kernel early exit** (``check_every > 0 and tol > 0``): instead of
+    one fixed ``fori_loop``, the kernel runs chunks of ``check_every``
+    iterations under a ``lax.while_loop`` with a per-lane converged mask —
+    converged lanes take explicit frozen (select) updates, the whole grid
+    cell exits as soon as EVERY lane in it converges (the compiled
+    ``scf.while`` form jax.export-lowers clean for the TPU target on this
+    image, so the entry carries NO lowering waiver), and the per-lane
+    effective iteration counts are written to an extra ``(T, 1)`` int32
+    output. The mask logic transcribes solve_socp's tolerance-chunked
+    scan loop per lane (the explicit-masked form that is value-identical
+    to ``lax.while_loop``'s own vmap batching rule), so the interpret
+    twin stays BITWISE equal to the scan path. ``has_active`` adds a
+    ``(T, 1)`` f32 gate input (consensus-level adaptive effort,
+    ops/socp.py ``active=``): a gated-off lane contributes 0 chunks —
+    the 0-effective-iteration pass-through.
     """
+    early = bool(check_every) and tol > 0.0
+    (K2_ref, Minv_ref, A_ref, P_ref, q_ref, rho_ref, lb_ref, ub_ref,
+     shift_ref, x0_ref, y0_ref, z0_ref) = refs[:12]
+    k = 12
+    act_ref = None
+    if early and has_active:
+        act_ref = refs[k]
+        k += 1
+    if early:
+        xo_ref, yo_ref, zo_ref, res_ref, it_ref = refs[k:]
+    else:
+        xo_ref, yo_ref, zo_ref, res_ref = refs[k:]
     f32 = jnp.float32
     K2 = K2_ref[...].astype(f32)
     Minv = Minv_ref[...].astype(f32)
@@ -411,10 +438,57 @@ def _fused_solve_kernel(
             dual = jnp.max(jnp.abs(mv(P, x) + q + ATy), axis=-1)
             return prim, dual
 
-    x, y, z = lax.fori_loop(
-        0, iters, body, (x0_ref[...], y0_ref[...], z0_ref[...]),
-        unroll=False,
-    )
+    carry0 = (x0_ref[...], y0_ref[...], z0_ref[...])
+    if not early:
+        x, y, z = lax.fori_loop(0, iters, body, carry0, unroll=False)
+    else:
+        # Tolerance-chunked with per-lane freezing: the masked transcription
+        # of solve_socp's explicit check_every/tol loop (value-identical per
+        # lane to lax.while_loop's vmap batching rule — see the docstring).
+        n_full, rem = divmod(iters, check_every)
+        T = carry0[0].shape[0]
+        if act_ref is not None:
+            gate = act_ref[...][:, 0] > 0.0
+        else:
+            gate = jnp.ones((T,), bool)
+
+        def above_tol(c):
+            prim, dual = res_pair(*c)
+            return (prim > tol) | (dual > tol)
+
+        def chunk(c, n_it):
+            return lax.fori_loop(0, n_it, body, c, unroll=False)
+
+        n_chunks = jnp.zeros((T,), jnp.int32)
+        carry = carry0
+        if n_full:
+            def loop_cond(s):
+                return jnp.any(s[2])
+
+            def loop_body(s):
+                c, i, act = s
+                new = chunk(c, check_every)
+                m = act[:, None]
+                c = tuple(jnp.where(m, a, b) for a, b in zip(new, c))
+                i = i + act.astype(jnp.int32)
+                act = act & (i < n_full) & above_tol(c)
+                return (c, i, act)
+
+            carry, n_chunks, _ = lax.while_loop(
+                loop_cond, loop_body, (carry, n_chunks, gate & above_tol(carry))
+            )
+        eff = n_chunks * check_every
+        if rem:
+            # The remainder chunk mirrors the scan path's vmapped lax.cond
+            # (= select over both branches) — keeping the total at exactly
+            # ``iters`` for never-converging lanes.
+            need = gate & above_tol(carry)
+            new = chunk(carry, rem)
+            m = need[:, None]
+            carry = tuple(jnp.where(m, a, b) for a, b in zip(new, carry))
+            eff = eff + jnp.where(need, rem, 0)
+        x, y, z = carry
+        it_ref[...] = eff[:, None]
     xo_ref[...] = x
     yo_ref[...] = y
     zo_ref[...] = z
@@ -436,13 +510,14 @@ def _pad_batch(a, B_pad, fill=0.0):
 @functools.partial(
     jax.jit,
     static_argnames=("nv", "n_box", "soc_dims", "iters", "alpha",
-                     "precision", "interpret", "exact_dot"),
+                     "precision", "interpret", "exact_dot", "check_every",
+                     "tol"),
 )
 def fused_solve_lanes(
-    x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift=None,
+    x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift=None, active=None,
     *, nv: int, n_box: int, soc_dims: tuple, iters: int, alpha: float,
     precision: str = "f32", interpret: bool = False,
-    exact_dot: bool | None = None,
+    exact_dot: bool | None = None, check_every: int = 0, tol: float = 0.0,
 ):
     """Run whole batched solves through :func:`_fused_solve_kernel`: args
     are batch-first ``(B, rows...)`` as produced by the vmap folding in
@@ -452,6 +527,16 @@ def fused_solve_lanes(
     the Mosaic-lowerable broadcast-reduce body when compiled (see the
     kernel docstring); pass it explicitly to test the compiled form's
     numerics under the interpreter.
+
+    ``check_every``/``tol`` (both nonzero) select the in-kernel early-exit
+    form: per-lane converged masks checked every ``check_every``
+    iterations INSIDE the one pallas_call — converged lanes freeze via
+    explicit selects, a grid cell's loop exits when all its lanes
+    converge — and the return gains a sixth element ``eff_iters`` ((B,)
+    int32 per-lane effective iteration counts). ``active`` ((B,) bool;
+    early-exit form only) gates lanes off from the start — a gated lane
+    is the 0-effective-iteration pass-through the consensus-level
+    adaptive-effort tier rides (ops/socp.py ``solve_socp(active=)``).
 
     ``precision="bf16"`` stores the operator matrices (K2, Minv, A, P) in
     bfloat16 — halving the HBM->VMEM operator payload, the dominant
@@ -467,6 +552,14 @@ def fused_solve_lanes(
     m = rho.shape[-1]
     d = nv + m
     has_shift = shift is not None
+    early = bool(check_every) and tol > 0.0
+    has_active = early and active is not None
+    if active is not None and not early:
+        raise ValueError(
+            "active= gating needs the early-exit form (check_every > 0 "
+            "and tol > 0): a fixed-iteration kernel cannot express a "
+            "0-effective-iteration pass-through"
+        )
     if exact_dot is None:
         exact_dot = interpret
     B_pad = max(
@@ -500,6 +593,10 @@ def fused_solve_lanes(
         # signed zeros vs the scan path's shift=None branch.
         inputs.append(jnp.zeros((B_pad, m), dtype))
     inputs += [xp, yp, zp]
+    if has_active:
+        # (B, 1) f32 gate (2-D keeps Mosaic on well-trodden block shapes;
+        # pad lanes gate OFF so they cannot hold a grid cell's loop open).
+        inputs.append(_pad_batch(active.astype(dtype)[:, None], B_pad))
 
     grid = (B_pad // SOLVE_BATCH_TILE,)
 
@@ -514,22 +611,37 @@ def fused_solve_lanes(
         _fused_solve_kernel,
         nv=nv, n_box=n_box, soc_dims=tuple(soc_dims), iters=iters,
         alpha=alpha, has_shift=has_shift, exact_dot=exact_dot,
+        check_every=check_every if early else 0, tol=tol if early else 0.0,
+        has_active=has_active,
     )
-    xo, yo, zo, res = pl.pallas_call(
+    in_specs = [
+        spec((d, d)), spec((nv, nv)), spec((m, nv)), spec((nv, nv)),
+        spec((nv,)), spec((m,)), spec((n_box,)), spec((n_box,)),
+        spec((m,)), spec((nv,)), spec((m,)), spec((m,)),
+    ]
+    if has_active:
+        in_specs.append(spec((1,)))
+    out_specs = [spec((nv,)), spec((m,)), spec((m,)), spec((2,))]
+    out_shape = [
+        jax.ShapeDtypeStruct((B_pad, nv), dtype),
+        jax.ShapeDtypeStruct((B_pad, m), dtype),
+        jax.ShapeDtypeStruct((B_pad, m), dtype),
+        jax.ShapeDtypeStruct((B_pad, 2), dtype),
+    ]
+    if early:
+        out_specs.append(spec((1,)))
+        out_shape.append(jax.ShapeDtypeStruct((B_pad, 1), jnp.int32))
+    outs = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            spec((d, d)), spec((nv, nv)), spec((m, nv)), spec((nv, nv)),
-            spec((nv,)), spec((m,)), spec((n_box,)), spec((n_box,)),
-            spec((m,)), spec((nv,)), spec((m,)), spec((m,)),
-        ],
-        out_specs=[spec((nv,)), spec((m,)), spec((m,)), spec((2,))],
-        out_shape=[
-            jax.ShapeDtypeStruct((B_pad, nv), dtype),
-            jax.ShapeDtypeStruct((B_pad, m), dtype),
-            jax.ShapeDtypeStruct((B_pad, m), dtype),
-            jax.ShapeDtypeStruct((B_pad, 2), dtype),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(*inputs)
+    if early:
+        xo, yo, zo, res, eff = outs
+        return (xo[:B], yo[:B], zo[:B], res[:B, 0], res[:B, 1],
+                eff[:B, 0])
+    xo, yo, zo, res = outs
     return xo[:B], yo[:B], zo[:B], res[:B, 0], res[:B, 1]

@@ -284,7 +284,8 @@ def padded_kkt_operator(P, A, lb, ub, shift=None, *, n_box: int,
 @partial(
     jax.jit,
     static_argnames=("n_box", "soc_dims", "iters", "check_every", "tol",
-                     "fused", "alpha", "rho", "sigma", "precision"),
+                     "fused", "alpha", "rho", "sigma", "precision",
+                     "report_iters"),
 )
 def solve_socp_padded(
     P: jnp.ndarray,
@@ -306,13 +307,17 @@ def solve_socp_padded(
     pqp: PaddedKKTOp | None = None,
     fused: str = "auto",
     precision: str = "f32",
-) -> SOCPSolution:
+    active: jnp.ndarray | None = None,
+    report_iters: bool = False,
+):
     """Tile-aligned :func:`solve_socp`: pads the problem to its bucket
     (:func:`padded_dims`), solves on the padded layout, and returns the
     solution in the UNPADDED layout (pad variables/rows sliced off). Accepts
     a prebuilt :class:`PaddedKKTOp` via ``pqp`` for operator reuse across
     solves; ``warm`` is an UNPADDED warm start. Agreement with the unpadded
-    path is to f32 reduction-order rounding (tests/test_socp_padded.py)."""
+    path is to f32 reduction-order rounding (tests/test_socp_padded.py).
+    ``active``/``report_iters`` pass through to :func:`solve_socp` (the
+    adaptive-effort gate and the effective-iteration report)."""
     nv = P.shape[-1]
     n_box_p = padded_dims(nv, n_box, soc_dims)[1]
     if pqp is None:
@@ -329,8 +334,11 @@ def solve_socp_padded(
         n_box=n_box_p, soc_dims=tuple(soc_dims), iters=iters, rho=rho,
         sigma=sigma, alpha=alpha, warm=warm_p, check_every=check_every,
         tol=tol, shift=pqp.shift, op=pqp.op, fused=fused,
-        precision=precision,
+        precision=precision, active=active, report_iters=report_iters,
     )
+    if report_iters:
+        sol, eff = sol
+        return unpad_solution(sol, nv, n_box, n_box_p), eff
     return unpad_solution(sol, nv, n_box, n_box_p)
 
 
@@ -401,6 +409,34 @@ def _admm_step(carry, K2, w2, rho_vec, lb, ub, shift, *,
     return (x_new, y_new, z_new)
 
 
+def _fold_batch_rules(batched, single, n_out: int) -> None:
+    """Attach the ONE recursive vmap-folding rule pair every fused-solve
+    runner shares (see :func:`_fused_chunk_runner`'s docstring for the
+    folding rationale): the ``batched`` rule FOLDS each new (leading)
+    vmap axis into the kernel's existing batch axis, the ``single`` rule
+    lifts an unbatched call into ``batched`` — one copy, so an axis-
+    ordering fix cannot drift between runners."""
+
+    @batched.def_vmap
+    def _batched_rule(axis_size, in_batched, *args):
+        folded = []
+        for a, b in zip(args, in_batched):
+            if not b:
+                a = jnp.broadcast_to(a[None], (axis_size,) + a.shape)
+            folded.append(a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]))
+        outs = batched(*folded)
+        unfold = lambda o: o.reshape((axis_size, -1) + o.shape[1:])
+        return tuple(unfold(o) for o in outs), (True,) * n_out
+
+    @single.def_vmap
+    def _single_rule(axis_size, in_batched, *args):
+        lifted = [
+            a if b else jnp.broadcast_to(a[None], (axis_size,) + a.shape)
+            for a, b in zip(args, in_batched)
+        ]
+        return batched(*lifted), (True,) * n_out
+
+
 @functools.lru_cache(maxsize=None)
 def _fused_chunk_runner(nv: int, n_box: int, soc_dims: tuple, iters: int,
                         alpha: float, interpret: bool):
@@ -425,32 +461,13 @@ def _fused_chunk_runner(nv: int, n_box: int, soc_dims: tuple, iters: int,
             iters=iters, interpret=interpret, **kw,
         )
 
-    @batched.def_vmap
-    def _batched_rule(axis_size, in_batched, *args):
-        # Fold the new (leading) vmap axis into the existing lane axis.
-        folded = []
-        for a, b in zip(args, in_batched):
-            if not b:
-                a = jnp.broadcast_to(a[None], (axis_size,) + a.shape)
-            folded.append(a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]))
-        outs = batched(*folded)
-        unfold = lambda o: o.reshape((axis_size, -1) + o.shape[1:])
-        return tuple(unfold(o) for o in outs), (True, True, True)
-
     @jax.custom_batching.custom_vmap
     def single(x, y, z, K2, w2, rho, lb, ub, shift):
         def stepf(c, _):
             return _admm_step(c, K2, w2, rho, lb, ub, shift, **kw), None
         return lax.scan(stepf, (x, y, z), None, length=iters)[0]
 
-    @single.def_vmap
-    def _single_rule(axis_size, in_batched, *args):
-        lifted = [
-            a if b else jnp.broadcast_to(a[None], (axis_size,) + a.shape)
-            for a, b in zip(args, in_batched)
-        ]
-        return batched(*lifted), (True, True, True)
-
+    _fold_batch_rules(batched, single, 3)
     return single
 
 
@@ -489,18 +506,6 @@ def _fused_solve_runner(nv: int, n_box: int, soc_dims: tuple, iters: int,
             return xo, yo, zo, prim, dual
         return xo, yo, zo
 
-    @batched.def_vmap
-    def _batched_rule(axis_size, in_batched, *args):
-        # Fold the new (leading) vmap axis into the existing batch axis.
-        folded = []
-        for a, b in zip(args, in_batched):
-            if not b:
-                a = jnp.broadcast_to(a[None], (axis_size,) + a.shape)
-            folded.append(a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]))
-        outs = batched(*folded)
-        unfold = lambda o: o.reshape((axis_size, -1) + o.shape[1:])
-        return tuple(unfold(o) for o in outs), (True,) * n_out
-
     @jax.custom_batching.custom_vmap
     def single(x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift):
         # The scan path's own per-instance program (bitwise twin of the
@@ -519,15 +524,195 @@ def _fused_solve_runner(nv: int, n_box: int, soc_dims: tuple, iters: int,
             return x, y, z, prim, dual
         return x, y, z
 
-    @single.def_vmap
-    def _single_rule(axis_size, in_batched, *args):
-        lifted = [
-            a if b else jnp.broadcast_to(a[None], (axis_size,) + a.shape)
-            for a, b in zip(args, in_batched)
-        ]
-        return batched(*lifted), (True,) * n_out
-
+    _fold_batch_rules(batched, single, n_out)
     return single
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_solve_exit_runner(nv: int, n_box: int, soc_dims: tuple,
+                             iters: int, alpha: float, interpret: bool,
+                             has_shift: bool, precision: str,
+                             check_every: int, tol: float,
+                             has_active: bool):
+    """Early-exit twin of :func:`_fused_solve_runner`: the WHOLE
+    tolerance-chunked solve — w2 build, chunks of ``check_every``
+    iterations with per-lane converged freezing, whole-grid-cell loop
+    exit, the exit residual reduction, and the per-lane effective
+    iteration count — in ONE ``pallas_call``
+    (admm_kernel.fused_solve_lanes ``check_every/tol``). This is what
+    closes the PR-12 regression where a ``check_every/tol`` solve wrapped
+    ``run_chunk`` in an XLA-side ``lax.while_loop`` that re-launched the
+    kernel (re-streaming every operator from HBM) once per chunk.
+
+    Returns ``(x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift, active) ->
+    (x, y, z, prim_res, dual_res, eff_iters)``. ``active`` is the
+    per-lane consensus-effort gate ((,) bool per instance; a fixed-arity
+    all-ones placeholder when ``has_active`` is False — like ``shift``,
+    statically skipped so the common path stages no gating ops). The
+    ``single`` twin is the scan path's OWN explicit-masked chunk loop
+    (bitwise oracle; value-identical to lax.while_loop's vmap batching
+    rule), so vmapping it ≡ the kernel's interpret body by construction.
+    """
+    from tpu_aerial_transport.ops import admm_kernel
+
+    kw = dict(nv=nv, n_box=n_box, soc_dims=soc_dims, alpha=alpha)
+    n_out = 6
+
+    @jax.custom_batching.custom_vmap
+    def batched(x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift, active):
+        outs = admm_kernel.fused_solve_lanes(
+            x, y, z, K2, Minv, A, P, q, rho, lb, ub,
+            shift if has_shift else None,
+            active if has_active else None,
+            iters=iters, precision=precision, interpret=interpret,
+            check_every=check_every, tol=tol, **kw,
+        )
+        return outs
+
+    @jax.custom_batching.custom_vmap
+    def single(x, y, z, K2, Minv, A, P, q, rho, lb, ub, shift, active):
+        # The scan path's own per-instance program: w2 build + the
+        # explicit-masked tolerance-chunked loop (bitwise twin of the
+        # kernel body's vmapped functions — see solve_socp's tol path).
+        wq = Minv @ q
+        w2 = jnp.concatenate([wq, A @ wq])
+        s = shift if has_shift else None
+
+        def stepf(c, _):
+            return _admm_step(c, K2, w2, rho, lb, ub, s, **kw), None
+
+        def run_chunk(c, n_it):
+            return lax.scan(stepf, c, None, length=n_it)[0]
+
+        def residuals(c):
+            prim = jnp.max(jnp.abs(A @ c[0] - c[2]))
+            dual = jnp.max(jnp.abs(P @ c[0] + q + A.T @ c[1]))
+            return prim, dual
+
+        def above_tol(c):
+            prim, dual = residuals(c)
+            return (prim > tol) | (dual > tol)
+
+        gate = active > 0 if has_active else None
+        carry, n_chunks, eff = _masked_chunk_loop(
+            (x, y, z), run_chunk, above_tol, gate, iters, check_every,
+        )
+        prim, dual = residuals(carry)
+        return carry[0], carry[1], carry[2], prim, dual, eff
+
+    _fold_batch_rules(batched, single, n_out)
+    return single
+
+
+def _masked_chunk_loop(carry0, run_chunk, above_tol, gate, iters: int,
+                       check_every: int):
+    """The ONE tolerance-chunked early-exit loop body (per instance):
+    chunks of ``check_every`` iterations under a ``lax.while_loop`` whose
+    carry holds an EXPLICIT per-lane active bit — converged (or
+    ``gate``-masked) lanes take frozen select updates, so under ``vmap``
+    the cond is the honest any-lane-active test and frozen lanes are
+    documented-cheap selects rather than an implicit batching-rule
+    artifact. Value-identical per lane to the pre-explicit form (the
+    batching rule applied the same select itself — regression-pinned
+    bitwise vs the unbatched solve in tests/test_effort.py).
+
+    Shared by solve_socp's scan/pallas tol path and the kernel runner's
+    ``single`` twin so the mask logic cannot drift between them. Returns
+    ``(carry, n_chunks, eff_iters)`` with ``eff_iters`` the effective
+    iteration count actually applied (0 for a gated-off lane — the
+    consensus-level adaptive-effort pass-through).
+    """
+    n_full, rem = divmod(iters, check_every)
+    n_chunks = jnp.zeros((), jnp.int32)
+    carry = carry0
+
+    def working(c):
+        # gate=None stages NO gating ops (the plain inner_tol path).
+        return above_tol(c) if gate is None else gate & above_tol(c)
+
+    if n_full:
+        def cond(s):
+            # The lane's own active bit; lax.while_loop's vmap batching
+            # rule ORs lanes — the honest any-lane-active test.
+            return s[2]
+
+        def body(s):
+            c, i, act = s
+            new = run_chunk(c, check_every)
+            c = jax.tree.map(lambda a, b: jnp.where(act, a, b), new, c)
+            i = i + act.astype(jnp.int32)
+            act = act & (i < n_full) & above_tol(c)
+            return (c, i, act)
+
+        carry, n_chunks, _ = lax.while_loop(
+            cond, body, (carry, n_chunks, working(carry))
+        )
+    eff = n_chunks * check_every
+    if rem:
+        # Remainder chunk keeps the total at exactly ``iters`` when the
+        # budget is not a multiple of check_every (skipped if converged
+        # or gated off; a select over both branches under vmap).
+        need = working(carry)
+        carry = lax.cond(
+            need, lambda c: run_chunk(c, rem), lambda c: c, carry
+        )
+        eff = eff + jnp.where(need, rem, 0)
+    return carry, n_chunks, eff
+
+
+# The consensus-level solver-effort vocabulary (controllers'
+# ``effort=`` knob; see :func:`resolve_effort`).
+EFFORTS = ("fixed", "adaptive")
+
+
+def resolve_effort(effort: str | None = "auto") -> str:
+    """Resolve the controllers' consensus-level solver-effort knob at
+    CONFIG BUILD time (the :func:`resolve_fused`/``resolve_consensus``
+    idiom): ``"auto"`` (or None) consults the ``TAT_EFFORT`` env var
+    (``fixed`` | ``adaptive`` | ``auto``/unset) and otherwise stays
+    ``"fixed"`` — the reference's fixed-iteration-cap behavior, which
+    compiles HLO identical to a pre-knob config (asserted in
+    tests/test_effort.py; the ``no_faults()``/``telemetry=None``
+    zero-cost contract).
+
+    ``"adaptive"`` makes effort follow convergence through the whole
+    stack: the inner ADMM solves run tolerance-chunked with per-lane
+    early exit (in-kernel on the fused="kernel" path — one pallas_call,
+    operators read from HBM once per solve), and the consensus loop
+    threads its own per-scenario converged mask into them so a converged
+    lane's solve is a 0-effective-iteration pass-through instead of a
+    full-budget re-solve; per-step effort lands on
+    ``SolverStats.inner_iters`` for the telemetry histograms.
+
+    **Chip-round flip criterion** (for making ``adaptive`` the non-CPU
+    default; the decision cells are ``{cadmm,dd}_n{16,64}_effort_
+    {fixed,adaptive}`` in BENCH_SWEEP.json): (1) the adaptive arm beats
+    its fixed twin by >= 15% scenario-MPC-steps/s at EQUAL
+    consensus-residual quality — both arms' ``final_consensus_res``
+    under the paper's 1e-2 N bar (an adaptive "win" that gave back
+    convergence is a refusal, not a flip); (2) the recorded iteration
+    histograms (``iters_hist`` / the telemetry effort section) confirm
+    the straggler spread the adaptivity exists to exploit — a
+    near-degenerate histogram means the workload has no spread and the
+    measured win is noise; (3) the parity suite (tests/test_effort.py:
+    adaptive vs fixed within 1e-2 N, nominal AND alive-masked, cadmm AND
+    dd) stays green on-chip."""
+    if effort is None:
+        effort = "auto"
+    if effort == "auto":
+        env = os.environ.get("TAT_EFFORT", "").strip().lower()
+        if env in EFFORTS:
+            return env
+        if env not in ("", "auto"):
+            raise ValueError(
+                f"TAT_EFFORT={env!r}: expected one of {EFFORTS} or 'auto'"
+            )
+        return "fixed"
+    if effort not in EFFORTS:
+        raise ValueError(
+            f"effort={effort!r}: expected one of {EFFORTS} or 'auto'"
+        )
+    return effort
 
 
 def resolve_fused(fused: str) -> str:
@@ -650,18 +835,29 @@ def _resolve_fused(fused: str) -> str:
 
 
 def runtime_fused_mode(fused: str, nv: int, m: int,
-                       n_box: int | None = None) -> str:
+                       n_box: int | None = None, *,
+                       check_every: int = 0, tol: float = 0.0) -> str:
     """The mode :func:`solve_socp` will ACTUALLY run for ``fused`` at
     operator dims ``(nv, m)`` on this host: "auto" backend resolution,
-    the "kernel" off-TPU trace-time downgrade, and the VMEM-residency
+    the "kernel" off-TPU trace-time downgrade, the VMEM-residency
     fallbacks (``fused_solve_fits`` for the whole-solve kernel,
-    ``MAX_FUSED_DIM`` for the chunk kernel). ONE resolver shared by
-    solve_socp's dispatch and by anything that must LABEL a measurement
-    with the mode that really ran (bench.py's fused A/B cells record it
-    as ``fused_resolved`` — a cell whose dims silently fell back to scan
-    must not be read as a kernel verdict)."""
+    ``MAX_FUSED_DIM`` for the chunk kernel), and the CHUNKING mode —
+    pass the solve's ``check_every``/``tol`` so a tolerance-chunked
+    measurement is labeled by the same decision that dispatches it. ONE
+    resolver shared by solve_socp's dispatch and by anything that must
+    LABEL a measurement with the mode that really ran (bench.py's
+    fused/effort A/B cells record it as ``fused_resolved`` — a cell
+    whose dims silently fell back to scan must not be read as a kernel
+    verdict). A ``check_every/tol`` solve on the "kernel" paths runs the
+    in-kernel early-exit form — still ONE pallas_call, so "kernel" is an
+    honest label; before the early-exit form existed, a tol-chunked
+    solve labeled "kernel" actually paid an XLA-side while_loop of
+    per-chunk kernel relaunches (the label drift this fold closes)."""
     # Host-side strings only (the ring._resolve_impl pattern), never a
     # traced value.
+    del check_every, tol  # both kernel forms exist for every chunking
+    # mode today; the args are part of the contract so a future
+    # constraint lands HERE (label + dispatch together), not in a caller.
     mode = _resolve_fused(fused)
     if mode == "kernel" and _kernel_runs_offchip():  # jaxlint: disable=JL005
         mode = "scan"
@@ -699,7 +895,7 @@ def resolve_pad_operators(pad: bool | None) -> bool:
     # Python-level cache key), and it is an algorithm constant at every call
     # site — a traced alpha would also break the scan/pallas parity contract.
     static_argnames=("n_box", "soc_dims", "iters", "check_every", "tol",
-                     "fused", "alpha", "precision"),
+                     "fused", "alpha", "precision", "report_iters"),
 )
 def solve_socp(
     P: jnp.ndarray,
@@ -721,7 +917,9 @@ def solve_socp(
     op: KKTOp | None = None,
     fused: str = "auto",
     precision: str = "f32",
-) -> SOCPSolution:
+    active: jnp.ndarray | None = None,
+    report_iters: bool = False,
+):
     """Solve one conic QP. All array args may carry leading batch axes only via
     ``vmap`` (this function itself is single-instance).
 
@@ -757,10 +955,27 @@ def solve_socp(
       precision: operator storage on the "kernel" paths — "f32", or "bf16"
         (bf16-storage / f32-accumulation of K2/Minv/A/P; halves the HBM
         operator payload). Inert on scan/pallas paths.
+      active: optional () bool gate (tolerance-chunked path only — the
+        consensus-level adaptive-effort tier): False makes this solve a
+        0-effective-iteration pass-through of the warm start, so a
+        converged consensus lane inside a vmapped batch stops paying for
+        stragglers. None (the default) stages no gating ops.
+      report_iters: when True, return ``(solution, eff_iters)`` with
+        ``eff_iters`` the () int32 iteration count actually applied
+        (``iters`` on the fixed path; chunks-run x check_every (+ the
+        remainder) on the tolerance-chunked path — the effort-telemetry
+        input). False (the default) keeps the historical single-value
+        return.
     """
     m, nv = A.shape
     assert m == n_box + sum(soc_dims)
     dtype = P.dtype
+    if active is not None and not (check_every and tol > 0):
+        raise ValueError(
+            "solve_socp(active=) needs the tolerance-chunked path "
+            "(check_every > 0 and tol > 0): a fixed-iteration solve "
+            "cannot express a 0-effective-iteration pass-through"
+        )
 
     rho_vec = make_rho_vec(m, n_box, lb, ub, rho, dtype)
 
@@ -788,10 +1003,14 @@ def solve_socp(
     # "auto" backend resolution, the "kernel" off-TPU trace-time
     # downgrade (the ring._resolve_impl precedent — a backend-guard CPU
     # re-run of a kernel-configured cell still measures a working solve),
-    # and the VMEM-residency fallbacks, all in the ONE shared resolver so
-    # measurement labels (bench fused_resolved) cannot drift from
-    # dispatch.
-    fused_mode = runtime_fused_mode(fused, nv, m, n_box)
+    # the VMEM-residency fallbacks, AND the chunking mode (a
+    # check_every/tol solve dispatches the early-exit kernel form), all
+    # in the ONE shared resolver so measurement labels (bench
+    # fused_resolved) cannot drift from dispatch.
+    tol_path = bool(check_every) and tol > 0
+    fused_mode = runtime_fused_mode(
+        fused, nv, m, n_box, check_every=check_every, tol=tol
+    )
     solve_kernel = fused_mode in ("kernel", "kernel_interpret")
 
     if not solve_kernel:
@@ -826,14 +1045,9 @@ def solve_socp(
         # shiftless branch (no z + 0 signed-zero drift).
         shift_k = shift if shift is not None else jnp.zeros((m,), dtype)
         kernel_args = (K2, op.Minv, A, P, q, rho_vec, lb, ub, shift_k)
-
-        def run_chunk(carry, k):
-            runner = _fused_solve_runner(
-                nv, n_box, tuple(soc_dims), k, alpha, interp,
-                shift is not None, precision, False,
-            )
-            with phases.scope(phases.FUSED_SOLVE):
-                return runner(*carry, *kernel_args)
+        # (No per-chunk runner here: BOTH kernel forms — fixed-iteration
+        # and tolerance-chunked — run the whole solve in one pallas_call;
+        # the tol path's chunking happens INSIDE the kernel.)
     elif fused_mode == "scan":
 
         def step(carry, _):
@@ -861,47 +1075,64 @@ def solve_socp(
         dual = jnp.max(jnp.abs(P @ x + q + A.T @ y))
         return prim, dual
 
-    if check_every and tol > 0:
-        n_full, rem = divmod(iters, check_every)
+    def result(sol, eff):
+        return (sol, eff) if report_iters else sol
+
+    if tol_path and solve_kernel:
+        # In-kernel early exit: the WHOLE tolerance-chunked solve — w2
+        # build, chunks with per-lane converged freezing, whole-grid-cell
+        # loop exit, exit residuals, per-lane effective iteration count —
+        # in ONE pallas_call, so the operators are still read from HBM
+        # once per solve. (Before this, a check_every/tol solve wrapped
+        # run_chunk in an XLA-side while_loop re-launching the kernel —
+        # re-streaming the operators — once per chunk: exactly the PR-12
+        # VMEM-residency win given back.)
+        runner = _fused_solve_exit_runner(
+            nv, n_box, tuple(soc_dims), iters, alpha, interp,
+            shift is not None, precision, check_every, tol,
+            active is not None,
+        )
+        act_arg = active if active is not None else jnp.ones((), dtype)
+        with phases.scope(phases.FUSED_SOLVE):
+            x, y, z, prim, dual, eff = runner(
+                x0, y0, z0, *kernel_args, act_arg
+            )
+        return result(
+            SOCPSolution(x=x, y=y, z=z, prim_res=prim, dual_res=dual), eff
+        )
+    if tol_path:
 
         def above_tol(carry):
             prim, dual = residuals(carry)
             return (prim > tol) | (dual > tol)
 
-        def cond(s):
-            carry, i = s
-            return (i < n_full) & above_tol(carry)
-
-        def body(s):
-            carry, i = s
-            return run_chunk(carry, check_every), i + 1
-
-        carry, _ = lax.while_loop(cond, body, ((x0, y0, z0), 0))
-        if rem:
-            # Remainder chunk keeps the total at exactly `iters` when the
-            # budget is not a multiple of check_every (skipped if converged).
-            carry = lax.cond(
-                above_tol(carry), lambda c: run_chunk(c, rem), lambda c: c, carry
-            )
+        carry, _, eff = _masked_chunk_loop(
+            (x0, y0, z0), run_chunk, above_tol, active, iters, check_every,
+        )
     elif solve_kernel:
         # Fixed-iteration whole-solve kernel: the exit residual reduction
         # rides INSIDE the pallas_call (with_res=True) — nothing of the
         # solve touches HBM between the operator read and the solution
-        # write. The tolerance-chunked branch above keeps its XLA-side
-        # residual checks (the while_loop cond needs them between chunks).
+        # write.
         runner = _fused_solve_runner(
             nv, n_box, tuple(soc_dims), iters, alpha, interp,
             shift is not None, precision, True,
         )
         with phases.scope(phases.FUSED_SOLVE):
             x, y, z, prim, dual = runner(x0, y0, z0, *kernel_args)
-        return SOCPSolution(x=x, y=y, z=z, prim_res=prim, dual_res=dual)
+        return result(
+            SOCPSolution(x=x, y=y, z=z, prim_res=prim, dual_res=dual),
+            jnp.asarray(iters, jnp.int32),
+        )
     else:
         carry = run_chunk((x0, y0, z0), iters)
+        eff = jnp.asarray(iters, jnp.int32)
 
     x, y, z = carry
     prim, dual = residuals(carry)
-    return SOCPSolution(x=x, y=y, z=z, prim_res=prim, dual_res=dual)
+    return result(
+        SOCPSolution(x=x, y=y, z=z, prim_res=prim, dual_res=dual), eff
+    )
 
 
 def solution_is_finite(sols: "SOCPSolution") -> jnp.ndarray:
