@@ -1032,6 +1032,118 @@ def _effort_ab_cell(controller, n, n_scenarios, effort):
     return value
 
 
+# Largest world the DENSE env-query arm is measured at on a cell budget:
+# the dense sweep materializes (B, T, G=33) grid-evaluation intermediates
+# — ~2.2 GB of f32 at B=64, T=65536 — and its compile+measure wall blows
+# the cell deadline well before that. Dense arms above this are recorded
+# as SKIPPED-with-reason cells (never silently absent): the whole point
+# of the A/B is that dense CANNOT run the city-scale worlds the bucketed
+# tier opens.
+DENSE_ENV_CELL_MAX_TREES = 16384
+
+# Jittered-grid tree density for the env-query cells' city worlds
+# [trees/m^2]: just under the reference MIN_DIST_BETWEEN_TREES packing
+# limit (1/3.2^2 ~ 0.0977), so the generated worlds are legal reference
+# forests, only bigger.
+ENV_CELL_DENSITY = 0.085
+
+
+def _env_world(n_trees, seed=0):
+    """A forest with exactly ``n_trees`` trees: the reference 200-tree
+    mountain world at the paper's size, a jittered-grid city world
+    (square tree counts) above it."""
+    import math
+
+    from tpu_aerial_transport.envs import forest as forest_mod
+
+    if n_trees <= forest_mod.MAX_TREES:
+        return forest_mod.make_forest(seed=seed, max_trees=n_trees), 28.0
+    n_side = math.isqrt(n_trees)
+    if n_side * n_side != n_trees:
+        raise ValueError(f"n_trees={n_trees}: env cells use square "
+                         "jittered-grid worlds")
+    pitch = 1.0 / math.sqrt(ENV_CELL_DENSITY)
+    world_size = (n_side + 0.5) * pitch
+    forest = forest_mod.make_forest(
+        seed=seed, max_trees=n_trees, world_size=world_size,
+        density=ENV_CELL_DENSITY,
+    )
+    return forest, world_size / 2.0 * 0.9
+
+
+def _env_query_cell(impl, n_trees, n_scenarios=64, n_steps=10):
+    """Environment-query A/B cell (envs/spatial.py): the batched capsule
+    query running end-to-end through ``collision_cbf_rows`` (sweep +
+    top-k + CBF row construction) at world size ``n_trees``, dense vs
+    bucketed arms. Fields follow the ring/fused cell conventions:
+    ``env_query``/``env_query_resolved`` label the impl through the ONE
+    shared resolver (spatial.runtime_env_query — the same decision that
+    dispatches), and the bucketed arm records the grid-occupancy
+    telemetry (``grid``: K, cell count, max/mean occupancy — the
+    overflow/occupancy record the build-time refusal pairs with). The
+    flip criterion for the "auto" threshold is written at
+    ``spatial.resolve_env_query``."""
+    from tpu_aerial_transport.envs import forest as forest_mod
+    from tpu_aerial_transport.envs import spatial as spatial_mod
+    from tpu_aerial_transport.harness import setup as setup_mod
+
+    _, col, _ = setup_mod.rqp_setup(4)
+    vision_radius = col.collision_radius + 5.0
+    forest, half_extent = _env_world(n_trees)
+    value = {"n_trees": n_trees, "env_query": impl}
+    if impl == "bucketed":
+        forest = spatial_mod.with_grid(
+            forest, vision_radius + forest.bark_radius
+        )
+        value["grid"] = spatial_mod.grid_stats(forest.grid)
+    value["env_query_resolved"] = spatial_mod.runtime_env_query(
+        impl, forest
+    )
+
+    def one(x, v):
+        return forest_mod.collision_cbf_rows(
+            forest, x, v, col.collision_radius, col.max_deceleration,
+            vision_radius, 0.1, 1.5, 10, env_query=impl,
+        )
+
+    batched = jax.vmap(one)
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(
+        np.concatenate(
+            [rng.uniform(-half_extent, half_extent, (n_scenarios, 2))
+             + np.asarray(forest_mod.MOUNTAIN_CENTER),
+             np.full((n_scenarios, 1), 2.0)], axis=1),
+        jnp.float32,
+    )
+    vs = jnp.asarray(rng.normal(size=(n_scenarios, 3)) * 0.5, jnp.float32)
+
+    def roll(xs, vs, n_steps):
+        def body(x, _):
+            cbf = batched(x, vs)
+            # Drift the batch so every scan step is a fresh query (no
+            # loop-invariant hoisting of the sweep).
+            return x + 0.05, (cbf.min_dist, cbf.collision)
+        _, outs = jax.lax.scan(body, xs, None, length=n_steps)
+        return outs
+
+    step = jax.jit(roll, static_argnames="n_steps")
+    t0 = time.perf_counter()
+    jax.block_until_ready(step(xs, vs, n_steps))
+    compile_wall_s = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(step(xs, vs, n_steps))
+        times.append(time.perf_counter() - t0)
+    rate = n_scenarios * n_steps / float(np.median(times))
+    value.update({
+        "scenario_env_queries_per_sec": rate,
+        "compile_wall_s": compile_wall_s,
+        "n_scenarios": n_scenarios,
+    })
+    return value
+
+
 def _measured_iter_ms(controller, n, k_lo=4, k_hi=24, n_steps=30):
     """MEASURED per-consensus-iteration latency (not p50-divided): run the
     single-stream rollout with the consensus loop forced to a fixed
@@ -1792,6 +1904,42 @@ def sweep(resume: bool = False, platform: str | None = None):
                     ))
                 except Exception as e:
                     record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    # Environment-query A/B cells (envs/spatial.py — the city-scale
+    # world decision cells): dense vs bucketed arms of the batched
+    # capsule query through collision_cbf_rows at T in {200, 4096,
+    # 65536} trees. Meaningful on ANY backend (the gather + sweep math
+    # is pure XLA); dense arms above DENSE_ENV_CELL_MAX_TREES are
+    # recorded as SKIPPED-with-reason cells — the (B, T, G) dense
+    # intermediates blow the cell's memory/deadline budget, which IS the
+    # finding (dense cannot run the worlds the bucketed tier opens).
+    # The "auto"-threshold flip criterion is written at
+    # spatial.resolve_env_query.
+    for env_impl in ("dense", "bucketed"):
+        for n_trees in (200, 4096, 65536):
+            key = f"env_{env_impl}_T{n_trees}"
+            if not want(key) or (key in results
+                                 and "error" not in results[key]):
+                continue
+            if env_impl == "dense" and n_trees > DENSE_ENV_CELL_MAX_TREES:
+                record(key, {
+                    "skipped": True,
+                    "reason": (
+                        f"dense arm at T={n_trees}: the O(T) sweep "
+                        f"materializes (B, T, 33) grid intermediates "
+                        f"(~{64 * n_trees * 33 * 4 / 1e9:.1f} GB f32 per "
+                        "buffer at B=64) and blows the cell "
+                        "memory/deadline budget — the bucketed twin "
+                        "measures this world; recorded, not hidden"),
+                    "env_query": env_impl, "n_trees": n_trees,
+                })
+                continue
+            try:
+                record(key, guarded_cell(
+                    key, _env_query_cell, env_impl, n_trees,
+                ))
+            except Exception as e:
+                record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
 
     # Cold-start ladder A/B (tpu_aerial_transport/aot/): time-to-first-
     # step of a FRESH process per fallback-ladder rung — the zero-compile

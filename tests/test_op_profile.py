@@ -283,3 +283,66 @@ def test_real_trace_attribution_meets_bar(tmp_path):
     frac = attributed / op_total
     assert frac >= 0.8, (frac, rows)
     assert "local_solve" in rows and "consensus" in rows
+
+
+def test_phase_vocabulary_covers_env_query():
+    """The obs.phases vocabulary must carry the environment-query phase:
+    both query impls (the dense forest sweep and the bucketed slab
+    gather, envs/forest.py / envs/spatial.py) run inside this scope, and
+    the bench env_* A/B cells read the query share off it."""
+    from tpu_aerial_transport.obs import phases
+
+    assert phases.ENV_QUERY == "env_query"
+    assert phases.ENV_QUERY in phases.PHASES
+
+
+@pytest.mark.parametrize("env_query", ["dense", "bucketed"])
+def test_real_trace_env_query_attribution(env_query, tmp_path):
+    """End-to-end on a real capture of the batched environment query
+    (both impls): the sweep/gather ops attribute under tat.env_query —
+    NOT (unattributed) — via the compiled-HLO op_name source, so a
+    dropped scope in envs/forest.py or envs/spatial.py fails tier-1 on
+    CPU instead of silently degrading the on-chip attribution bar."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_aerial_transport.envs import forest as forest_mod
+    from tpu_aerial_transport.envs import spatial as spatial_mod
+
+    op_profile = _load_op_profile()
+    forest = forest_mod.make_forest(seed=0)
+    if env_query == "bucketed":
+        forest = spatial_mod.with_grid(forest, 6.3)
+
+    @jax.jit
+    def step(xs, vs):
+        def one(x, v):
+            return forest_mod.collision_cbf_rows(
+                forest, x, v, 1.0, 2.0, 6.0, 0.1, 1.5, 10,
+                env_query=env_query,
+            )
+
+        cbf = jax.vmap(one)(xs, vs)
+        return cbf.min_dist
+
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(
+        np.concatenate([rng.uniform(5, 55, (64, 2)),
+                        np.full((64, 1), 2.0)], axis=1), jnp.float32)
+    vs = jnp.asarray(rng.normal(size=(64, 3)), jnp.float32)
+    step(xs, vs).block_until_ready()
+    trace_dir = str(tmp_path / "trace")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(3):
+            step(xs, vs).block_until_ready()
+    with open(os.path.join(trace_dir, "headline.hlo.txt"), "w") as fh:
+        fh.write(jax.jit(step).lower(xs, vs).compile().as_text())
+
+    agg = op_profile.op_aggregate(op_profile.load_xplanes(trace_dir))
+    assert agg, "no op events captured"
+    hlo_map = op_profile.load_hlo_map(op_profile.find_hlo_dump(trace_dir))
+    rows, op_total, _ = op_profile.rollup_phases(agg, hlo_map)
+    assert op_total > 0
+    assert "env_query" in rows, rows.keys()
+    assert rows["env_query"]["total_us"] > 0

@@ -1,0 +1,402 @@
+"""Spatial-hash bucketed environment queries (envs/spatial.py): grid
+build invariants + the structured overflow refusal, bitwise
+bucketed-vs-dense EnvCBF parity (single, batched, vmapped, nominal and
+vision-cone-masked), the lax.top_k tie-order discipline, the dense-mode
+byte-identical-HLO zero-cost contract, the resolver gates, and the
+city-scale world parameterization of make_forest."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.control import cadmm, centralized, dd
+from tpu_aerial_transport.envs import forest as fo
+from tpu_aerial_transport.envs import spatial as sp
+from tpu_aerial_transport.harness import setup
+
+VISION = 6.0
+QUERY_R = VISION + fo.BARK_RADIUS
+
+
+def _rows(forest, xl, vl, mode, n_rows=10):
+    return fo.collision_cbf_rows(
+        forest, xl, vl, VISION - 5.0, 2.0, VISION, 0.1, 1.5, n_rows,
+        env_query=mode,
+    )
+
+
+def _cbf_equal(a, b):
+    return all(
+        bool(jnp.array_equal(getattr(a, k), getattr(b, k)))
+        for k in ("lhs", "rhs", "collision", "min_dist")
+    )
+
+
+def _city(n_trees=4096, seed=1, max_trees=None):
+    import math
+
+    n_side = math.isqrt(n_trees)
+    pitch = 1.0 / np.sqrt(0.085)
+    return fo.make_forest(
+        seed=seed, max_trees=max_trees or n_trees,
+        world_size=(n_side + 0.5) * pitch, density=0.085,
+    )
+
+
+# ----------------------------- build ----------------------------------
+
+
+def test_auto_threshold_matches_max_trees():
+    # DENSE_AUTO_MAX_TREES is a literal (forest is mid-import when
+    # spatial loads); this pin keeps it equal to the real constant.
+    assert sp.DENSE_AUTO_MAX_TREES == fo.MAX_TREES
+
+
+def test_build_invariants_and_coverage():
+    """Every valid tree within query_radius (XY) of any probe point must
+    sit in the probe cell's slab — the completeness guarantee bitwise
+    parity rests on — and slabs are ascending (the tie-order
+    discipline), K tile-rounded."""
+    forest = fo.make_forest(seed=3)
+    grid = sp.build_grid(forest, QUERY_R)
+    assert grid.k % sp.SLAB_TILE == 0 and grid.k >= sp.MIN_SLAB
+    idxs = np.asarray(grid.cell_idx)
+    valids = np.asarray(grid.cell_valid)
+    for c in range(idxs.shape[0]):
+        s = idxs[c][valids[c]]
+        assert (np.diff(s) > 0).all(), f"slab {c} not ascending"
+
+    pos = np.asarray(forest.tree_pos)
+    num = int(forest.num_trees)
+    rng = np.random.default_rng(0)
+    probes = rng.uniform(-30, 30, size=(64, 2)) + np.asarray(
+        fo.MOUNTAIN_CENTER
+    )
+    for p in probes:
+        mid = jnp.asarray([p[0], p[1], 2.0], jnp.float32)
+        idx, valid = jax.jit(sp.candidate_slab)(forest.replace(grid=grid),
+                                                mid)
+        slab = set(np.asarray(idx)[np.asarray(valid)].tolist())
+        d = np.linalg.norm(pos[:num, :2] - p[None], axis=1)
+        required = set(np.nonzero(d <= QUERY_R)[0].tolist())
+        assert required <= slab, (p, required - slab)
+
+
+def test_overflow_refusal_measures_k_needed():
+    forest = fo.make_forest(seed=0)
+    with pytest.raises(sp.GridOverflowError) as ei:
+        sp.build_grid(forest, QUERY_R, k=2)
+    err = ei.value
+    assert err.k == 2 and err.k_needed > 2
+    assert str(err.k_needed) in str(err)
+    # The measured number IS the fix.
+    grid = sp.build_grid(forest, QUERY_R, k=err.k_needed)
+    assert grid.k == err.k_needed
+    # And auto-sizing admits it with the tile rounding.
+    auto = sp.build_grid(forest, QUERY_R)
+    assert auto.k >= err.k_needed
+
+
+def test_empty_world_grid():
+    forest = fo.forest_from_tree_pos(np.zeros((0, 3)), 0)
+    grid = sp.build_grid(forest, QUERY_R)
+    stats = sp.grid_stats(grid)
+    assert stats["max_occupancy"] == 0 and stats["n_cells"] == 1
+
+
+# ----------------------------- parity ---------------------------------
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_bitwise_parity_single_and_batched(seed):
+    """Bucketed EnvCBF rows == dense bitwise: the candidate set is
+    complete by the build-time coverage guarantee and the per-tree sweep
+    math is elementwise along the tree axis, so gathering candidates
+    cannot change a selected tree's row values."""
+    forest = sp.with_grid(fo.make_forest(seed=seed), QUERY_R)
+    rng = np.random.default_rng(seed)
+    xl = jnp.asarray(
+        np.append(rng.uniform(5, 55, 2), 2.0), jnp.float32
+    )
+    vl = jnp.asarray(rng.normal(size=3), jnp.float32)
+    dense = jax.jit(lambda f, x, v: _rows(f, x, v, "dense"))(forest, xl, vl)
+    buck = jax.jit(lambda f, x, v: _rows(f, x, v, "bucketed"))(
+        forest, xl, vl
+    )
+    assert _cbf_equal(dense, buck)
+
+    xs = jnp.asarray(
+        np.concatenate([rng.uniform(0, 60, (32, 2)),
+                        np.full((32, 1), 2.0)], axis=1), jnp.float32
+    )
+    vs = jnp.asarray(rng.normal(size=(32, 3)), jnp.float32)
+    bd = jax.jit(jax.vmap(lambda x, v: _rows(forest, x, v, "dense")))(xs, vs)
+    bb = jax.jit(jax.vmap(lambda x, v: _rows(forest, x, v, "bucketed")))(
+        xs, vs
+    )
+    assert _cbf_equal(bd, bb)
+
+
+@pytest.mark.parametrize("ctrl", ["cadmm", "dd"])
+def test_bitwise_parity_vision_cone_masked(ctrl):
+    """The controllers' per-agent vision-cone path (sweep once, cone mask
+    per agent over the candidate centers) keeps bitwise parity too — for
+    both consensus controllers."""
+    params, col, state = setup.rqp_setup(4)
+    mod = cadmm if ctrl == "cadmm" else dd
+    kw = dict(max_iter=2, inner_iters=4)
+    cfg_d = mod.make_config(params, col.collision_radius,
+                            col.max_deceleration, env_query="dense", **kw)
+    cfg_b = mod.make_config(params, col.collision_radius,
+                            col.max_deceleration, env_query="bucketed",
+                            **kw)
+    base_d = cfg_d if ctrl == "cadmm" else cfg_d.base
+    base_b = cfg_b if ctrl == "cadmm" else cfg_b.base
+    forest = sp.with_grid(
+        fo.make_forest(seed=0), base_d.vision_radius + fo.BARK_RADIUS
+    )
+    state = state.replace(
+        xl=jnp.array([28.0, 1.0, 2.0], jnp.float32),
+        vl=jnp.array([0.5, 0.2, 0.0], jnp.float32),
+    )
+    ed = jax.jit(
+        lambda s: cadmm.agent_env_cbfs(params, base_d, forest, s)
+    )(state)
+    eb = jax.jit(
+        lambda s: cadmm.agent_env_cbfs(params, base_b, forest, s)
+    )(state)
+    assert _cbf_equal(ed, eb)
+    # Vmapped over perturbed states (the batched-scenario shape).
+    xs = jnp.asarray(
+        np.random.default_rng(1).normal(size=(8, 3)) * 3
+        + np.array([30.0, 0.0, 2.0]), jnp.float32)
+    sd = jax.jit(jax.vmap(lambda x: cadmm.agent_env_cbfs(
+        params, base_d, forest, state.replace(xl=x))))(xs)
+    sb = jax.jit(jax.vmap(lambda x: cadmm.agent_env_cbfs(
+        params, base_b, forest, state.replace(xl=x))))(xs)
+    assert _cbf_equal(sd, sb)
+
+
+def test_topk_tie_order_pinned():
+    """The deliberate tie-order discipline: lax.top_k breaks equal
+    distances toward the SMALLER index, so slabs are stored ascending by
+    tree index and a bucketed selection resolves ties exactly like the
+    dense sweep's tree-index order. Two mirrored trees produce bitwise-
+    equal distances; both impls must pick tree 0's row first."""
+    trees = np.array([[33.0, 3.0, 2.0], [33.0, -3.0, 2.0]])
+    forest = sp.with_grid(fo.forest_from_tree_pos(trees, 2), QUERY_R)
+    xl = jnp.array([33.0, 0.0, 2.0], jnp.float32)
+    vl = jnp.array([1.0, 0.0, 0.0], jnp.float32)
+    data = fo.capsule_forest_distance(forest, xl, xl, 0.5, VISION)
+    assert np.float32(data.dists[0]) == np.float32(data.dists[1])
+    # The dense pin: smaller index first on the tie.
+    from jax import lax
+
+    _, idx = lax.top_k(jnp.where(data.mask, -data.dists, -jnp.inf), 2)
+    assert idx[0] == 0 and idx[1] == 1
+    # The bucketed slab stores ascending indices, so its selection ties
+    # the same way — rows bitwise equal end to end.
+    assert _cbf_equal(_rows(forest, xl, vl, "dense"),
+                      _rows(forest, xl, vl, "bucketed"))
+
+
+# --------------------------- edge cases --------------------------------
+
+
+def test_zero_range_cone_keep_through_bucketed():
+    """vision_cone_mask keeps trees at zero camera range; the bucketed
+    per-candidate cone mask (cone_mask_at over gathered centers) must
+    preserve that — and the full masked query stays bitwise dense."""
+    trees = np.array([[30.0, 0.0, 2.0], [35.0, 1.0, 2.0]])
+    forest = sp.with_grid(fo.forest_from_tree_pos(trees, 2), QUERY_R)
+    camera = jnp.array([30.0, 0.0], jnp.float32)
+    direction = jnp.array([1.0, 0.0], jnp.float32)
+    dense_mask = fo.vision_cone_mask(forest, camera, direction, 0.1)
+    assert bool(dense_mask[0])  # zero-range keep.
+    idx, valid = sp.candidate_slab(
+        forest, jnp.array([30.0, 0.0, 2.0], jnp.float32)
+    )
+    cand_mask = fo.cone_mask_at(
+        jnp.take(forest.tree_pos, idx, axis=0), camera, direction, 0.1
+    )
+    # Per-candidate mask == gathered dense mask (elementwise math).
+    assert jnp.array_equal(cand_mask, jnp.take(dense_mask, idx))
+
+
+def test_exact_axis_contact_normal_through_bucketed():
+    """The exact axis-surface-contact radial-fallback normal (the PR-1
+    near-contact hardening) must survive the bucketed path: same active
+    protective row as dense, bitwise."""
+    tree = np.array([[1.0, 0.0, 2.0]])
+    forest = sp.with_grid(fo.forest_from_tree_pos(tree, 1), 6.0 + 0.3)
+    xl = jnp.array([1.0 - fo.BARK_RADIUS, 0.0, 2.0], jnp.float32)
+    cbf = fo.collision_cbf_rows(
+        forest, xl, jnp.zeros(3), collision_radius=0.9,
+        max_deceleration=2.0, vision_radius=6.0, dist_eps=0.1,
+        alpha_env_cbf=1.5, n_rows=4, env_query="bucketed",
+    )
+    lhs, rhs = np.asarray(cbf.lhs), np.asarray(cbf.rhs)
+    act = np.abs(lhs).max(axis=1) > 0
+    assert act.any(), "exact contact must keep its protecting row"
+    r = int(np.argmax(act))
+    assert lhs[r, 0] < 0 and rhs[r] > 0
+    dense = fo.collision_cbf_rows(
+        forest, xl, jnp.zeros(3), collision_radius=0.9,
+        max_deceleration=2.0, vision_radius=6.0, dist_eps=0.1,
+        alpha_env_cbf=1.5, n_rows=4, env_query="dense",
+    )
+    assert _cbf_equal(dense, cbf)
+
+
+def test_empty_cell_matches_forest_none_semantics():
+    """A query landing in an empty/far cell returns the inactive-row
+    EnvCBF — exactly the ``forest=None`` contract."""
+    forest = sp.with_grid(_city(4096), QUERY_R)
+    far = jnp.array([-4000.0, -4000.0, 2.0], jnp.float32)
+    v = jnp.array([0.5, 0.0, 0.0], jnp.float32)
+    buck = jax.jit(lambda f, u: _rows(f, far, u, "bucketed"))(forest, v)
+    none = fo.collision_cbf_rows(
+        None, far, v, VISION - 5.0, 2.0, VISION, 0.1, 1.5, 10
+    )
+    assert _cbf_equal(buck, none)
+
+
+# ---------------------- zero-cost dense contract -----------------------
+
+
+def test_dense_hlo_byte_identical():
+    """The zero-cost contract (the no_faults()/effort="fixed" pattern):
+    a grid-attached forest under env_query="dense" lowers the cadmm
+    control step to byte-identical HLO vs a plain forest under the
+    pre-knob default config — shipping the bucketed tier cannot perturb
+    a dense deployment — while "bucketed" genuinely changes the program
+    (sanity that the knob is live)."""
+    params, col, state = setup.rqp_setup(4)
+    acc = (jnp.array([0.3, 0.0, 0.1]), jnp.zeros(3))
+    f_eq = centralized.equilibrium_forces(params)
+    kw = dict(max_iter=2, inner_iters=4, pad_operators=True)
+    cfg0 = cadmm.make_config(params, col.collision_radius,
+                             col.max_deceleration, **kw)
+    cfg_d = cadmm.make_config(params, col.collision_radius,
+                              col.max_deceleration, env_query="dense",
+                              **kw)
+    cfg_b = cadmm.make_config(params, col.collision_radius,
+                              col.max_deceleration, env_query="bucketed",
+                              **kw)
+    plain = fo.make_forest(seed=0)
+    gridded = sp.with_grid(plain, cfg0.vision_radius + fo.BARK_RADIUS)
+    cs = cadmm.init_cadmm_state(params, cfg0)
+    plan = cadmm.make_plan(params, cfg0)
+
+    def hlo(cfg, forest):
+        return jax.jit(
+            lambda a, s: cadmm.control(
+                params, cfg, f_eq, a, s, acc, forest, plan=plan
+            )
+        ).lower(cs, state).as_text()
+
+    base = hlo(cfg0, plain)
+    assert base == hlo(cfg_d, gridded)
+    assert base != hlo(cfg_b, gridded)
+
+
+# ----------------------------- resolvers -------------------------------
+
+
+def test_resolve_env_query_gates(monkeypatch):
+    monkeypatch.delenv("TAT_ENV_QUERY", raising=False)
+    assert sp.resolve_env_query("auto") == "auto"
+    assert sp.resolve_env_query(None) == "auto"
+    assert sp.resolve_env_query("dense") == "dense"
+    assert sp.resolve_env_query("bucketed") == "bucketed"
+    with pytest.raises(ValueError, match="env_query"):
+        sp.resolve_env_query("grid")
+    monkeypatch.setenv("TAT_ENV_QUERY", "bucketed")
+    assert sp.resolve_env_query("auto") == "bucketed"
+    assert sp.resolve_env_query("dense") == "dense"  # explicit wins.
+    monkeypatch.setenv("TAT_ENV_QUERY", "quadtree")
+    with pytest.raises(ValueError, match="TAT_ENV_QUERY"):
+        sp.resolve_env_query("auto")
+
+
+def test_runtime_env_query_resolution():
+    small = fo.make_forest(seed=0)
+    assert sp.runtime_env_query("auto", small) == "dense"
+    big = _city(4096)
+    with pytest.raises(ValueError, match="no spatial grid"):
+        sp.runtime_env_query("auto", big)  # big world needs its grid.
+    assert sp.runtime_env_query("auto", sp.with_grid(big, QUERY_R)) \
+        == "bucketed"
+    with pytest.raises(ValueError, match="no spatial grid"):
+        sp.runtime_env_query("bucketed", small)
+    assert sp.runtime_env_query("dense", big) == "dense"
+
+
+def test_coverage_and_rowcount_refusals():
+    forest = sp.with_grid(fo.make_forest(seed=0), 3.0)  # short grid.
+    xl = jnp.array([30.0, 0.0, 2.0], jnp.float32)
+    with pytest.raises(ValueError, match="query_radius"):
+        sp.bucketed_distance(forest, xl, xl, 1.0, VISION)
+    ok = sp.with_grid(fo.make_forest(seed=0), QUERY_R)
+    with pytest.raises(ValueError, match="n_rows"):
+        sp.bucketed_distance(ok, xl, xl, 1.0, VISION,
+                             n_rows=ok.grid.k + 1)
+
+
+def test_make_config_resolution(monkeypatch):
+    params, col, _ = setup.rqp_setup(4)
+    monkeypatch.delenv("TAT_ENV_QUERY", raising=False)
+    cfg = cadmm.make_config(params, col.collision_radius,
+                            col.max_deceleration)
+    assert cfg.env_query == "auto"
+    monkeypatch.setenv("TAT_ENV_QUERY", "bucketed")
+    cfg = cadmm.make_config(params, col.collision_radius,
+                            col.max_deceleration)
+    assert cfg.env_query == "bucketed"
+    dcfg = dd.make_config(params, col.collision_radius,
+                          col.max_deceleration, env_query="dense")
+    assert dcfg.base.env_query == "dense"
+
+
+# -------------------- world parameterization ---------------------------
+
+
+def test_make_forest_world_size():
+    forest = _city(1024, seed=2)
+    assert int(forest.num_trees) == 1024
+    pos = np.asarray(forest.tree_pos[:1024])
+    assert np.isfinite(pos).all()
+    assert (pos[:, 2] > 0).all()  # z = (ground + bark_height)/2 > 0.
+    # Min spacing holds on the jittered grid.
+    from scipy.spatial import cKDTree
+
+    d, _ = cKDTree(pos[:, :2]).query(pos[:, :2], k=2)
+    assert d[:, 1].min() >= fo.MIN_DIST_BETWEEN_TREES - 1e-9
+    # Determinism.
+    assert jnp.array_equal(forest.tree_pos, _city(1024, seed=2).tree_pos)
+
+
+def test_make_forest_refusals():
+    with pytest.raises(ValueError, match="density"):
+        fo.make_forest(seed=0, world_size=100.0, density=0.2)
+    with pytest.raises(ValueError, match="max_trees"):
+        fo.make_forest(seed=0, max_trees=100, world_size=100.0,
+                       density=0.085)
+    with pytest.raises(ValueError, match="world_size"):
+        fo.make_forest(seed=0, density=0.05)
+    with pytest.raises(ValueError, match="max_trees"):
+        fo.forest_from_tree_pos(np.zeros((5, 3)), 5, max_trees=4)
+
+
+def test_grid_survives_rollout_pytree():
+    """The grid rides the Forest pytree: tree-mapping the forest (the
+    rollout/serving plumbing shape) preserves the bucketed query."""
+    forest = sp.with_grid(fo.make_forest(seed=0), QUERY_R)
+    moved = jax.tree.map(lambda x: x + 0 if x.dtype != bool else x, forest)
+    xl = jnp.array([30.0, 0.0, 2.0], jnp.float32)
+    vl = jnp.array([0.5, 0.0, 0.0], jnp.float32)
+    assert _cbf_equal(_rows(forest, xl, vl, "bucketed"),
+                      _rows(moved, xl, vl, "bucketed"))

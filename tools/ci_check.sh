@@ -24,7 +24,7 @@ fail=0
 echo "== jaxlint (Tier A) =="
 python tools/jaxlint.py "${PATHS[@]}" || fail=1
 
-echo "== jaxlint --contracts --target tpu (ring + fused-kernel + effort entrypoints) =="
+echo "== jaxlint --contracts --target tpu (ring + fused-kernel + effort + env-query entrypoints) =="
 # TC106 off-chip TPU lowering gate + Tier-B trace contracts over the
 # ring-exchange entrypoints (PR 7), the whole-solve fused-ADMM kernel
 # entrypoints (PR 12: ops.admm_kernel:fused_solve_{interpret,pallas} —
@@ -33,7 +33,10 @@ echo "== jaxlint --contracts --target tpu (ring + fused-kernel + effort entrypoi
 # chip round), and the adaptive-effort entrypoints (PR 13:
 # ops.admm_kernel:fused_solve_earlyexit_{interpret,pallas} — the
 # in-kernel early-exit scf.while form — plus the adaptive consensus
-# steps control.{cadmm,dd}:control_adaptive). The ring entries need a
+# steps control.{cadmm,dd}:control_adaptive, and the bucketed
+# environment-query tier (envs.spatial:env_query_{bucketed,dense} —
+# the candidate-slab gather + shared sweep math must keep TPU-target
+# lowering clean off-chip, no waiver). The ring entries need a
 # >=4-device mesh, so force a virtual-device CPU host through the ONE
 # shared knob (utils/platform.py TAT_VIRTUAL_DEVICES; default 4 here) —
 # min_devices/waived entries silently skip on 1-device boxes otherwise —
@@ -43,8 +46,8 @@ echo "== jaxlint --contracts --target tpu (ring + fused-kernel + effort entrypoi
 JAX_PLATFORMS=cpu \
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${TAT_VIRTUAL_DEVICES:-4}" \
 python tools/jaxlint.py --contracts --target tpu \
-    --only parallel.ring:consensus_exchange,parallel.ring:consensus_exchange_pallas,parallel.mesh:cadmm_control_sharded_ring,ops.admm_kernel:fused_solve_interpret,ops.admm_kernel:fused_solve_pallas,ops.admm_kernel:fused_solve_earlyexit_interpret,ops.admm_kernel:fused_solve_earlyexit_pallas,control.cadmm:control_adaptive,control.dd:control_adaptive \
-    tpu_aerial_transport/parallel/ring.py tpu_aerial_transport/ops/admm_kernel.py tpu_aerial_transport/control/cadmm.py tpu_aerial_transport/control/dd.py || fail=1
+    --only parallel.ring:consensus_exchange,parallel.ring:consensus_exchange_pallas,parallel.mesh:cadmm_control_sharded_ring,ops.admm_kernel:fused_solve_interpret,ops.admm_kernel:fused_solve_pallas,ops.admm_kernel:fused_solve_earlyexit_interpret,ops.admm_kernel:fused_solve_earlyexit_pallas,control.cadmm:control_adaptive,control.dd:control_adaptive,envs.spatial:env_query_bucketed,envs.spatial:env_query_dense \
+    tpu_aerial_transport/parallel/ring.py tpu_aerial_transport/ops/admm_kernel.py tpu_aerial_transport/control/cadmm.py tpu_aerial_transport/control/dd.py tpu_aerial_transport/envs/spatial.py || fail=1
 
 echo "== pods 2-process parity smoke (tools/pods_local.py) =="
 # Bounded multi-process smoke of the pods tier (parallel/pods.py): 2

@@ -273,10 +273,24 @@ def summarize(events: list[dict]) -> dict:
             iters = "" if im is None else (
                 f"{im:.1f}" + ("" if ip is None else f"/{ip:g}")
             )
-            rungs.append((e["cell"], impl, solve, v["rung"], effort, iters))
+            # Environment-query column (the env_{dense,bucketed}_T* A/B
+            # cells, bench.py _env_query_cell; plain value fields):
+            # impl("resolved" when they differ, the exchange-impl
+            # convention) plus the bucketed arm's slab width — the grid
+            # occupancy telemetry's headline number.
+            envq = v.get("env_query", "")
+            eqr = v.get("env_query_resolved", envq)
+            if eqr and eqr != envq:
+                envq = f"{envq}({eqr})"
+            g = v.get("grid")
+            if envq and isinstance(g, dict) and "k" in g:
+                envq += f" K={g['k']}"
+            rungs.append((e["cell"], impl, solve, v["rung"], effort,
+                          iters, envq))
     for e in chunks:
         if "rung" in e:
-            rungs.append((f"chunk {e['chunk']}", "", "", e["rung"], "", ""))
+            rungs.append((f"chunk {e['chunk']}", "", "", e["rung"], "",
+                          "", ""))
     if bevents or rungs:
         kinds: dict[str, int] = {}
         for e in bevents:
@@ -512,13 +526,15 @@ def render(summary: dict) -> None:
                       f"{(e.get('detail') or '')[:120]}")
         if be["rungs"]:
             print("\n| unit | exchange impl | solve impl | effort | "
-                  "iters mean/p99 | rung |")
-            print("|---|---|---|---|---|---|")
+                  "iters mean/p99 | env query | rung |")
+            print("|---|---|---|---|---|---|---|")
             for unit, impl, solve, rung, *rest in be["rungs"]:
                 effort = rest[0] if rest else ""
                 iters = rest[1] if len(rest) > 1 else ""
+                envq = rest[2] if len(rest) > 2 else ""
                 print(f"| {unit} | {impl or '—'} | {solve or '—'} | "
-                      f"{effort or '—'} | {iters or '—'} | {rung} |")
+                      f"{effort or '—'} | {iters or '—'} | "
+                      f"{envq or '—'} | {rung} |")
 
 
 def _latency_stats(xs: list[float]) -> dict | None:

@@ -208,6 +208,51 @@ def _build_cadmm_forest():
     return _cadmm_bits(forest=forest_mod.make_forest(0))
 
 
+def _env_query_bits(env_query: str):
+    """Env-query entrypoints (envs/spatial.py): the full query surface —
+    dispatch, (for the bucketed tier) the grid-cell candidate-slab
+    gather, the shared per-tree capsule sweep, and collision CBF row
+    construction — on the reference 200-slot world. The bucketed twin's
+    grid is rebuilt per make_args call: TC101 then also proves a fresh
+    grid artifact of the same world re-uses the compiled query."""
+    import jax.numpy as jnp
+
+    from tpu_aerial_transport.envs import forest as forest_mod
+    from tpu_aerial_transport.envs import spatial
+
+    vision_radius = 6.0
+
+    def fn(forest, xl, vl):
+        return forest_mod.collision_cbf_rows(
+            forest, xl, vl, vision_radius - 5.0, 2.0, vision_radius,
+            0.1, 1.5, 10, env_query=env_query,
+        )
+
+    def make_args():
+        forest = forest_mod.make_forest(0)
+        if env_query == "bucketed":
+            forest = spatial.with_grid(
+                forest, vision_radius + forest.bark_radius
+            )
+        return (
+            forest,
+            jnp.array([28.0, 1.0, 2.0], jnp.float32),
+            jnp.array([0.5, 0.2, 0.0], jnp.float32),
+        )
+
+    return fn, make_args
+
+
+@_register("envs.spatial:env_query_bucketed")
+def _build_env_query_bucketed():
+    return _env_query_bits("bucketed")
+
+
+@_register("envs.spatial:env_query_dense")
+def _build_env_query_dense():
+    return _env_query_bits("dense")
+
+
 @_register("control.dd:control")
 def _build_dd():
     from tpu_aerial_transport.control import centralized, dd

@@ -61,9 +61,14 @@ TRACED_FUNCTIONS: dict[str, tuple[str, ...]] = {
     ),
     "tpu_aerial_transport/envs/forest.py": (
         "ground_height", "braking_capsule", "capsule_forest_distance",
-        "cbf_rows_from_distance", "vision_cone_mask",
+        "capsule_distance_data", "cbf_rows_from_distance",
+        "vision_cone_mask", "cone_mask_at",
         "point_cylinder_distance", "segment_cylinder_distance",
         "collision_cbf_rows",
+    ),
+    "tpu_aerial_transport/envs/spatial.py": (
+        "candidate_slab", "bucketed_distance", "env_query_bucketed",
+        "env_query_dense",
     ),
     "tpu_aerial_transport/harness/rollout.py": ("rollout",),
     "tpu_aerial_transport/harness/diff.py": (
@@ -172,6 +177,19 @@ CONTRACT_ENTRYPOINTS: dict[str, str] = {
     "serving.batcher:serving_chunk_centralized":
         "serving chunk for the canonical centralized family (the mixed-"
         "stream twin of serving_chunk)",
+    "envs.spatial:env_query_bucketed":
+        "spatial-hash bucketed environment query: grid-cell candidate-"
+        "slab gather + the exact dense per-tree capsule sweep over "
+        "candidates only, through collision CBF row construction — the "
+        "city-scale (10^4-10^6 obstacle) world tier "
+        "(envs/spatial.py; TC104 enforced on the 8-aligned slab edges, "
+        "TC106 off-chip TPU lowering enforced — gather + the existing "
+        "sweep math, no waiver)",
+    "envs.spatial:env_query_dense":
+        "the dense O(max_trees) environment query under the same "
+        "entrypoint surface (envs.spatial.env_query_dense -> "
+        "forest.capsule_forest_distance) — the bucketed tier's "
+        "byte-identical-HLO baseline twin",
     "parallel.pods:pods_control_step":
         "2-D (scenario, agent) pods-mesh C-ADMM control step: scenarios "
         "vmapped per shard, consensus over the agent axis, batch stats "

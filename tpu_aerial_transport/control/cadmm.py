@@ -43,6 +43,7 @@ from jax import lax
 
 from tpu_aerial_transport.control.types import EnvCBF, SolverStats, inactive_env_cbf
 from tpu_aerial_transport.envs import forest as forest_mod
+from tpu_aerial_transport.envs import spatial as spatial_mod
 from tpu_aerial_transport.harness.bucketing import bucket_dim as _bucket_dim
 from tpu_aerial_transport.models.rqp import GRAVITY, RQPParams, RQPState
 from tpu_aerial_transport.obs import phases
@@ -202,6 +203,22 @@ class RQPCADMMConfig:
     # RESOLVED name. Single-program (axis_name=None) steps never exchange,
     # so the field is inert there.
     consensus_impl: str = struct.field(pytree_node=False, default="allreduce")
+    # Environment-query implementation (envs/spatial.py
+    # resolve_env_query; "auto" | "dense" | "bucketed"). "dense" (the
+    # resolved small-world default) is the historical O(max_trees) sweep
+    # — byte-identical HLO to a pre-knob config (asserted in
+    # tests/test_spatial.py). "bucketed" gathers the forest's
+    # spatial-hash candidate slab (forest.grid, spatial.with_grid) and
+    # runs the same per-tree math over candidates only — bitwise-equal
+    # EnvCBF rows, O(K) instead of O(max_trees), which is what admits
+    # 10^4-10^6-obstacle city-scale worlds. "auto" (stored as-is; env
+    # force resolved at make_config time) finishes resolving at TRACE
+    # time from the forest's static slot count (spatial.
+    # runtime_env_query: dense at <= DENSE_AUTO_MAX_TREES, bucketed
+    # above) — the world's size is a shape, unknown at config build.
+    # The mesh, pods, and serving tiers inherit the mode with zero
+    # plumbing — it rides this config into every query.
+    env_query: str = struct.field(pytree_node=False, default="dense")
 
 
 def make_config(
@@ -228,6 +245,7 @@ def make_config(
     track_agent_stats: bool = False,
     consensus_impl: str = "auto",
     effort: str = "auto",
+    env_query: str = "auto",
 ) -> RQPCADMMConfig:
     """Defaults are reference-conservative (max_iter mirrors the reference's
     100-iteration cap). For warm-started receding-horizon use, the measured
@@ -302,6 +320,12 @@ def make_config(
         # force, else "fixed" until the chip round's effort A/B cells
         # pass the flip criterion written in its docstring).
         effort=socp.resolve_effort(effort),
+        # The TAT_ENV_QUERY env force is consumed here (config build
+        # time, outside jit, like every knob above), but "auto" may
+        # survive: the dense/bucketed split depends on the WORLD's
+        # static slot count, first known at trace time
+        # (spatial.runtime_env_query finishes it in agent_env_cbfs_for).
+        env_query=spatial_mod.resolve_env_query(env_query),
     )
 
 
@@ -941,17 +965,30 @@ def agent_env_cbfs_for(
     cap_a, cap_b, cap_h, speed, cap_dir = forest_mod.braking_capsule(
         state.xl, state.vl, collision_radius, cfg.max_deceleration
     )
-    data = forest_mod.capsule_forest_distance(
-        forest, cap_a, cap_b, collision_radius, cfg.vision_radius
-    )
+    # Env-query dispatch (cfg.env_query; envs/spatial.py): the bucketed
+    # tier gathers the capsule midpoint's candidate slab ONCE and the
+    # per-agent cone masks below run over the (K,) candidates instead of
+    # all (max_trees,) slots — same sweep-once/mask-per-agent structure,
+    # bitwise-equal rows (the slab coverage is a build-time guarantee).
+    mode = spatial_mod.runtime_env_query(cfg.env_query, forest)
+    if mode == "bucketed":
+        data, centers, _ = spatial_mod.bucketed_distance(
+            forest, cap_a, cap_b, collision_radius, cfg.vision_radius,
+            n_rows=cfg.n_env_cbfs,
+        )
+    else:
+        data = forest_mod.capsule_forest_distance(
+            forest, cap_a, cap_b, collision_radius, cfg.vision_radius
+        )
+        centers = forest.tree_pos
 
     def one_agent(r_i):
         camera = (state.xl + state.Rl @ r_i)[:2]
         d = camera - state.xl[:2]
         norm = jnp.linalg.norm(d)
         direction = d / jnp.where(norm > 0, norm, 1.0)
-        mask = forest_mod.vision_cone_mask(
-            forest, camera, direction, cfg.vision_cone_ang
+        mask = forest_mod.cone_mask_at(
+            centers, camera, direction, cfg.vision_cone_ang
         )
         # Degenerate bearing (attachment above payload center): reference flags
         # collision and disables rows (:337-339).

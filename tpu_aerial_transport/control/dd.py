@@ -84,6 +84,7 @@ def make_config(
     track_agent_stats: bool = False,
     consensus_impl: str = "auto",
     effort: str = "auto",
+    env_query: str = "auto",
 ) -> RQPDDConfig:
     """Defaults are reference-conservative. For warm-started receding-horizon
     use the measured inner-iteration knee is ~40: the quasi-Newton dual ascent
@@ -111,6 +112,7 @@ def make_config(
         track_agent_stats=track_agent_stats,
         consensus_impl=consensus_impl,
         effort=effort,
+        env_query=env_query,
     )
     return RQPDDConfig(base=base, prim_inf_tol=prim_inf_tol)
 

@@ -30,6 +30,7 @@ from flax import struct
 from jax import lax
 
 from tpu_aerial_transport.control.types import EnvCBF
+from tpu_aerial_transport.obs import phases
 
 # Reference constants (env_forest.py:22-31).
 MOUNTAIN_CENTER = np.array([30.0, 0.0])
@@ -76,39 +77,109 @@ class Forest:
 
     bark_radius: float = struct.field(pytree_node=False, default=BARK_RADIUS)
     bark_height: float = struct.field(pytree_node=False, default=BARK_HEIGHT)
+    # Optional spatial-hash bucketing artifact (envs/spatial.py
+    # SpatialGrid, attached by ``spatial.with_grid``): per-cell candidate
+    # index slabs over the tree XY plane, consumed by the ``"bucketed"``
+    # environment-query tier. None (the default) leaves every existing
+    # construction/query path — and the dense query's compiled HLO —
+    # untouched; the grid rides the Forest pytree through rollouts, mesh,
+    # pods and serving with zero extra plumbing.
+    grid: "object | None" = None
+
+
+def _mountain_geometry():
+    ang = np.pi / 2.0 - np.arctan2(MOUNTAIN_RADIUS, MOUNTAIN_HEIGHT)
+    sphere_radius = MOUNTAIN_RADIUS / np.sin(ang)
+    return sphere_radius, sphere_radius * np.cos(ang)
+
+
+def _ground_np(sphere_radius, center_depth, d2):
+    """Terrain height at squared mountain distance ``d2`` (numpy twin of
+    :func:`ground_height`): 0 off the spherical cap — the radicand clip
+    matters for city-scale worlds whose trees extend far beyond the
+    mountain (the unclipped form is NaN there)."""
+    return np.maximum(
+        np.sqrt(np.maximum(sphere_radius**2 - d2, 0.0)) - center_depth, 0.0
+    )
 
 
 def make_forest(seed: int = 0, max_trees: int = MAX_TREES,
-                dtype=jnp.float32) -> Forest:
-    """Seeded rejection-sampling forest generation (reference :47-85): up to
-    ``max_trees`` trees with min spacing 3.2 m inside the 25 m mountain disc, the
-    first tree pinned at center + (0.5, 0.5); tree base follows the spherical-cap
-    terrain, center z = (ground_height + bark_height) / 2."""
-    rng = np.random.default_rng(seed)
-    tree_xy = [MOUNTAIN_CENTER + np.array([0.5, 0.5])]
-    for _ in range(max_trees * 50):
-        if len(tree_xy) >= max_trees:
-            break
-        pos = rng.random(2) - 0.5
-        norm = np.linalg.norm(pos)
-        if norm == 0:
-            continue
-        pos = pos / norm * rng.random() * MOUNTAIN_RADIUS + MOUNTAIN_CENTER
-        if np.min(np.linalg.norm(np.array(tree_xy) - pos, axis=1)) \
-                < MIN_DIST_BETWEEN_TREES:
-            continue
-        tree_xy.append(pos)
-    num = len(tree_xy)
-    tree_xy = np.array(tree_xy)
+                dtype=jnp.float32, *, world_size: float | None = None,
+                density: float | None = None) -> Forest:
+    """Seeded forest generation.
 
-    ang = np.pi / 2.0 - np.arctan2(MOUNTAIN_RADIUS, MOUNTAIN_HEIGHT)
-    sphere_radius = MOUNTAIN_RADIUS / np.sin(ang)
-    center_depth = sphere_radius * np.cos(ang)
+    Default (``world_size=None``): the reference's rejection sampling
+    (:47-85) — up to ``max_trees`` trees with min spacing 3.2 m inside the
+    25 m mountain disc, the first tree pinned at center + (0.5, 0.5); tree
+    base follows the spherical-cap terrain, center
+    z = (ground_height + bark_height) / 2.
+
+    City-scale (``world_size`` given, in metres): trees on a seeded
+    jittered grid over the ``world_size`` x ``world_size`` square centered
+    on the mountain, ``density`` trees/m^2 (default: the tightest packing
+    the reference spacing admits, ``1 / MIN_DIST_BETWEEN_TREES^2``). The
+    jitter amplitude keeps every pair at least ``MIN_DIST_BETWEEN_TREES``
+    apart; a density whose grid pitch falls below that spacing is refused.
+    The tree count implied by ``(world_size, density)`` must fit
+    ``max_trees`` — a world that would overflow the fixed-shape slot array
+    is a clear ``ValueError`` naming the required ``max_trees``, never a
+    silent mask truncation. Worlds above the dense-query class
+    (``spatial.DENSE_AUTO_MAX_TREES``) should attach a spatial-hash grid
+    (``envs.spatial.with_grid``) for the bucketed query tier."""
+    rng = np.random.default_rng(seed)
+    if density is not None and world_size is None:
+        raise ValueError("density= requires world_size=")
+    if world_size is not None:
+        if density is None:
+            density = 1.0 / MIN_DIST_BETWEEN_TREES**2
+        pitch = 1.0 / np.sqrt(density)
+        if pitch < MIN_DIST_BETWEEN_TREES:
+            raise ValueError(
+                f"density={density} gives a grid pitch of {pitch:.2f} m, "
+                f"below the {MIN_DIST_BETWEEN_TREES} m minimum tree "
+                "spacing — reduce density to at most "
+                f"{1.0 / MIN_DIST_BETWEEN_TREES**2:.4f} trees/m^2"
+            )
+        n_side = max(int(np.floor(world_size / pitch)), 1)
+        num = n_side * n_side
+        if num > max_trees:
+            raise ValueError(
+                f"world_size={world_size} at density={density} needs "
+                f"{num} tree slots but max_trees={max_trees} — pass "
+                f"max_trees>={num} (refusing to silently truncate the "
+                "world to the first max_trees grid rows)"
+            )
+        # Jittered grid: cell centers at pitch spacing, uniform jitter
+        # bounded so neighboring trees keep the reference min spacing.
+        jitter = max((pitch - MIN_DIST_BETWEEN_TREES) / 2.0, 0.0)
+        base = (np.arange(n_side) + 0.5) * pitch - world_size / 2.0
+        gx, gy = np.meshgrid(base, base, indexing="ij")
+        tree_xy = np.stack([gx.ravel(), gy.ravel()], axis=1)
+        tree_xy += rng.uniform(-jitter, jitter, size=tree_xy.shape)
+        tree_xy += MOUNTAIN_CENTER
+    else:
+        tree_xy = [MOUNTAIN_CENTER + np.array([0.5, 0.5])]
+        for _ in range(max_trees * 50):
+            if len(tree_xy) >= max_trees:
+                break
+            pos = rng.random(2) - 0.5
+            norm = np.linalg.norm(pos)
+            if norm == 0:
+                continue
+            pos = pos / norm * rng.random() * MOUNTAIN_RADIUS + MOUNTAIN_CENTER
+            if np.min(np.linalg.norm(np.array(tree_xy) - pos, axis=1)) \
+                    < MIN_DIST_BETWEEN_TREES:
+                continue
+            tree_xy.append(pos)
+        tree_xy = np.array(tree_xy)
+    num = len(tree_xy)
+
+    sphere_radius, center_depth = _mountain_geometry()
 
     pos3 = np.full((max_trees, 3), _FAR)
     pos3[:num, :2] = tree_xy
     d2 = np.sum((tree_xy - MOUNTAIN_CENTER) ** 2, axis=1)
-    ground = np.sqrt(sphere_radius**2 - d2) - center_depth
+    ground = _ground_np(sphere_radius, center_depth, d2)
     pos3[:num, 2] = (ground + BARK_HEIGHT) / 2.0
     valid = np.arange(max_trees) < num
     return Forest(
@@ -123,18 +194,26 @@ def make_forest(seed: int = 0, max_trees: int = MAX_TREES,
 def forest_from_tree_pos(tree_pos, num_trees, max_trees: int = MAX_TREES,
                          dtype=jnp.float32) -> Forest:
     """Rebuild a Forest from logged tree positions (replay path; reference
-    rqp_plots.py:503-505 reconstructs the env from the log the same way)."""
+    rqp_plots.py:503-505 reconstructs the env from the log the same way).
+    Refuses more positions than ``max_trees`` slots — truncating a logged
+    world would silently delete obstacles from the replayed queries."""
     tree_pos = np.asarray(tree_pos)
+    if tree_pos.shape[0] > max_trees:
+        raise ValueError(
+            f"{tree_pos.shape[0]} logged tree positions do not fit "
+            f"max_trees={max_trees} slots — pass "
+            f"max_trees>={tree_pos.shape[0]} (refusing to silently drop "
+            "obstacles from the replayed world)"
+        )
     pos3 = np.full((max_trees, 3), _FAR)
     pos3[: tree_pos.shape[0]] = tree_pos
-    ang = np.pi / 2.0 - np.arctan2(MOUNTAIN_RADIUS, MOUNTAIN_HEIGHT)
-    sphere_radius = MOUNTAIN_RADIUS / np.sin(ang)
+    sphere_radius, center_depth = _mountain_geometry()
     return Forest(
         tree_pos=jnp.asarray(pos3, dtype),
         tree_valid=jnp.asarray(np.arange(max_trees) < tree_pos.shape[0]),
         num_trees=jnp.asarray(num_trees, jnp.int32),
         mountain_sphere_radius=jnp.asarray(sphere_radius, dtype),
-        mountain_center_depth=jnp.asarray(sphere_radius * np.cos(ang), dtype),
+        mountain_center_depth=jnp.asarray(center_depth, dtype),
     )
 
 
@@ -252,21 +331,28 @@ class DistanceData:
     min_dist: jnp.ndarray  # () min over mask (vision_radius if none).
 
 
-def capsule_forest_distance(
-    forest: Forest,
+def capsule_distance_data(
+    centers: jnp.ndarray,
+    valid: jnp.ndarray,
+    bark_radius,
+    bark_height,
     cap_a: jnp.ndarray,
     cap_b: jnp.ndarray,
     cap_radius,
     vision_radius,
     vision_mask=None,
 ) -> DistanceData:
-    """Distance from the capsule with axis ``[cap_a, cap_b]`` and radius
-    ``cap_radius`` to every tree (reference ``centralized_distance``; pass
-    ``vision_mask`` for the per-agent cone of ``distributed_distance``)."""
-    centers = forest.tree_pos  # (T, 3)
+    """Distance sweep from the capsule with axis ``[cap_a, cap_b]`` and
+    radius ``cap_radius`` to the trees at ``centers (N, 3)`` with validity
+    ``valid (N,)`` — the per-tree math of :func:`capsule_forest_distance`,
+    factored over an arbitrary tree set so the bucketed query tier
+    (envs/spatial.py) can run the EXACT same ops over a gathered candidate
+    slab: every op below is elementwise along the tree axis, so a tree's
+    dist/witness/normal values are bitwise identical whether it sits in
+    the full ``(max_trees,)`` sweep or a ``(K,)`` candidate slab."""
     dist_axis, p_seg, p_cyl = segment_cylinder_distance(
         cap_a[None, :], cap_b[None, :], centers,
-        forest.bark_radius, forest.bark_height / 2.0,
+        bark_radius, bark_height / 2.0,
     )
     dists = dist_axis - cap_radius
     # Witness point on the capsule surface: offset from the axis toward the tree.
@@ -292,7 +378,7 @@ def capsule_forest_distance(
     radial = p_seg[:, :2] - centers[:, :2]
     rn = jnp.linalg.norm(radial, axis=-1, keepdims=True)
     dz_seg = p_seg[:, 2] - centers[:, 2]
-    on_wall = (jnp.abs(dz_seg)[:, None] < forest.bark_height / 2.0) & (
+    on_wall = (jnp.abs(dz_seg)[:, None] < bark_height / 2.0) & (
         rn > 1e-12
     )
     radial_dir = jnp.concatenate(
@@ -316,9 +402,9 @@ def capsule_forest_distance(
     cap_mid = 0.5 * (cap_a + cap_b)
     in_range = (
         jnp.linalg.norm(centers - cap_mid[None, :], axis=-1)
-        <= vision_radius + forest.bark_radius
+        <= vision_radius + bark_radius
     )
-    mask = forest.tree_valid & in_range
+    mask = valid & in_range
     if vision_mask is not None:
         mask = mask & vision_mask
     dists = jnp.where(mask, dists, jnp.inf)
@@ -330,15 +416,46 @@ def capsule_forest_distance(
     )
 
 
-def vision_cone_mask(forest: Forest, camera_pos, direction, half_angle):
-    """Per-agent 2-D vision-cone mask (reference ``distributed_distance``,
-    env_forest.py:169-212): keep trees whose bearing from ``camera_pos`` (2-D) is
-    within ``half_angle`` of ``direction``; trees at zero range are always kept."""
-    d = forest.tree_pos[:, :2] - camera_pos[None, :2]
+def capsule_forest_distance(
+    forest: Forest,
+    cap_a: jnp.ndarray,
+    cap_b: jnp.ndarray,
+    cap_radius,
+    vision_radius,
+    vision_mask=None,
+) -> DistanceData:
+    """Distance from the capsule with axis ``[cap_a, cap_b]`` and radius
+    ``cap_radius`` to every tree (reference ``centralized_distance``; pass
+    ``vision_mask`` for the per-agent cone of ``distributed_distance``).
+    The dense O(max_trees) sweep; the bucketed tier
+    (``envs.spatial.env_query_bucketed``) runs the same
+    :func:`capsule_distance_data` core over a grid-gathered candidate
+    slab instead."""
+    with phases.scope(phases.ENV_QUERY):
+        return capsule_distance_data(
+            forest.tree_pos, forest.tree_valid, forest.bark_radius,
+            forest.bark_height, cap_a, cap_b, cap_radius, vision_radius,
+            vision_mask,
+        )
+
+
+def cone_mask_at(centers, camera_pos, direction, half_angle):
+    """:func:`vision_cone_mask` over an arbitrary tree set ``centers
+    (N, 3)`` — elementwise per tree, so a candidate slab's cone mask is
+    bitwise the gathered full-world mask (the bucketed tier's per-agent
+    vision-cone reuse)."""
+    d = centers[:, :2] - camera_pos[None, :2]
     norm = jnp.linalg.norm(d, axis=-1)
     safe = jnp.where(norm > 0, norm, 1.0)
     cosang = jnp.sum(d / safe[:, None] * direction[None, :2], axis=-1)
     return (norm == 0.0) | (cosang >= jnp.cos(half_angle))
+
+
+def vision_cone_mask(forest: Forest, camera_pos, direction, half_angle):
+    """Per-agent 2-D vision-cone mask (reference ``distributed_distance``,
+    env_forest.py:169-212): keep trees whose bearing from ``camera_pos`` (2-D) is
+    within ``half_angle`` of ``direction``; trees at zero range are always kept."""
+    return cone_mask_at(forest.tree_pos, camera_pos, direction, half_angle)
 
 
 def braking_capsule(xl, vl, collision_radius, max_deceleration):
@@ -364,11 +481,22 @@ def collision_cbf_rows(
     alpha_env_cbf,
     n_rows: int,
     vision_mask=None,
+    env_query: str = "dense",
 ) -> EnvCBF:
     """Backup-CBF rows for the nearest ``n_rows`` obstacles (reference
     :280-337): for each selected tree, row ``(normal * min_time) @ dvl >=
     -alpha (d - eps) - normal . vl`` where ``min_time`` is the remaining braking
-    time before closest approach. Fixed shapes via masked ``lax.top_k``."""
+    time before closest approach. Fixed shapes via masked ``lax.top_k``.
+
+    ``env_query`` selects the distance-sweep implementation
+    (``envs.spatial.resolve_env_query`` vocabulary: "auto" | "dense" |
+    "bucketed"): "dense" (the default — byte-identical program to the
+    historical call) sweeps all ``max_trees`` slots; "bucketed" gathers
+    the forest's spatial-hash candidate slab (``forest.grid``, attached
+    by ``spatial.with_grid``) and runs the same per-tree math over
+    candidates only — EnvCBF rows bitwise equal to dense wherever the
+    grid's coverage radius admits the query (guaranteed at build);
+    "auto" picks by static world size at trace time."""
     dtype = xl.dtype
     inactive_rhs = -alpha_env_cbf * (vision_radius - dist_eps)
     if forest is None:
@@ -382,9 +510,19 @@ def collision_cbf_rows(
     cap_a, cap_b, cap_h, speed, cap_dir = braking_capsule(
         xl, vl, collision_radius, max_deceleration
     )
-    data = capsule_forest_distance(
-        forest, cap_a, cap_b, collision_radius, vision_radius, vision_mask
-    )
+    from tpu_aerial_transport.envs import spatial  # cycle: spatial uses us.
+
+    mode = spatial.runtime_env_query(env_query, forest)
+    if mode == "bucketed":
+        data, _, _ = spatial.bucketed_distance(
+            forest, cap_a, cap_b, collision_radius, vision_radius,
+            vision_mask=vision_mask, n_rows=n_rows,
+        )
+    else:
+        data = capsule_forest_distance(
+            forest, cap_a, cap_b, collision_radius, vision_radius,
+            vision_mask,
+        )
     return cbf_rows_from_distance(
         data, xl, vl, cap_h, speed, cap_dir, max_deceleration,
         vision_radius, dist_eps, alpha_env_cbf, n_rows,

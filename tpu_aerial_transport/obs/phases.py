@@ -21,6 +21,14 @@ PREFIX = "tat."
 # The algorithm phases (the op_profile rollup's row vocabulary):
 QP_BUILD = "qp_build"          # per-agent QP matrix assembly + KKT ops.
 CBF_ROWS = "cbf_rows"          # env CBF row construction (forest sweep).
+ENV_QUERY = "env_query"        # the environment distance sweep itself
+#                                (envs/forest.py capsule_forest_distance /
+#                                envs/spatial.py bucketed slab gather +
+#                                candidate sweep; nested inside
+#                                tat.cbf_rows at the controller callsites —
+#                                innermost wins, so the query's share
+#                                separates from the row construction
+#                                around it).
 LOCAL_SOLVE = "local_solve"    # per-agent conic QP solves (inner ADMM).
 FUSED_SOLVE = "fused_solve"    # whole-solve ADMM mega-kernel dispatch
 #                                (ops/admm_kernel.fused_solve_lanes via
@@ -46,7 +54,7 @@ PODS_STEP = "pods_step"        # 2-D (scenario, agent) pods-mesh shard_map
 #                                controllers' fine scopes inside win.
 
 PHASES = (
-    QP_BUILD, CBF_ROWS, LOCAL_SOLVE, FUSED_SOLVE, CONSENSUS,
+    QP_BUILD, CBF_ROWS, ENV_QUERY, LOCAL_SOLVE, FUSED_SOLVE, CONSENSUS,
     CONSENSUS_EXCHANGE, DUAL_UPDATE, DYNAMICS, PAD, FAULTS, FALLBACK,
     TELEMETRY, SHARDED_STEP, SERVING_CHUNK, PODS_STEP,
 )
