@@ -1630,7 +1630,8 @@ def _write_json_atomic(path: str, payload) -> None:
     os.replace(tmp, path)
 
 
-def sweep(resume: bool = False, platform: str | None = None):
+def sweep(resume: bool = False, platform: str | None = None,
+          trace: str | None = None):
     """Full BASELINE.json matrix. Each measured config ("chunk" of the
     sweep) is journaled to ``BENCH_SWEEP_JOURNAL.jsonl`` (the same
     append-only fsync'd jsonl ``resilience.recovery`` uses for rollout
@@ -1760,8 +1761,20 @@ def sweep(resume: bool = False, platform: str | None = None):
     # ensure_backend already ran — resolving it via jax.default_backend()
     # here would be the first IN-PROCESS backend init, unwatchdogged on
     # this thread (the guard only pays that inside run()'s deadline).
+    # --trace: wire a span tracer through the guard so every guarded
+    # cell records a guard_dispatch span (label + rung + classified
+    # failure kind) — "where did the sweep's wall time go" as one
+    # Perfetto timeline. The sink is the sweep metrics writer (the
+    # durable-jsonl rule every other traced surface follows), so a
+    # sweep that dies mid-run keeps its recorded spans; the Chrome file
+    # at the end is a rendering of them, not the only copy.
+    tracer = None
+    if trace:
+        from tpu_aerial_transport.obs import trace as trace_lib
+
+        tracer = trace_lib.Tracer(metrics, track="sweep")
     guard = backend_mod.BackendGuard(
-        metrics=metrics, journal=journal,
+        metrics=metrics, journal=journal, tracer=tracer,
         primary_rung=(None if platform is None else
                       backend_mod.RUNG_CPU if platform == "cpu"
                       else backend_mod.RUNG_ONCHIP),
@@ -2200,6 +2213,12 @@ def sweep(resume: bool = False, platform: str | None = None):
 
     _write_json_atomic("BENCH_SWEEP.json", results)
     metrics.emit("done", chunks=len(results) - 1)
+    if tracer is not None and tracer.rows:
+        from tpu_aerial_transport.obs import trace as trace_lib
+
+        trace_lib.write_chrome_trace(trace, tracer.rows)
+        print(f"# sweep trace: {trace} ({len(tracer.rows)} spans)",
+              flush=True)
     if os.path.exists(SWEEP_PARTIAL_PATH):
         os.remove(SWEEP_PARTIAL_PATH)
     if journal.exists():
@@ -2707,6 +2726,10 @@ def main():
                          "(the n=64 consensus-cliff metric; runs on CPU "
                          "too — writes BENCH_SCALING.json)")
     ap.add_argument("--profile", default=None, metavar="DIR")
+    ap.add_argument("--trace", default="",
+                    help="--sweep: write a Chrome/Perfetto trace of the "
+                         "sweep's guarded cells (guard_dispatch spans "
+                         "with label/rung/failure kind) to this path")
     ap.add_argument("--fused", default="auto",
                     choices=["auto", "scan", "pallas", "interpret"],
                     help="inner ADMM chunk mode for the headline "
@@ -2752,7 +2775,8 @@ def main():
     if args.smoke:
         smoke()
     elif args.sweep:
-        sweep(resume=args.resume, platform=platform)
+        sweep(resume=args.resume, platform=platform,
+              trace=args.trace or None)
     elif args.multichip:
         multichip()
     elif args.components:

@@ -131,6 +131,11 @@ def main(argv=None) -> int:
     ap.add_argument("--run-dir", default="")
     ap.add_argument("--resume", action="store_true")
     ap.add_argument("--metrics", default="")
+    ap.add_argument("--trace", default="",
+                    help="write a Chrome/Perfetto trace of the stream "
+                         "(request/queue/batch/device/guard spans + "
+                         "critical-path accounting) to this path; load "
+                         "it at ui.perfetto.dev")
     ap.add_argument("--results", default="",
                     help="write per-request {id: {status, digest}} JSON")
     ap.add_argument("--sigterm-after", type=int, default=0,
@@ -154,10 +159,23 @@ def main(argv=None) -> int:
         name: batcher.CANONICAL_FAMILIES[name].chunk_len
         for name in family_names
     }
+    tracer = None
+    if args.trace:
+        from tpu_aerial_transport.obs import export as export_mod
+        from tpu_aerial_transport.obs import trace as trace_lib
+
+        # Spans also land as trace_event rows in the metrics jsonl when
+        # one is configured (one durable record, two renderings).
+        sink = (export_mod.MetricsWriter(args.metrics)
+                if args.metrics else None)
+        tracer = trace_lib.Tracer(sink, track="server")
     kw = dict(
         families=family_names, buckets=buckets, capacity=args.capacity,
         bundle=args.bundle or None, require_bundle=args.require_bundle,
-        run_dir=args.run_dir or None, metrics=args.metrics or None,
+        run_dir=args.run_dir or None,
+        metrics=(tracer.sink if tracer is not None and tracer.sink
+                 else args.metrics or None),
+        tracer=tracer,
     )
 
     with GracefulInterrupt() as interrupt:
@@ -248,11 +266,28 @@ def main(argv=None) -> int:
     if args.results:
         with open(args.results, "w") as fh:
             json.dump(results, fh, indent=1)
+    trace_summary = {}
+    if tracer is not None and tracer.rows:
+        from tpu_aerial_transport.obs import trace as trace_lib
+
+        trace_lib.write_chrome_trace(
+            args.trace, trace_lib.stitch(tracer.rows)
+        )
+        cp = trace_lib.critical_path(tracer.rows)
+        trace_summary = {
+            "trace": args.trace,
+            "trace_spans": len(tracer.rows),
+            "critical_path_p99": {
+                seg: round(st["p99"], 4)
+                for seg, st in cp["per_segment"].items()
+            },
+        }
     summary = {
         "mode": ("resume" if args.resume
                  else "bundled" if args.bundle else "jit"),
         "wall_s": round(wall_s, 3),
         "rounds": rounds,
+        **trace_summary,
         "scenario_mpc_steps_per_sec": (
             round(stats["scenario_steps"] / wall_s, 2) if wall_s else None
         ),

@@ -347,3 +347,163 @@ def test_v2_files_remain_valid_but_not_for_aot_serve(tmp_path):
         }) + "\n")
     errs = export_mod.validate_file(path)
     assert len(errs) == 1 and "requires schema >= 3" in errs[0]
+
+
+# ------------------- schema v5: trace_event vocabulary -----------------
+
+def test_trace_event_validates_at_schema_v5(tmp_path):
+    from tpu_aerial_transport.obs import trace as trace_mod
+
+    path = str(tmp_path / "tr.metrics.jsonl")
+    tr = trace_mod.Tracer(export_mod.MetricsWriter(path), track="p0of1")
+    with tr.span(trace_mod.CHUNK, chunk=0):
+        pass
+    tr.instant("preempted", parent=None, chunk=1)
+    assert export_mod.validate_file(path) == []
+    events = export_mod.read_events(path)
+    assert [e["event"] for e in events] == ["trace_event", "trace_event"]
+    assert all(e["schema"] == export_mod.SCHEMA_VERSION >= 5
+               for e in events)
+    # Both clock domains present — the stitcher's alignment anchor.
+    assert all("t0_mono" in e and "t0_wall" in e for e in events)
+
+
+def test_trace_event_requires_ids_and_clocks(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("trace_event", name="chunk", trace_id="t", span_id="s",
+           track="p0of1", t0_mono=0.0)  # no t0_wall.
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "missing fields ['t0_wall']" in errs[0]
+
+
+def test_v4_files_remain_valid_but_not_for_trace_event(tmp_path):
+    """Additive bump contract, v5 edition: a v4 file still validates; a
+    trace_event STAMPED v4 does not."""
+    path = str(tmp_path / "old.metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "schema": 4, "event": "serving_event", "ts": 0.0,
+            "kind": "submitted", "request_id": "r0",
+        }) + "\n")
+    assert export_mod.validate_file(path) == []
+    with open(path, "a") as fh:
+        fh.write(json.dumps({
+            "schema": 4, "event": "trace_event", "ts": 0.0,
+            "name": "chunk", "trace_id": "t", "span_id": "s",
+            "track": "p0of1", "t0_mono": 0.0, "t0_wall": 0.0,
+        }) + "\n")
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "requires schema >= 5" in errs[0]
+
+
+# ------------- concurrent writers: the pods durability pin -------------
+
+def test_concurrent_writers_interleave_without_torn_lines(tmp_path):
+    """Two PROCESSES appending to one jsonl through
+    obs.export.jsonl_append (the pods tier's implicit reliance: N
+    workers share one run dir, the guard/journal/metrics writers all
+    ride this primitive): every line lands whole — no torn or
+    interleaved lines — and validate_file stays green. O_APPEND +
+    single-write-per-line is the mechanism; this pins it."""
+    path = str(tmp_path / "shared.metrics.jsonl")
+    n_events = 200
+    # Payload long enough that a non-atomic append WOULD interleave.
+    code = (
+        "import sys\n"
+        "sys.path.insert(0, {repo!r})\n"
+        "import json, os\n"
+        "def append(path, obj):\n"
+        "    with open(path, 'a', encoding='utf-8') as fh:\n"
+        "        fh.write(json.dumps(obj) + '\\n')\n"
+        "        fh.flush()\n"
+        "        os.fsync(fh.fileno())\n"
+        "wid = int(sys.argv[1])\n"
+        "for i in range({n}):\n"
+        "    append({path!r}, {{'schema': {schema}, 'event': 'chunk',\n"
+        "            'ts': 0.0, 'chunk': i, 'wall_s': 0.1,\n"
+        "            'writer': wid, 'pad': 'x' * 512}})\n"
+    ).format(repo=REPO, n=n_events, path=path,
+             schema=export_mod.SCHEMA_VERSION)
+    procs = [
+        subprocess.Popen([sys.executable, "-c", code, str(w)],
+                         cwd=REPO, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE)
+        for w in range(2)
+    ]
+    for p in procs:
+        _, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.readlines()
+    assert len(lines) == 2 * n_events
+    seen = {0: [], 1: []}
+    for line in lines:
+        obj = json.loads(line)  # raises on any torn/interleaved line.
+        seen[obj["writer"]].append(obj["chunk"])
+    # Per-writer order preserved (appends are sequential per process).
+    assert seen[0] == list(range(n_events))
+    assert seen[1] == list(range(n_events))
+    assert export_mod.validate_file(path) == []
+
+
+def test_jsonl_append_itself_matches_the_subprocess_recipe(tmp_path):
+    """The subprocess above re-implements the 5-line append so it can't
+    silently diverge from the real one: pin jsonl_append's observable
+    behavior (whole line + newline, appended, fsync'd) here."""
+    path = str(tmp_path / "a.jsonl")
+    export_mod.jsonl_append(path, {"a": 1})
+    export_mod.jsonl_append(path, {"b": 2})
+    with open(path) as fh:
+        assert [json.loads(l) for l in fh] == [{"a": 1}, {"b": 2}]
+
+
+# -------------- run_health serving-SLO dedup (append mode) -------------
+
+def _serving_events(writer, latency, occupancy, reason="queue_full"):
+    """One synthetic request lifecycle + boundary + rejection, the
+    fields run_health's serving section reads."""
+    writer.emit("serving_event", kind="submitted", request_id="rq0",
+                family="f")
+    writer.emit("serving_event", kind="completed", request_id="rq0",
+                family="f", batch_id=0,
+                slo={"latency_s": latency,
+                     "admit_to_complete_s": latency / 2})
+    writer.emit("serving_event", kind="rejected", request_id="rq1",
+                family="f", reason=reason)
+    writer.emit("serving_event", kind="deadline_missed",
+                request_id="rq2", family="f", missed="in_queue")
+    writer.emit("serving_event", kind="batch_launch", family="f",
+                batch_id=0, bucket=8, lanes=1)
+    writer.emit("serving_event", kind="batch_boundary", family="f",
+                batch_id=0, chunk=1, occupancy=occupancy, rung="jit")
+
+
+def test_run_health_serving_section_dedups_appended_rerun(tmp_path):
+    """Regression (ISSUE 15 satellite): a metrics file APPENDED by a
+    re-measured run (bench --resume / a re-run example) must not skew
+    the serving percentile/occupancy rows — aggregate per request_id /
+    (batch_id, chunk), LAST event wins (the PR-10 topology-table rule).
+    """
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import run_health
+
+    path = str(tmp_path / "serve.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    _serving_events(w, latency=1.0, occupancy=0.5)
+    # The re-measured run appends the SAME identities, new numbers.
+    _serving_events(w, latency=3.0, occupancy=0.9,
+                    reason="no_bucket_coverage")
+    sv = run_health.summarize(export_mod.read_events(path))["serving"]
+    # One completed request, not two: percentiles from the last run.
+    assert sv["latency_s"]["count"] == 1
+    assert sv["latency_s"]["p50"] == 3.0
+    assert sv["admit_to_complete_s"]["count"] == 1
+    # One boundary per (batch, chunk): occupancy from the last event.
+    assert sv["mean_occupancy"] == 0.9
+    # Rejection reason deduped per request: last reason only.
+    assert sv["rejections"] == {"no_bucket_coverage": 1}
+    assert sv["deadline_misses"] == {"in_queue": 1}
+    # Raw event counts stay honest counts (the dedup is aggregation-
+    # side).
+    assert sv["kinds"]["completed"] == 2

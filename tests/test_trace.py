@@ -1,0 +1,699 @@
+"""obs.trace: the distributed-tracing span layer — tracer mechanics,
+request-path propagation through the serving tier, guard/recovery spans,
+clock stitching, Chrome-trace conversion + validation, critical-path
+accounting, and the zero-cost tracer=None contract (HLO identity)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.obs import export as export_mod
+from tpu_aerial_transport.obs import trace as trace_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE_VIEW = os.path.join(REPO, "tools", "trace_view.py")
+RUN_HEALTH = os.path.join(REPO, "tools", "run_health.py")
+
+
+# ----------------------------- tracer core -----------------------------
+
+def test_span_nesting_and_parentage():
+    tr = trace_mod.Tracer(track="t")
+    with tr.span("run", run_dir="/tmp/x") as run:
+        with tr.span("chunk", chunk=0) as chunk:
+            assert chunk.parent_id == run.span_id
+            assert chunk.trace_id == run.trace_id
+        # Sibling after the nested span closes: still under run.
+        with tr.span("chunk", chunk=1) as c1:
+            assert c1.parent_id == run.span_id
+    names = [r["name"] for r in tr.rows]
+    assert names == ["chunk", "chunk", "run"]  # children end first.
+    run_row = tr.rows[-1]
+    assert "parent_id" not in run_row  # the lexical root has no parent.
+    assert run_row["attrs"]["run_dir"] == "/tmp/x"
+    for r in tr.rows:
+        assert r["t1_mono"] >= r["t0_mono"]
+        assert r["track"] == "t"
+
+
+def test_explicit_parent_and_cross_call_span():
+    tr = trace_mod.Tracer()
+    root = tr.begin("request", parent=None, request_id="r0")
+    q = tr.begin("queue_wait", parent=root)
+    assert q.trace_id == root.trace_id and q.parent_id == root.span_id
+    tr.end(q, batch_id=3)
+    tr.end(root, status="completed")
+    assert tr.rows[0]["attrs"]["batch_id"] == 3
+    # end() is idempotent: a defensive second end keeps the first stamps.
+    t1 = tr.rows[1]["t1_mono"]
+    tr.end(root)
+    assert len(tr.rows) == 2 and tr.rows[1]["t1_mono"] == t1
+
+
+def test_instant_and_sink_callable():
+    seen = []
+    tr = trace_mod.Tracer(sink=seen.append)
+    tr.instant("preempted", parent=None, chunk=2)
+    assert seen == tr.rows
+    assert seen[0]["t1_mono"] == seen[0]["t0_mono"]
+    assert seen[0]["attrs"]["chunk"] == 2
+
+
+def test_rows_export_schema_v5_valid(tmp_path):
+    path = str(tmp_path / "t.metrics.jsonl")
+    tr = trace_mod.Tracer(export_mod.MetricsWriter(path), track="p0of1")
+    with tr.span("run"):
+        with tr.span("chunk", chunk=0):
+            pass
+    assert export_mod.validate_file(path) == []
+    events = export_mod.read_events(path)
+    trows = trace_mod.trace_rows(events)
+    assert len(trows) == 2
+    assert all(e["schema"] == export_mod.SCHEMA_VERSION for e in trows)
+
+
+# ------------------------------ stitching ------------------------------
+
+def _fake_row(track, name, t0_mono, t1_mono, wall_off, trace_id="tA",
+              span_id=None, parent_id=None, attrs=None):
+    return {
+        "name": name, "trace_id": trace_id,
+        "span_id": span_id or trace_mod.new_span_id(),
+        "track": track, "t0_mono": t0_mono, "t1_mono": t1_mono,
+        "t0_wall": t0_mono + wall_off, "t1_wall": t1_mono + wall_off,
+        **({"parent_id": parent_id} if parent_id else {}),
+        **({"attrs": attrs} if attrs else {}),
+    }
+
+
+def test_stitch_aligns_monotonic_domains():
+    """Two processes whose monotonic clocks started at wildly different
+    origins but whose wall clocks agree: stitched times are comparable
+    across tracks, durations stay exactly the monotonic ones."""
+    # p0's mono starts near 0, p1's near 1e6 (a long-lived process) —
+    # the same physical instant (wall 1000.0) for both first spans.
+    r0 = _fake_row("p0of2", "chunk", 5.0, 7.0, wall_off=995.0)
+    r1 = _fake_row("p1of2", "chunk", 1e6 + 5.0, 1e6 + 6.0,
+                   wall_off=995.0 - 1e6)
+    stitched = trace_mod.stitch([r0, r1])
+    s0, s1 = stitched
+    assert s0["t0"] == pytest.approx(s1["t0"], abs=1e-6)  # same instant.
+    assert s0["t1"] - s0["t0"] == pytest.approx(2.0)
+    assert s1["t1"] - s1["t0"] == pytest.approx(1.0)
+
+
+def test_stitch_run_dir_refuses_empty_fleet(tmp_path):
+    """ZERO trace rows under a manifest naming N processes is the most
+    complete partial-fleet lie (every worker killed before a span
+    ended): refuse, don't publish an empty trace."""
+    run_dir = str(tmp_path)
+    with open(os.path.join(run_dir, "carry.shards.json"), "w") as fh:
+        json.dump({"n_processes": 2}, fh)
+    with pytest.raises(ValueError, match="only 0 track"):
+        trace_mod.stitch_run_dir(run_dir)
+    assert trace_mod.stitch_run_dir(run_dir, allow_partial=True) == []
+
+
+def test_stitch_run_dir_refuses_partial_fleet(tmp_path):
+    run_dir = str(tmp_path)
+    export_mod.jsonl_append(
+        os.path.join(run_dir, "trace.p0of2.metrics.jsonl"),
+        {"schema": export_mod.SCHEMA_VERSION, "event": "trace_event",
+         "ts": 0.0, **_fake_row("p0of2", "run", 0.0, 1.0, 100.0)},
+    )
+    with open(os.path.join(run_dir, "carry.shards.json"), "w") as fh:
+        json.dump({"n_processes": 2}, fh)
+    with pytest.raises(ValueError, match="2 processes"):
+        trace_mod.stitch_run_dir(run_dir)
+    assert len(trace_mod.stitch_run_dir(run_dir, allow_partial=True)) == 1
+    # The second process's file completes the fleet.
+    export_mod.jsonl_append(
+        os.path.join(run_dir, "trace.p1of2.metrics.jsonl"),
+        {"schema": export_mod.SCHEMA_VERSION, "event": "trace_event",
+         "ts": 0.0, **_fake_row("p1of2", "run", 50.0, 51.0, 50.0)},
+    )
+    rows = trace_mod.stitch_run_dir(run_dir)
+    assert {r["track"] for r in rows} == {"p0of2", "p1of2"}
+
+
+# ------------------------- chrome trace + gate -------------------------
+
+def test_chrome_trace_packs_overlapping_spans_and_validates():
+    tr = trace_mod.Tracer(track="server")
+    # Two concurrent requests: same-name spans overlapping in time must
+    # land on separate packed lanes (Perfetto slice tracks cannot hold
+    # overlapping X events).
+    a = tr.begin("request", parent=None, request_id="a")
+    b = tr.begin("request", parent=None, request_id="b")
+    tr.end(a)
+    tr.end(b)
+    obj = trace_mod.chrome_trace(tr.rows)
+    assert trace_mod.validate_chrome_trace(obj) == []
+    xs = [e for e in obj["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 2
+    assert xs[0]["tid"] != xs[1]["tid"]
+    names = {e["args"]["name"] for e in obj["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert {"request", "request.1"} <= names
+
+
+def test_validate_chrome_trace_catches_violations():
+    ok = {"traceEvents": [
+        {"ph": "X", "name": "a", "pid": 1, "tid": 1, "ts": 0.0,
+         "dur": 5.0, "args": {"trace_id": "t", "span_id": "s1"}},
+    ]}
+    assert trace_mod.validate_chrome_trace(ok) == []
+    bad_parent = {"traceEvents": ok["traceEvents"] + [
+        {"ph": "X", "name": "b", "pid": 1, "tid": 2, "ts": 1.0,
+         "dur": 1.0,
+         "args": {"trace_id": "t", "span_id": "s2",
+                  "parent_id": "missing"}},
+    ]}
+    errs = trace_mod.validate_chrome_trace(bad_parent)
+    assert errs and "parent_id" in errs[0]
+    overlap = {"traceEvents": [
+        {"ph": "X", "name": "a", "pid": 1, "tid": 1, "ts": 0.0,
+         "dur": 5.0, "args": {}},
+        {"ph": "X", "name": "a", "pid": 1, "tid": 1, "ts": 2.0,
+         "dur": 1.0, "args": {}},
+    ]}
+    assert any("overlap" in e for e in
+               trace_mod.validate_chrome_trace(overlap))
+    nonmono = {"traceEvents": [
+        {"ph": "i", "s": "t", "name": "a", "pid": 1, "tid": 1, "ts": 5.0},
+        {"ph": "i", "s": "t", "name": "b", "pid": 1, "tid": 1, "ts": 1.0},
+    ]}
+    assert any("non-monotone" in e for e in
+               trace_mod.validate_chrome_trace(nonmono))
+    assert trace_mod.validate_chrome_trace({"nope": 1})
+
+
+def test_trace_view_cli_validate_gate(tmp_path):
+    good = str(tmp_path / "good.trace.json")
+    tr = trace_mod.Tracer()
+    with tr.span("run"):
+        pass
+    trace_mod.write_chrome_trace(good, tr.rows)
+    proc = subprocess.run(
+        [sys.executable, TRACE_VIEW, "--validate", good],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    bad = str(tmp_path / "bad.trace.json")
+    with open(bad, "w") as fh:
+        fh.write("{not json")
+    proc = subprocess.run(
+        [sys.executable, TRACE_VIEW, "--validate", bad],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    assert "unreadable" in proc.stderr
+
+
+# ----------------------- serving-path propagation ----------------------
+
+@pytest.fixture(scope="module")
+def traced_serving_run(tmp_path_factory):
+    """One small traced serving run (centralized family — cheapest
+    compile), shared by the propagation / accounting / rendering tests."""
+    from tpu_aerial_transport.serving import server as server_mod
+    from tpu_aerial_transport.serving.queue import ScenarioRequest
+
+    tmp = tmp_path_factory.mktemp("traced_serve")
+    mpath = str(tmp / "serve.metrics.jsonl")
+    writer = export_mod.MetricsWriter(mpath)
+    tracer = trace_mod.Tracer(writer, track="server")
+    server = server_mod.ScenarioServer(
+        families=["centralized4"], buckets=(8,), metrics=writer,
+        tracer=tracer,
+    )
+    tickets = [
+        server.submit(ScenarioRequest(
+            family="centralized4", horizon=2 * (1 + i % 2),
+            request_id=f"req{i:03d}",
+        ))
+        for i in range(3)
+    ]
+    rejected = server.submit(ScenarioRequest(
+        family="not_served", horizon=2, request_id="reqbad",
+    ))
+    server.run_until_drained()
+    return server, tracer, tickets, rejected, mpath
+
+
+def test_request_spans_propagate_through_pipeline(traced_serving_run):
+    server, tracer, tickets, rejected, _ = traced_serving_run
+    rows = tracer.rows
+    by_name: dict = {}
+    for r in rows:
+        by_name.setdefault(r["name"], []).append(r)
+    assert {trace_mod.REQUEST, trace_mod.QUEUE_WAIT,
+            trace_mod.BATCH_FORM, trace_mod.CHUNK_DISPATCH,
+            trace_mod.HARVEST, trace_mod.GUARD_DISPATCH} \
+        <= set(by_name)
+    # Every completed ticket: its own trace, queue_wait child of the
+    # request root, and the request's trace id appearing in at least one
+    # dispatch span's lane map.
+    dispatch_members = set()
+    for d in by_name[trace_mod.CHUNK_DISPATCH]:
+        for lane in d["attrs"]["lanes"]:
+            dispatch_members.add(lane[2])
+        assert d["attrs"]["rung"]  # serve-ladder rung stamped.
+    for t in tickets:
+        assert t.status == "completed"
+        assert t.trace is not None
+        tid = t.trace.trace_id
+        req = [r for r in by_name[trace_mod.REQUEST]
+               if r["trace_id"] == tid]
+        assert len(req) == 1
+        assert req[0]["attrs"]["status"] == "completed"
+        q = [r for r in by_name[trace_mod.QUEUE_WAIT]
+             if r["trace_id"] == tid]
+        assert len(q) == 1
+        assert q[0]["parent_id"] == req[0]["span_id"]
+        assert q[0]["attrs"]["batch_id"] == t.batch_id
+        assert tid in dispatch_members
+    # The minted trace context rides the (replaced) request object.
+    assert all(t.request.trace_id == t.trace.trace_id for t in tickets)
+    # Guard spans nest under the dispatch spans they guard.
+    dspan_ids = {d["span_id"] for d in by_name[trace_mod.CHUNK_DISPATCH]}
+    for g in by_name[trace_mod.GUARD_DISPATCH]:
+        assert g["parent_id"] in dspan_ids
+
+
+def test_rejection_is_terminal_span(traced_serving_run):
+    _, tracer, _, rejected, _ = traced_serving_run
+    assert rejected.status == "rejected"
+    rej = [r for r in tracer.rows if r["name"] == trace_mod.REQUEST
+           and r.get("attrs", {}).get("status") == "rejected"]
+    assert len(rej) == 1
+    assert rej[0]["attrs"]["reason"] == "no_bucket_coverage"
+    # No queue_wait span for a rejected request.
+    assert not any(r["name"] == trace_mod.QUEUE_WAIT
+                   and r["trace_id"] == rej[0]["trace_id"]
+                   for r in tracer.rows)
+
+
+def test_critical_path_segments_sum_exactly(traced_serving_run):
+    """The acceptance bar: every completed request's segments sum to its
+    submit→complete interval within 1% (exact by construction here)."""
+    _, tracer, tickets, _, _ = traced_serving_run
+    cp = trace_mod.critical_path(tracer.rows)
+    assert cp["completed"] == len(tickets)
+    for q in cp["requests"]:
+        if q["status"] != "completed":
+            continue
+        total = q["total_s"]
+        s = sum(q["segments"].values())
+        assert abs(s - total) <= max(1e-9, 0.01 * total), (q, s)
+        assert set(q["segments"]) == set(trace_mod.SEGMENTS)
+        assert q["segments"]["device"] > 0  # device time attributed.
+    assert cp["worst"] is not None
+    assert set(cp["per_segment"]) == set(trace_mod.SEGMENTS)
+
+
+def test_critical_path_dedups_remeasured_requests():
+    """Append-mode files re-measure requests under the same request_id:
+    only the LAST request span per id counts (the run_health dedup
+    rule)."""
+    rows = []
+    for run in range(2):
+        off = 100.0 * run
+        tid = f"t{run}"
+        rows.append(_fake_row("s", "request", off, off + 2.0 + run, 0.0,
+                              trace_id=tid,
+                              attrs={"request_id": "reqX",
+                                     "status": "completed"}))
+        rows.append(_fake_row("s", "queue_wait", off, off + 1.0, 0.0,
+                              trace_id=tid))
+    cp = trace_mod.critical_path(rows)
+    assert len(cp["requests"]) == 1
+    assert cp["requests"][0]["total_s"] == pytest.approx(3.0)
+    assert cp["requests"][0]["segments"]["queue_wait"] == pytest.approx(1.0)
+
+
+def test_critical_path_clamps_window_to_restored_request_start():
+    """Regression (review finding): a RESTORED request's post-resume
+    span shares its trace_id with the dead run's queue_wait and batch
+    spans; the in-batch window must start no earlier than the request
+    span itself, or pre-resume device time counts into the restored
+    request and the segments exceed the total."""
+    rows = [
+        # Dead run: queue span + a dispatch that served this trace.
+        _fake_row("s", "queue_wait", 0.0, 50.0, 0.0, trace_id="tA"),
+        _fake_row("s", "chunk_dispatch", 40.0, 60.0, 0.0,
+                  trace_id="srv", attrs={"lanes": [[0, "rq", "tA"]]}),
+        # Post-resume: the surviving request span (restored=True path),
+        # plus the dispatch that actually finished it.
+        _fake_row("s", "request", 100.0, 110.0, 0.0, trace_id="tA",
+                  attrs={"request_id": "rq", "status": "completed"}),
+        _fake_row("s", "chunk_dispatch", 102.0, 108.0, 0.0,
+                  trace_id="srv", attrs={"lanes": [[0, "rq", "tA"]]}),
+    ]
+    cp = trace_mod.critical_path(rows)
+    q = cp["requests"][0]
+    assert q["total_s"] == pytest.approx(10.0)
+    assert q["segments"]["device"] == pytest.approx(6.0)  # not 26.
+    assert q["segments"]["queue_wait"] == 0.0  # dead-run span pre-t0.
+    assert sum(q["segments"].values()) == pytest.approx(q["total_s"])
+
+
+def test_snapshot_span_survives_failing_boundary_publish(
+    chunked_bits, tmp_path, monkeypatch
+):
+    """Regression (review finding): a SnapshotError at the boundary
+    publish must export the snapshot span (error-tagged), not drop the
+    one record of the failing publish."""
+    from tpu_aerial_transport.harness import checkpoint
+    from tpu_aerial_transport.resilience import recovery
+
+    run, state0, cs0 = chunked_bits
+    tr = trace_mod.Tracer()
+
+    def boom(*a, **k):
+        raise checkpoint.SnapshotError("unreadable", "x", "disk gone")
+
+    monkeypatch.setattr(recovery.checkpoint, "save_snapshot", boom)
+    plan = recovery.RunPlan(run_dir=str(tmp_path / "run"),
+                            n_hl_steps=4, n_chunks=2)
+    with pytest.raises(checkpoint.SnapshotError):
+        recovery.run_chunks(
+            plan, run.chunk_jit, run.init_carry(state0, cs0), tracer=tr,
+        )
+    snap = [r for r in tr.rows if r["name"] == trace_mod.SNAPSHOT]
+    assert len(snap) == 1 and snap[0]["attrs"]["error"] == "snapshot"
+    chunk = [r for r in tr.rows if r["name"] == trace_mod.CHUNK]
+    assert chunk[0]["attrs"]["error"] == "snapshot"
+    run_row = [r for r in tr.rows if r["name"] == trace_mod.RUN]
+    assert run_row[0]["attrs"]["status"] == "error"
+
+
+def test_run_health_renders_critical_path_section(traced_serving_run):
+    _, _, _, _, mpath = traced_serving_run
+    proc = subprocess.run(
+        [sys.executable, RUN_HEALTH, mpath],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    assert "critical path (distributed tracing" in out
+    assert "worst request: req" in out
+    for seg in trace_mod.SEGMENTS:
+        assert f"| {seg} |" in out
+    # And the trace still validates as metrics jsonl (ci gate).
+    gate = subprocess.run(
+        [sys.executable, RUN_HEALTH, "--validate", mpath],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert gate.returncode == 0, gate.stdout + gate.stderr
+
+
+def test_chrome_trace_of_serving_run_validates(traced_serving_run, tmp_path):
+    _, tracer, _, _, _ = traced_serving_run
+    out = str(tmp_path / "serve.trace.json")
+    obj = trace_mod.write_chrome_trace(out, tracer.rows)
+    assert trace_mod.validate_chrome_trace(obj) == []
+    # Perfetto-loadable basics: process metadata + X slices present.
+    phs = {e["ph"] for e in obj["traceEvents"]}
+    assert "X" in phs and "M" in phs
+
+
+# --------------------------- guard + recovery --------------------------
+
+def test_guard_spans_carry_rung_and_error_kind():
+    from tpu_aerial_transport.resilience import backend as backend_mod
+
+    tr = trace_mod.Tracer(track="guard")
+    faults = backend_mod.FaultInjector.from_env("crash@boom")
+    guard = backend_mod.BackendGuard(
+        faults=faults, tracer=tr, primary_rung="on-chip",
+    )
+    parent = tr.begin("chunk_dispatch", parent=None, lanes=[[0, "r", "t"]])
+    value, rung = guard.run("boom", lambda: 42, fallback_fn=lambda: 7,
+                            trace_parent=parent)
+    tr.end(parent)
+    assert (value, rung) == (7, backend_mod.RUNG_CPU)
+    g = [r for r in tr.rows if r["name"] == trace_mod.GUARD_DISPATCH]
+    f = [r for r in tr.rows if r["name"] == trace_mod.GUARD_FALLBACK]
+    assert len(g) == 1 and len(f) == 1
+    assert g[0]["attrs"]["kind"] == "device_crash"
+    assert g[0]["parent_id"] == parent.span_id
+    assert f[0]["attrs"]["rung"] == backend_mod.RUNG_CPU
+    assert f[0]["attrs"]["after"] == "device_crash"
+    # The fallback span inherits the dispatch's lane map through the
+    # parent chain (the accountant's "retry" segment linkage).
+    by_id = {r["span_id"]: r for r in tr.rows}
+    assert trace_mod._members(f[0], by_id) == ["t"]
+
+
+def test_guard_success_span_records_rung():
+    from tpu_aerial_transport.resilience import backend as backend_mod
+
+    tr = trace_mod.Tracer()
+    guard = backend_mod.BackendGuard(tracer=tr, primary_rung="cpu-tagged")
+    value, rung = guard.run("ok", lambda: 1)
+    assert value == 1
+    g = [r for r in tr.rows if r["name"] == trace_mod.GUARD_DISPATCH]
+    assert len(g) == 1 and g[0]["attrs"]["rung"] == rung
+
+
+@pytest.fixture(scope="module")
+def chunked_bits():
+    import jax.numpy as jnp
+
+    from tpu_aerial_transport.control import centralized, lowlevel
+    from tpu_aerial_transport.harness import rollout as h_rollout
+    from tpu_aerial_transport.harness import setup
+
+    params, col, state0 = setup.rqp_setup(4)
+    cfg = centralized.make_config(
+        params, col.collision_radius, col.max_deceleration, solver_iters=8
+    )
+    f_eq = centralized.equilibrium_forces(params)
+    llc = lowlevel.make_lowlevel_controller("pd", params)
+    x0 = state0.xl
+
+    def hl(cs, s, a):
+        return centralized.control(params, cfg, f_eq, cs, s, a)
+
+    def acc_des_fn(state, t):
+        del t
+        dvl = -1.0 * state.vl - 1.0 * (state.xl - x0)
+        return (dvl, jnp.zeros(3, state.xl.dtype)), x0, jnp.zeros(3)
+
+    run = h_rollout.make_chunked_rollout(
+        hl, llc.control, params, n_hl_steps=4, n_chunks=2,
+        acc_des_fn=acc_des_fn,
+    )
+    cs0 = centralized.init_ctrl_state(params, cfg)
+    return run, state0, cs0
+
+
+def test_run_chunks_emits_chunk_spans(chunked_bits, tmp_path):
+    from tpu_aerial_transport.resilience import recovery
+
+    run, state0, cs0 = chunked_bits
+    tr = trace_mod.Tracer(track="p0of1")
+    plan = recovery.RunPlan(run_dir=str(tmp_path / "run"),
+                            n_hl_steps=4, n_chunks=2)
+    result = recovery.run_chunks(
+        plan, run.chunk_jit, run.init_carry(state0, cs0), tracer=tr,
+    )
+    assert result.status == "done"
+    names = [r["name"] for r in tr.rows]
+    assert names.count(trace_mod.CHUNK) == 2
+    assert names.count(trace_mod.SNAPSHOT) == 2
+    assert names.count(trace_mod.RUN) == 1
+    run_row = next(r for r in tr.rows if r["name"] == trace_mod.RUN)
+    assert run_row["attrs"]["status"] == "done"
+    for r in tr.rows:
+        if r["name"] == trace_mod.CHUNK:
+            assert r["parent_id"] == run_row["span_id"]
+        if r["name"] == trace_mod.SNAPSHOT:
+            assert r["parent_id"] in {
+                c["span_id"] for c in tr.rows
+                if c["name"] == trace_mod.CHUNK
+            }
+
+
+def test_resume_trace_shows_boundary_with_parented_spans(
+    chunked_bits, tmp_path
+):
+    """The resume acceptance shape: a preempted run's trace (pre spans)
+    plus the resumed run's trace (resume span + post chunk spans
+    parented under it), both in the run dir's metrics files, stitch into
+    one validating trace."""
+    from tpu_aerial_transport.resilience import recovery
+
+    run, state0, cs0 = chunked_bits
+    run_dir = str(tmp_path / "run")
+    m1 = os.path.join(run_dir, "trace.pre.metrics.jsonl")
+    tr1 = trace_mod.Tracer(export_mod.MetricsWriter(m1), track="p0of1")
+    plan = recovery.RunPlan(run_dir=run_dir, n_hl_steps=4, n_chunks=2)
+
+    class _Trip:  # trigger after chunk 0 completes.
+        @property
+        def triggered(self):
+            journal = recovery.RunJournal(run_dir)
+            return ("SIM" if 0 in journal.completed_chunks() else None)
+
+    r1 = recovery.run_chunks(
+        plan, run.chunk_jit, run.init_carry(state0, cs0),
+        interrupt=_Trip(), tracer=tr1,
+    )
+    assert r1.status == "preempted" and r1.chunks_done == 1
+
+    m2 = os.path.join(run_dir, "trace.post.metrics.jsonl")
+    tr2 = trace_mod.Tracer(export_mod.MetricsWriter(m2), track="p0of1")
+    r2 = recovery.resume_run(
+        run_dir, run.chunk_jit, run.init_carry(state0, cs0), tracer=tr2,
+    )
+    assert r2.status == "done" and r2.resumed_from_chunk == 1
+
+    resume_row = next(r for r in tr2.rows
+                      if r["name"] == trace_mod.RESUME)
+    run_row = next(r for r in tr2.rows if r["name"] == trace_mod.RUN)
+    assert resume_row["attrs"]["start_chunk"] == 1
+    assert run_row["parent_id"] == resume_row["span_id"]
+    assert run_row["trace_id"] == resume_row["trace_id"]
+    post_chunks = [r for r in tr2.rows if r["name"] == trace_mod.CHUNK]
+    assert len(post_chunks) == 1 and post_chunks[0]["attrs"]["chunk"] == 1
+    assert post_chunks[0]["parent_id"] == run_row["span_id"]
+    # Pre spans: chunk 0 + the preemption instant.
+    assert any(r["name"] == "preempted" for r in tr1.rows)
+
+    # The whole run dir stitches into one validating Perfetto trace.
+    rows = trace_mod.stitch_run_dir(run_dir)
+    assert len(rows) == len(tr1.rows) + len(tr2.rows)
+    obj = trace_mod.chrome_trace(rows)
+    assert trace_mod.validate_chrome_trace(obj) == []
+
+
+def test_retry_instant_on_host_level_requeue(chunked_bits, tmp_path):
+    from tpu_aerial_transport.resilience import recovery
+
+    run, state0, cs0 = chunked_bits
+    tr = trace_mod.Tracer()
+    calls = {"n": 0}
+    real = run.chunk_jit
+
+    def flaky(carry, i0):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("synthetic device error")
+        return real(carry, i0)
+
+    plan = recovery.RunPlan(run_dir=str(tmp_path / "run"),
+                            n_hl_steps=4, n_chunks=2)
+    result = recovery.run_chunks(
+        plan, flaky, run.init_carry(state0, cs0), max_retries=1,
+        tracer=tr,
+    )
+    assert result.status == "done" and result.retries == 1
+    retries = [r for r in tr.rows if r["name"] == trace_mod.RETRY]
+    assert len(retries) == 1 and retries[0]["attrs"]["attempt"] == 1
+    # The failed chunk span closed with the error, then chunk 0 ran again.
+    errored = [r for r in tr.rows if r["name"] == trace_mod.CHUNK
+               and "error" in r.get("attrs", {})]
+    assert len(errored) == 1
+
+
+# ------------------------------ zero cost ------------------------------
+
+def test_tracer_none_is_zero_cost_and_hlo_identical():
+    """tracer=None: no trace handles on tickets, no rows anywhere — and
+    since tracing is host-only, the served program's lowered HLO is
+    byte-identical with a tracer active vs absent (the no_faults() /
+    telemetry=None contract)."""
+    import jax
+
+    from tpu_aerial_transport.serving import batcher
+
+    def lowered(with_tracer: bool):
+        jax.clear_caches()  # identical trace-cache footing (PR 12 rule).
+        fam = batcher.make_family("centralized4")
+        carry = fam.template_carry_host()
+        batch = jax.tree.map(lambda x: np.stack([x, x]), carry)
+        if with_tracer:
+            tr = trace_mod.Tracer()
+            with tr.span(trace_mod.CHUNK_DISPATCH):
+                return jax.jit(fam.batched_fn).lower(
+                    batch, np.int32(0)
+                ).as_text()
+        return jax.jit(fam.batched_fn).lower(batch, np.int32(0)).as_text()
+
+    assert lowered(False) == lowered(True)
+
+
+def test_minted_trace_id_reaches_the_serving_journal(tmp_path):
+    """Regression (review finding): admission mints the trace_id onto a
+    REPLACED request object; the server must journal that one, or
+    resume re-mints and pre/post-resume spans land on different traces
+    (the acceptance criterion's trace-identity contract)."""
+    from tpu_aerial_transport.resilience.recovery import RunJournal
+    from tpu_aerial_transport.serving import server as server_mod
+    from tpu_aerial_transport.serving.queue import ScenarioRequest
+
+    run_dir = str(tmp_path / "run")
+    tracer = trace_mod.Tracer()
+    server = server_mod.ScenarioServer(
+        families=["centralized4"], buckets=(8,), run_dir=run_dir,
+        tracer=tracer,
+    )
+    t = server.submit(ScenarioRequest(family="centralized4", horizon=2,
+                                      request_id="rj0"))
+    assert t.trace is not None and t.request.trace_id == t.trace.trace_id
+    rows = [e for e in RunJournal(run_dir, server_mod.SERVING_JOURNAL)
+            .read() if e.get("event") == "serving_request"]
+    assert len(rows) == 1
+    assert rows[0]["request"]["trace_id"] == t.trace.trace_id
+    # And the round-trip the resume path performs keeps it.
+    back = ScenarioRequest.from_json(rows[0]["request"])
+    assert back.trace_id == t.trace.trace_id
+
+
+def test_pods_runner_normalizes_falsy_tracer(tmp_path):
+    """Regression (review finding): a caller passing tracer=False (the
+    bool(flag) idiom) must get the untraced zero-cost path, not False
+    leaking through the `tracer is not None` gates into `.begin` calls.
+    """
+    import jax
+
+    from tpu_aerial_transport.parallel import pods
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 virtual devices")
+    spec = pods.resolve_pods_spec(4, "1x2", n_devices=2, n_processes=1)
+    mesh = pods.make_pods_mesh(spec)
+
+    def chunk_fn(carry, i0):
+        return carry + i0.astype(carry.dtype), carry[None]
+
+    run = pods.pods_rollout_resumable(
+        chunk_fn, mesh, n_hl_steps=2, n_chunks=2,
+        run_dir=str(tmp_path / "run"), tracer=False,
+    )
+    import numpy as np
+
+    result = run(np.zeros((2, 4), np.float32))
+    assert result.status == "done"
+    assert not os.path.exists(
+        str(tmp_path / "run" / "trace.p0of1.metrics.jsonl")
+    )
+
+
+def test_untraced_server_allocates_no_trace_state():
+    from tpu_aerial_transport.serving import server as server_mod
+    from tpu_aerial_transport.serving.queue import ScenarioRequest
+
+    server = server_mod.ScenarioServer(families=["centralized4"],
+                                       buckets=(8,))
+    t = server.submit(ScenarioRequest(family="centralized4", horizon=2))
+    assert server.tracer is None and t.trace is None
+    assert t.request.trace_id is None  # no ids minted untraced.
+    server.run_until_drained()
+    assert t.status == "completed" and t.trace is None

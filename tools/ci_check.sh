@@ -94,6 +94,21 @@ else
     echo "no artifacts/*.metrics.jsonl — skipped"
 fi
 
+echo "== trace-event json (tools/trace_view.py --validate) =="
+# Distributed-tracing artifacts (ISSUE 15): any emitted Perfetto trace
+# must be well-formed trace-event JSON with per-track monotone,
+# non-overlapping slices and every span's parent present in the file —
+# the structural contract chrome://tracing / ui.perfetto.dev rely on.
+# trace_view loads the span layer by file path (no jax import).
+shopt -s nullglob
+trace_files=(artifacts/*.trace.json)
+shopt -u nullglob
+if [ ${#trace_files[@]} -gt 0 ]; then
+    python tools/trace_view.py --validate "${trace_files[@]}" || fail=1
+else
+    echo "no artifacts/*.trace.json — skipped"
+fi
+
 echo "== black --check =="
 if python -c "import black" 2>/dev/null; then
     python -m black --check --quiet "${PATHS[@]}" || fail=1

@@ -203,6 +203,13 @@ def run_worker(args) -> int:
             runner.chunk_fn, mesh,
             n_hl_steps=args.steps, n_chunks=args.chunks,
             run_dir=args.out_dir, seed=0,
+            # tracer=True: per-process span track (p{pid}ofN) into a
+            # per-process trace jsonl in the shared run dir — the
+            # parent stitches them (tools/trace_view.py machinery)
+            # into ONE Perfetto trace after the pod exits. None (not
+            # False!) when untraced: the chunk driver's zero-cost gate
+            # is `tracer is not None`.
+            tracer=(True if args.trace else None),
         )
         local = _local_resume_carry(args, spec, params, cfg, runner)
         interrupt = None
@@ -341,6 +348,7 @@ def spawn_pod(args, extra_worker_args: list[str] | None = None):
         "--controller", args.controller, "--platform", args.platform,
     ] + (["--mesh", args.mesh] if args.mesh else []) \
       + (["--out-dir", args.out_dir] if args.out_dir else []) \
+      + (["--trace", args.trace] if args.trace else []) \
       + (["--resume"] if args.resume else []) \
       + ([] if args.masked else ["--no-masked"]) \
       + (["--stop-after-chunk", str(args.stop_after_chunk)]
@@ -433,6 +441,13 @@ def main() -> int:
                     choices=["cadmm", "dd"])
     ap.add_argument("--platform", default="cpu")
     ap.add_argument("--out-dir", default="")
+    ap.add_argument("--trace", default="",
+                    help="resume mode: write a stitched cross-process "
+                         "Chrome/Perfetto trace to this path (each "
+                         "worker records spans on its own p{pid}ofN "
+                         "track into the shared run dir; the parent "
+                         "aligns the per-process monotonic clocks and "
+                         "emits ONE trace)")
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--resume", action="store_true",
                     help="resume mode: continue a preempted run_dir")
@@ -465,14 +480,42 @@ def main() -> int:
 
     if args.mode == "parity" and args.check_parity:
         return check_parity(args)
+    if args.trace and (args.mode != "resume" or not args.out_dir):
+        raise SystemExit("--trace needs --mode resume and --out-dir "
+                         "(the traced chunk driver + the shared run dir "
+                         "the stitcher reads)")
     result, rc, tail = spawn_pod(args)
     if rc:
         print(json.dumps({
             "error": tail, "rc": rc, "mode": args.mode,
         }), flush=True)
         return rc
+    if args.trace:
+        result["trace"] = stitch_trace(args.out_dir, args.trace)
     print(json.dumps(result), flush=True)
     return 0
+
+
+def stitch_trace(run_dir: str, out_path: str) -> dict:
+    """Parent-side stitch: every worker's per-process trace jsonl in the
+    shared run dir onto one clock, emitted as Perfetto trace JSON. The
+    shard manifest in the run dir names how many process tracks make the
+    trace complete — a partial stitch (including ZERO spans from
+    deadline-killed workers) raises rather than publishing a trace that
+    silently dropped a worker. The span layer comes via trace_view's
+    by-path loader — ONE copy of that loading discipline."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import trace_view
+
+    trace_mod = trace_view.trace_mod
+    rows = trace_mod.stitch_run_dir(run_dir)
+    obj = trace_mod.write_chrome_trace(out_path, rows)
+    return {
+        "path": out_path,
+        "spans": len(rows),
+        "tracks": sorted({r.get("track") for r in rows}),
+        "events": len(obj["traceEvents"]),
+    }
 
 
 # Parity bar: the two topologies run the SAME program over the SAME mesh

@@ -28,6 +28,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 from tpu_aerial_transport.obs import export as export_mod  # noqa: E402
+from tpu_aerial_transport.obs import trace as trace_lib  # noqa: E402
 
 RUNG_LABELS = ("0 clean", "1 retry", "2 hold", "3 equilibrium")
 
@@ -142,13 +143,27 @@ def summarize(events: list[dict]) -> dict:
         }
 
     # Serving tier (schema v4): request/batch lifecycle from serving/.
+    # DEDUP RULE (the topology-table rule below, request-side): metrics
+    # files APPEND across --resume / re-measured runs, so the same
+    # request_id can carry several terminal events and the same
+    # (batch_id, chunk) several boundaries — aggregate per identity with
+    # the LAST event winning, or re-runs skew every percentile row.
     sevents = [e for e in events if e.get("event") == "serving_event"]
     if sevents:
         kinds: dict[str, int] = {}
         for e in sevents:
             k = e.get("kind", "?")
             kinds[k] = kinds.get(k, 0) + 1
-        completed = [e for e in sevents if e.get("kind") == "completed"]
+        # Terminal outcome per request: last completed/rejected/
+        # deadline_missed event wins (a resume legitimately re-resolves
+        # a restored request; only its final resolution counts).
+        terminal: dict[str, dict] = {}
+        for e in sevents:
+            if e.get("kind") in ("completed", "rejected",
+                                 "deadline_missed"):
+                terminal[e.get("request_id", "?")] = e
+        completed = [e for e in terminal.values()
+                     if e.get("kind") == "completed"]
         lat = [e["slo"]["latency_s"] for e in completed
                if isinstance(e.get("slo"), dict)
                and "latency_s" in e["slo"]]
@@ -156,16 +171,21 @@ def summarize(events: list[dict]) -> dict:
                if isinstance(e.get("slo"), dict)
                and "admit_to_complete_s" in e["slo"]]
         rejections: dict[str, int] = {}
-        for e in sevents:
+        for e in terminal.values():
             if e.get("kind") == "rejected":
                 r = e.get("reason", "?")
                 rejections[r] = rejections.get(r, 0) + 1
         misses: dict[str, int] = {}
-        for e in sevents:
+        for e in terminal.values():
             if e.get("kind") == "deadline_missed":
                 m = e.get("missed", "?")
                 misses[m] = misses.get(m, 0) + 1
-        bounds = [e for e in sevents if e.get("kind") == "batch_boundary"]
+        # One boundary per (batch_id, chunk), last wins.
+        bound_by_id: dict[tuple, dict] = {}
+        for e in sevents:
+            if e.get("kind") == "batch_boundary":
+                bound_by_id[(e.get("batch_id"), e.get("chunk"))] = e
+        bounds = list(bound_by_id.values())
         occ = [e["occupancy"] for e in bounds
                if isinstance(e.get("occupancy"), (int, float))]
         batches: dict = {}
@@ -192,6 +212,18 @@ def summarize(events: list[dict]) -> dict:
             "mean_occupancy": (sum(occ) / len(occ)) if occ else None,
             "batches": batches,
         }
+
+    # Critical path (schema v5, obs.trace): decompose each traced
+    # request's submit→complete interval into queue-wait / batch-wait /
+    # device / harvest / retry segments — "why did p99 regress" as a
+    # table instead of an archaeology session. Re-measured requests in
+    # an append-mode file dedup per request_id (last request span wins,
+    # inside critical_path).
+    trows = trace_lib.trace_rows(events)
+    if trows:
+        cp = trace_lib.critical_path(trace_lib.stitch(trows))
+        if cp["requests"]:
+            out["critical_path"] = cp
 
     # Topology (pods tier): per-cell process/device counts + mesh shapes
     # (plain additive bench_cell value fields, _annotate_topology),
@@ -479,6 +511,28 @@ def render(summary: dict) -> None:
                 print(f"| {bid} | {b['family']} | "
                       f"{b['bucket'] if b['bucket'] is not None else '—'} "
                       f"| {rungs} |")
+
+    cp = summary.get("critical_path")
+    if cp:
+        print("\n## critical path (distributed tracing, obs.trace)")
+        print(f"- traced requests: {len(cp['requests'])} "
+              f"({cp['completed']} completed)")
+        if cp["per_segment"]:
+            print("\n| segment | p50 s | p99 s | mean s | total s |")
+            print("|---|---|---|---|---|")
+            for seg in trace_lib.SEGMENTS:
+                st = cp["per_segment"].get(seg)
+                if st is None:
+                    continue
+                print(f"| {seg} | {_fmt(st['p50'])} | {_fmt(st['p99'])} "
+                      f"| {_fmt(st['mean'])} | {_fmt(st['total'])} |")
+        w = cp.get("worst")
+        if w:
+            segs = ", ".join(
+                f"{k}={_fmt(v)}" for k, v in w["segments"].items() if v
+            )
+            print(f"- worst request: {w['request_id']} "
+                  f"(total {_fmt(w['total_s'])} s: {segs})")
 
     tp = summary.get("topology")
     if tp:

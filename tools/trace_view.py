@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Trace viewer/exporter: stitch ``trace_event`` rows into one
+Chrome/Perfetto trace, and validate emitted trace files (the ci gate).
+
+Thin CLI over ``tpu_aerial_transport/obs/trace.py`` (the span layer,
+stitcher, Chrome converter, and critical-path accountant all live
+there — loaded by file path so this tool never imports jax).
+
+Usage:
+  # One or more metrics jsonl files, or run DIRECTORIES (every *.jsonl
+  # inside is read; a pods run dir's shard manifest names how many
+  # process tracks make the trace complete):
+  python tools/trace_view.py RUN_DIR_OR_JSONL... --out out.trace.json
+
+  # Critical-path accounting (per-request queue/batch/device/harvest/
+  # retry segments) as JSON:
+  python tools/trace_view.py RUN.metrics.jsonl --critical-path
+
+  # CI gate: structural validation of emitted trace files (well-formed
+  # trace-event JSON, per-track monotone non-overlapping slices, every
+  # span's parent present); exit 1 on any violation:
+  python tools/trace_view.py --validate artifacts/*.trace.json
+
+Load the emitted file at https://ui.perfetto.dev (or chrome://tracing):
+one process row per track (server process / pods process), one thread
+row per span name.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# By-path load (the resilience.backend discipline): the span layer is
+# stdlib-only, and importing it as a package submodule would execute
+# tpu_aerial_transport.obs.__init__ — which pulls jax. A trace viewer
+# must work on hosts where importing jax is the hazard being traced.
+_spec = importlib.util.spec_from_file_location(
+    "tat_obs_trace",
+    os.path.join(_REPO, "tpu_aerial_transport", "obs", "trace.py"),
+)
+trace_mod = importlib.util.module_from_spec(_spec)
+# Registered BEFORE exec: dataclass processing under `from __future__
+# import annotations` resolves the defining module via sys.modules.
+sys.modules["tat_obs_trace"] = trace_mod
+_spec.loader.exec_module(trace_mod)
+
+
+def collect_rows(paths: list[str]) -> list[dict]:
+    """Stitched trace rows from a mix of jsonl files and run dirs."""
+    rows: list[dict] = []
+    for path in paths:
+        if os.path.isdir(path):
+            rows.extend(trace_mod.stitch_run_dir(path))
+        else:
+            rows.extend(
+                trace_mod.trace_rows(trace_mod._read_jsonl(path))
+            )
+    # stitch() is idempotent on already-stitched rows (the t0/t1 fields
+    # are recomputed from the same anchors), so one final pass unifies
+    # the mixed-source case.
+    return trace_mod.stitch(rows)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="+",
+                    metavar="RUN_DIR_OR_JSONL_OR_TRACE")
+    ap.add_argument("--out", default="",
+                    help="write Chrome/Perfetto trace-event JSON here")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="print the per-request critical-path "
+                         "decomposition as JSON")
+    ap.add_argument("--validate", action="store_true",
+                    help="paths are emitted *.trace.json files: "
+                         "structural validation only (ci gate), exit 1 "
+                         "on any violation")
+    args = ap.parse_args()
+
+    if args.validate:
+        failed = False
+        for path in args.paths:
+            errs = trace_mod.validate_trace_file(path)
+            if errs:
+                failed = True
+                print(f"{path}: {len(errs)} violation(s)",
+                      file=sys.stderr)
+                for e in errs[:20]:
+                    print(f"  {e}", file=sys.stderr)
+            else:
+                print(f"{path}: OK")
+        return 1 if failed else 0
+
+    rows = collect_rows(args.paths)
+    if not rows:
+        print("no trace_event rows found (tracing off, or wrong files?)",
+              file=sys.stderr)
+        return 1
+    summary = {
+        "rows": len(rows),
+        "tracks": sorted({r.get("track", "?") for r in rows}),
+        "traces": len({r["trace_id"] for r in rows}),
+    }
+    if args.out:
+        obj = trace_mod.write_chrome_trace(args.out, rows)
+        errs = trace_mod.validate_chrome_trace(obj)
+        if errs:  # never publish a trace the ci gate would reject.
+            for e in errs[:20]:
+                print(f"  {e}", file=sys.stderr)
+            return 1
+        summary["out"] = args.out
+        summary["events"] = len(obj["traceEvents"])
+    if args.critical_path:
+        summary["critical_path"] = trace_mod.critical_path(rows)
+    print(json.dumps(summary, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

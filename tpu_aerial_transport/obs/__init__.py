@@ -16,6 +16,15 @@ Three parts (ISSUE 5):
   (chunk boundaries via ``resilience.recovery.run_chunks``, bench sweep
   cells, on-demand rollout summaries), rendered by
   ``tools/run_health.py``.
+- :mod:`obs.trace` (ISSUE 15) — host-side distributed request tracing:
+  spans with trace/span/parent ids stitched from admission to device
+  across serving, recovery, and pods; exported as additive
+  ``trace_event`` metrics rows and as Chrome/Perfetto trace JSON
+  (``tools/trace_view.py``), with a critical-path accountant
+  decomposing each request's latency into queue/batch/device/harvest/
+  retry segments. Deliberately NOT imported here: it is stdlib-only and
+  must stay loadable from tools on hosts where importing jax (which
+  ``obs.export`` pulls transitively) is the hazard being traced.
 """
 
 from tpu_aerial_transport.obs import export, phases, telemetry  # noqa: F401

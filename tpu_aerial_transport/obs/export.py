@@ -34,10 +34,15 @@ from tpu_aerial_transport.obs import telemetry as telemetry_mod
 # continuous-batching scenario-serving tier's request/batch lifecycle:
 # admission, rejection-with-reason, SLO timestamps, deadline-miss
 # classification, per-boundary batch occupancy + rung — ``serving/``).
+# v5: adds the ``trace_event`` type (distributed-tracing span rows from
+# ``obs.trace.Tracer`` — request/queue/batch/device/guard/chunk spans
+# with trace/span/parent ids, per-process track, and BOTH monotonic and
+# wall-epoch timestamp pairs so ``tools/trace_view.py`` can stitch
+# multi-process runs onto one clock).
 # Files written at older versions remain valid (see
 # :data:`SUPPORTED_SCHEMAS`) — each bump only ADDS vocabulary.
-SCHEMA_VERSION = 4
-SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4})
+SCHEMA_VERSION = 5
+SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4, 5})
 
 # Event vocabulary -> required fields (beyond schema/event/ts). The
 # validator rejects unknown event types and missing fields; extra fields
@@ -59,6 +64,11 @@ EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     # fields are schema-legal — the reader contract is per-kind, rendered
     # by tools/run_health.py's serving SLO section).
     "serving_event": ("kind",),
+    # One finished span (obs.trace.Span.to_row()): t1_* present for
+    # spans, absent for instants; parent_id/attrs optional; track is the
+    # per-process timeline the stitcher groups by.
+    "trace_event": ("name", "trace_id", "span_id", "track",
+                    "t0_mono", "t0_wall"),
 }
 
 # Events that did not exist before a given schema version: an event of
@@ -68,6 +78,7 @@ EVENT_MIN_SCHEMA: dict[str, int] = {
     "backend_event": 2,
     "aot_serve": 3,
     "serving_event": 4,
+    "trace_event": 5,
 }
 
 

@@ -759,6 +759,7 @@ def pods_rollout_resumable(
     max_retries: int = 1,
     meta: dict | None = None,
     metrics=None,
+    tracer=None,
 ):
     """Preemption-safe pods twin of
     ``parallel.mesh.scenario_rollout_resumable``: the vmapped chunk runs
@@ -779,6 +780,14 @@ def pods_rollout_resumable(
     PROCESS-LOCAL host slabs (leading axis = this process's scenario
     rows); ``RunResult.logs`` holds the local block of the concatenated
     chunk logs.
+
+    ``tracer`` (an ``obs.trace.Tracer`` or ``True`` to build one wired
+    to this process's metrics sink) turns on distributed tracing through
+    the chunk driver: each process records its run/chunk/snapshot/resume
+    spans on its OWN track (``p{pid}of{N}`` — the same grammar as the
+    shard prefixes), and ``tools/trace_view.py`` stitches the per-process
+    monotonic clock domains into one trace through this run dir's shard
+    manifest. ``tracer=None`` stays zero-cost.
     """
     from tpu_aerial_transport.harness import checkpoint
     from tpu_aerial_transport.resilience import recovery
@@ -829,6 +838,27 @@ def pods_rollout_resumable(
         ),
         journal_filename=f"journal.p{pid}of{spec.n_processes}.jsonl",
     )
+
+    if tracer is True:
+        # Convenience wiring: one tracer per process, rows into a
+        # per-process metrics jsonl inside the shared run dir (the files
+        # trace_view's stitcher globs), track named by the same
+        # p{pid}ofN grammar as the shard prefixes.
+        from tpu_aerial_transport.obs import export as export_mod
+        from tpu_aerial_transport.obs import trace as trace_lib
+
+        track = f"p{pid}of{spec.n_processes}"
+        tracer = trace_lib.Tracer(
+            export_mod.MetricsWriter(
+                os.path.join(run_dir, f"trace.{track}.metrics.jsonl")
+            ),
+            track=track,
+        )
+    elif not tracer:
+        # Normalize falsy (False from a bool(flag) caller) to None: the
+        # chunk driver's zero-cost gate is `tracer is not None`, and
+        # False reaching it would crash at the first span.
+        tracer = None
 
     def place(local_carry):
         return place_local_batch(mesh, local_carry)
@@ -911,12 +941,13 @@ def pods_rollout_resumable(
                 max_retries=max_retries, metrics=metrics,
                 journal_filename=plan.journal_filename,
                 to_host=local_host_shard, max_start_chunk=cap,
+                tracer=tracer,
             )
         _publish_manifest()
         return recovery.run_chunks(
             plan, chunk_jit, local_carry, interrupt=interrupt,
             place=place, max_retries=max_retries, metrics=metrics,
-            to_host=local_host_shard,
+            to_host=local_host_shard, tracer=tracer,
         )
 
     run.batched_jit = batched_jit

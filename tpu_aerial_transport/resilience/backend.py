@@ -684,6 +684,7 @@ class BackendGuard:
                  faults: FaultInjector | None = None,
                  metrics=None,
                  journal=None,
+                 tracer=None,
                  primary_rung: str | None = None,
                  clock=time.monotonic):
         self.deadline_s = (default_deadline_s() if deadline_s is None
@@ -693,6 +694,12 @@ class BackendGuard:
             else FaultInjector.from_env()
         self.metrics = metrics
         self.journal = journal
+        # Distributed tracing (obs.trace.Tracer, duck-typed begin/end so
+        # this module stays importable by file path with no package
+        # import): run() wraps the primary in a "guard_dispatch" span and
+        # any degradation in a "guard_fallback" span — rung + classified
+        # BackendError kind as span attributes. None = zero-cost off.
+        self.tracer = tracer
         self._primary_rung = primary_rung
         self._clock = clock
         self.events: list[dict] = []
@@ -736,9 +743,30 @@ class BackendGuard:
         for t in new:
             self.emit("circuit_" + t["to"], label, reason=t["reason"])
 
+    def _run_fallback(self, fallback_fn, label: str, trace_parent,
+                      **attrs):
+        """Run the CPU fallback, wrapped in a "guard_fallback" span when
+        tracing (the critical-path accountant's "retry" segment)."""
+        if self.tracer is None:
+            return fallback_fn()
+        fspan = self.tracer.begin(
+            "guard_fallback", parent=trace_parent, label=label,
+            rung=RUNG_CPU, **attrs,
+        )
+        try:
+            return fallback_fn()
+        finally:
+            self.tracer.end(fspan)
+
     def run(self, label: str, primary_fn, fallback_fn=None, *,
-            rung: str | None = None, deadline_s: float | None = None):
-        """Execute one unit. Returns ``(value, rung_it_ran_at)``."""
+            rung: str | None = None, deadline_s: float | None = None,
+            trace_parent=None):
+        """Execute one unit. Returns ``(value, rung_it_ran_at)``.
+
+        ``trace_parent`` (an ``obs.trace.Span`` or None) parents the
+        guard's spans under the caller's dispatch span — the serving
+        tier passes its ``chunk_dispatch`` span, the chunk driver its
+        ``chunk`` span."""
         deadline = self.deadline_s if deadline_s is None else deadline_s
         self.last_fell_back = False
         allowed = self.breaker.allow()
@@ -757,8 +785,15 @@ class BackendGuard:
                         "half-open"),
             )
             self.last_fell_back = True
-            return fallback_fn(), RUNG_CPU
+            return self._run_fallback(
+                fallback_fn, label, trace_parent, circuit="open",
+            ), RUNG_CPU
 
+        gspan = None
+        if self.tracer is not None:
+            gspan = self.tracer.begin(
+                "guard_dispatch", parent=trace_parent, label=label,
+            )
         try:
             def _primary():
                 self.faults.maybe_fault(label)
@@ -773,6 +808,14 @@ class BackendGuard:
             )
         except Exception as e:  # noqa: BLE001 — classification decides.
             kind = classify(e)
+            if gspan is not None:
+                # The classified kind + the rung that failed are the span
+                # attributes the trace reader keys on.
+                self.tracer.end(
+                    gspan, kind=kind,
+                    rung=rung or self._primary_rung or "unresolved",
+                    detail=f"{type(e).__name__}: {e}"[:160],
+                )
             if kind == "unknown":
                 raise  # a code bug; degrading would only hide it.
             if kind in BREAKER_KINDS:
@@ -790,9 +833,13 @@ class BackendGuard:
                 raise BackendError(kind, f"{type(e).__name__}: {e}"[:300]) \
                     from e
             self.last_fell_back = True
-            return fallback_fn(), RUNG_CPU
+            return self._run_fallback(
+                fallback_fn, label, trace_parent, after=kind,
+            ), RUNG_CPU
         self.breaker.record_success()
         self._emit_transitions(label)
+        if gspan is not None:
+            self.tracer.end(gspan, rung=primary_rung)
         return value, primary_rung
 
 

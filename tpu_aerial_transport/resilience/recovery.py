@@ -53,6 +53,7 @@ from tpu_aerial_transport.harness.rollout import (
 )
 from tpu_aerial_transport.obs import export as export_mod
 from tpu_aerial_transport.obs import telemetry as telemetry_mod
+from tpu_aerial_transport.obs import trace as trace_mod
 from tpu_aerial_transport.resilience import backend as backend_mod
 
 JOURNAL_SCHEMA = 1
@@ -230,6 +231,8 @@ def run_chunks(
     metrics: "export_mod.MetricsWriter | str | None" = None,
     guard: "backend_mod.BackendGuard | None" = None,
     to_host=None,
+    tracer: "trace_mod.Tracer | None" = None,
+    trace_parent=None,
 ) -> RunResult:
     """Drive ``chunk_jit(carry, i0) -> (carry, logs)`` from ``start_chunk``
     to ``plan.n_chunks``, snapshotting the carry and the chunk's logs at
@@ -272,6 +275,15 @@ def run_chunks(
     chunked-rollout factories) — the cumulative run-health summary; plus
     ``retry``/``preempted``/``done`` events. ``tools/run_health.py``
     renders the file.
+
+    ``tracer`` (optional; an ``obs.trace.Tracer``) turns on distributed
+    tracing: a ``run`` root span, one ``chunk`` span per chunk (child
+    ``snapshot`` span around the boundary publish; the guard's
+    dispatch/fallback spans nest under it), host-level retries as
+    ``retry`` instants, preemption/resume boundaries marked.
+    ``tracer=None`` is the zero-cost path (every site is a host-level
+    ``if``); ``trace_parent`` lets :func:`resume_run` parent the run
+    under its ``resume`` span.
 
     Carry snapshots are pruned to ``plan.keep_last``; per-chunk log
     snapshots are kept for ALL chunks (the full trajectory must be
@@ -317,6 +329,16 @@ def run_chunks(
             guard.journal = journal
         if guard.metrics is None:
             guard.metrics = metrics
+        if guard.tracer is None:
+            guard.tracer = tracer
+    run_span = None
+    if tracer is not None:
+        run_span = tracer.begin(
+            trace_mod.RUN, parent=trace_parent, run_dir=plan.run_dir,
+            start_chunk=start_chunk, n_chunks=plan.n_chunks,
+            **({"resumed_from": resumed_from_chunk}
+               if resumed_from_chunk is not None else {}),
+        )
     rung: str | None = None
     degraded = False  # one-way: a guard fallback pins the run to CPU.
 
@@ -344,6 +366,10 @@ def run_chunks(
                 metrics.emit(
                     "preempted", chunk=c, signal=interrupt.triggered
                 )
+            if tracer is not None:
+                tracer.instant("preempted", parent=run_span, chunk=c,
+                               signal=interrupt.triggered)
+                tracer.end(run_span, status="preempted", chunks_done=c)
             return RunResult(
                 carry=carry,
                 logs=(concat_chunk_logs(logs_chunks, plan.logs_time_axis)
@@ -352,6 +378,9 @@ def run_chunks(
                 resumed_from_chunk=resumed_from_chunk,
                 retries=retries_total,
             )
+        cspan = sspan = None
+        if tracer is not None:
+            cspan = tracer.begin(trace_mod.CHUNK, parent=run_span, chunk=c)
         try:
             t0 = time.perf_counter()
             offset = chunk_index_offset(c, plan.chunk_len)
@@ -392,9 +421,13 @@ def run_chunks(
                             else lambda: _exec(_cpu_place(carry_host)))
                 (new_carry, logs, new_carry_host), rung = guard.run(
                     f"chunk{c}", lambda: _exec(carry), fallback_fn=fallback,
+                    trace_parent=cspan,
                 )
                 degraded = guard.last_fell_back
             wall_s = time.perf_counter() - t0  # host copy = device sync.
+            if tracer is not None:
+                sspan = tracer.begin(trace_mod.SNAPSHOT, parent=cspan,
+                                     chunk=c)
             checkpoint.save_snapshot(
                 plan.run_dir, c, new_carry_host, prefix=plan.carry_prefix,
                 config_hash=plan.config_hash, keep_last=plan.keep_last,
@@ -405,12 +438,28 @@ def run_chunks(
                 config_hash=plan.config_hash, keep_last=0,
                 meta={"chunk": c},
             )
+            if tracer is not None:
+                tracer.end(sspan)
         except checkpoint.SnapshotError:
+            if tracer is not None:
+                # The span recording the FAILING publish must survive —
+                # the server's harvest-span rule (ended before its chunk
+                # parent so the trace stays well-ordered).
+                if sspan is not None:
+                    tracer.end(sspan, error="snapshot")
+                tracer.end(cspan, error="snapshot")
+                tracer.end(run_span, status="error")
             raise  # a disk-integrity problem; retrying the chunk won't help.
         except Exception as e:  # noqa: BLE001 — device errors have no
             # common base class across backends (XlaRuntimeError,
             # RuntimeError, ValueError from a poisoned transfer...).
+            if tracer is not None:
+                if sspan is not None and not sspan.ended:
+                    tracer.end(sspan, error=f"{type(e).__name__}"[:80])
+                tracer.end(cspan, error=f"{type(e).__name__}: {e}"[:160])
             if attempt >= max_retries:
+                if tracer is not None:
+                    tracer.end(run_span, status="error")
                 raise
             attempt += 1
             retries_total += 1
@@ -423,9 +472,15 @@ def run_chunks(
                     "retry", chunk=c, attempt=attempt,
                     error=f"{type(e).__name__}: {e}"[:300],
                 )
+            if tracer is not None:
+                tracer.instant(trace_mod.RETRY, parent=run_span, chunk=c,
+                               attempt=attempt)
             carry = jax.tree.map(jnp.asarray, carry_host)
             carry = place(carry) if place is not None else carry
             continue
+        if tracer is not None:
+            tracer.end(cspan, **({"rung": rung} if rung is not None
+                                 else {}))
         journal.append({
             "event": "chunk", "chunk": c,
             "step_end": (c + 1) * plan.chunk_len,
@@ -456,6 +511,8 @@ def run_chunks(
     journal.append({"event": "done", "chunks": plan.n_chunks})
     if metrics is not None:
         metrics.emit("done", chunks=plan.n_chunks)
+    if tracer is not None:
+        tracer.end(run_span, status="done", chunks=plan.n_chunks)
     return RunResult(
         carry=carry,
         logs=(concat_chunk_logs(logs_chunks, plan.logs_time_axis)
@@ -493,6 +550,7 @@ def resume_run(
     journal_filename: str | None = None,
     to_host=None,
     max_start_chunk: int | None = None,
+    tracer: "trace_mod.Tracer | None" = None,
 ) -> RunResult:
     """Resume a journaled run from its newest fully-valid boundary.
 
@@ -538,6 +596,14 @@ def resume_run(
         chunk_jit, initial_carry, chunk_index_offset(0, plan.chunk_len)
     )
 
+    # The resume boundary as a span: the walk over candidate snapshots
+    # is real recovery time, and the post-resume run's spans parent
+    # under it so "what happened at the resume boundary" reads straight
+    # off the trace.
+    rspan = None
+    if tracer is not None:
+        rspan = tracer.begin(trace_mod.RESUME, parent=None,
+                             run_dir=run_dir)
     skipped: list[str] = []
     start_chunk = 0
     carry = initial_carry
@@ -581,9 +647,13 @@ def resume_run(
         metrics.emit(
             "resume", start_chunk=start_chunk, skipped=skipped[:8]
         )
+    if tracer is not None:
+        tracer.end(rspan, start_chunk=start_chunk,
+                   skipped=len(skipped))
     return run_chunks(
         plan, chunk_jit, carry, start_chunk=start_chunk,
         prior_logs=prior_logs, interrupt=interrupt, place=place,
         max_retries=max_retries, resumed_from_chunk=start_chunk,
         metrics=metrics, guard=guard, to_host=to_host,
+        tracer=tracer, trace_parent=rspan,
     )

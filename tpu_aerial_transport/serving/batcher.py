@@ -366,6 +366,9 @@ class Batch:
         ticket.batch_id = self.batch_id
         ticket.lane = lane
         ticket.slo.t_admit = self.clock()
+        if ticket.trace is not None:
+            ticket.trace.admitted(batch_id=self.batch_id, lane=lane,
+                                  bucket=self.bucket)
         self.emit(kind="admitted", request_id=req.request_id,
                   family=self.family.name, batch_id=self.batch_id,
                   lane=lane, bucket=self.bucket)
@@ -381,6 +384,9 @@ class Batch:
         ticket.batch_id = self.batch_id
         ticket.lane = lane
         ticket.slo.t_admit = self.clock()
+        if ticket.trace is not None:
+            ticket.trace.admitted(batch_id=self.batch_id, lane=lane,
+                                  bucket=self.bucket, restored=True)
         self.emit(kind="admitted", request_id=ticket.request.request_id,
                   family=self.family.name, batch_id=self.batch_id,
                   lane=lane, bucket=self.bucket, restored=True)
@@ -423,6 +429,11 @@ class Batch:
                           batch_id=self.batch_id,
                           missed=queue_mod.MISSED_IN_FLIGHT,
                           slo=slo.to_event())
+                if ticket.trace is not None:
+                    ticket.trace.resolve(
+                        queue_mod.DEADLINE_MISSED,
+                        missed=queue_mod.MISSED_IN_FLIGHT,
+                    )
             else:
                 ticket._resolve(queue_mod.COMPLETED)
                 self.emit(kind="completed",
@@ -431,6 +442,9 @@ class Batch:
                           batch_id=self.batch_id,
                           steps=ticket.steps_served,
                           slo=slo.to_event())
+                if ticket.trace is not None:
+                    ticket.trace.resolve(queue_mod.COMPLETED,
+                                         steps=ticket.steps_served)
             self.tickets[lane] = None
             finished.append(ticket)
         return finished
@@ -439,6 +453,18 @@ class Batch:
         """Journal form of the lane map (resume reads it back)."""
         return [
             [lane, t.request.request_id, int(self.remaining[lane])]
+            for lane, t in enumerate(self.tickets) if t is not None
+        ]
+
+    def lane_map(self) -> list[list]:
+        """Trace form of the lane map: ``[[lane, request_id, trace_id],
+        ...]`` — the attribute that links every member request's trace
+        to the batch's shared device spans (obs.trace critical-path
+        accounting keys on the trace ids)."""
+        return [
+            [lane, t.request.request_id,
+             t.trace.trace_id if t.trace is not None
+             else t.request.trace_id]
             for lane, t in enumerate(self.tickets) if t is not None
         ]
 

@@ -31,6 +31,8 @@ import time
 
 import numpy as np
 
+from tpu_aerial_transport.obs import trace as trace_mod
+
 # Statuses a ticket resolves to.
 PENDING = "pending"
 COMPLETED = "completed"
@@ -68,6 +70,11 @@ class ScenarioRequest:
     request_id: str = dataclasses.field(
         default_factory=lambda: f"req{next(_req_counter):06d}"
     )
+    # Distributed-tracing context (obs.trace): clients propagating an
+    # upstream trace set it; otherwise admission mints one when the
+    # server runs a tracer. Journaled with the request so a resumed
+    # run's spans land on the SAME trace as the preempted run's.
+    trace_id: str | None = None
 
     def to_json(self) -> dict:
         return {
@@ -78,6 +85,7 @@ class ScenarioRequest:
             "v0": [float(v) for v in np.asarray(self.v0).reshape(-1)],
             "deadline_s": (None if self.deadline_s is None
                            else float(self.deadline_s)),
+            **({"trace_id": self.trace_id} if self.trace_id else {}),
         }
 
     @classmethod
@@ -87,6 +95,7 @@ class ScenarioRequest:
             x0=tuple(obj["x0"]), v0=tuple(obj["v0"]),
             deadline_s=obj.get("deadline_s"),
             request_id=obj["request_id"],
+            trace_id=obj.get("trace_id"),
         )
 
 
@@ -128,6 +137,9 @@ class Ticket:
         self.steps_served = 0
         self.batch_id: int | None = None
         self.lane: int | None = None
+        # obs.trace.RequestTrace when the server runs a tracer; None is
+        # the zero-cost path (every consumer guards on it).
+        self.trace: trace_mod.RequestTrace | None = None
         self._done = threading.Event()
 
     @property
@@ -157,13 +169,14 @@ class AdmissionQueue:
     is the server's ``serving_event`` sink (may be None)."""
 
     def __init__(self, coverage, capacity: int = 256,
-                 clock=time.monotonic, emit=None):
+                 clock=time.monotonic, emit=None, tracer=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.coverage = coverage
         self.capacity = capacity
         self.clock = clock
         self.emit = emit or (lambda **kw: None)
+        self.tracer = tracer  # obs.trace.Tracer | None (zero-cost off).
         self._pending: dict[str, list[Ticket]] = {}  # family -> FIFO.
 
     # ------------------------------------------------------ admission --
@@ -171,11 +184,25 @@ class AdmissionQueue:
         """Admit or reject one request. ALWAYS returns a resolved-or-
         pending ticket (rejection is a structured status + reason +
         ``serving_event``, never an exception)."""
+        if self.tracer is not None and request.trace_id is None:
+            # Mint the trace context ON the request so journal replays /
+            # resumes keep the same trace identity.
+            request = dataclasses.replace(
+                request, trace_id=trace_mod.new_trace_id()
+            )
         ticket = Ticket(request)
         now = self.clock()
         ticket.slo.t_submit = now
         if request.deadline_s is not None:
             ticket.slo.deadline_at = now + float(request.deadline_s)
+        if self.tracer is not None:
+            root = self.tracer.begin(
+                trace_mod.REQUEST, parent=None,
+                trace_id=request.trace_id,
+                request_id=request.request_id, family=request.family,
+                horizon=int(request.horizon),
+            )
+            ticket.trace = trace_mod.RequestTrace(self.tracer, root)
 
         reason = self._admission_reason(request, now)
         if reason is not None:
@@ -183,8 +210,16 @@ class AdmissionQueue:
             self.emit(kind="rejected", request_id=request.request_id,
                       family=request.family, reason=reason,
                       depth=self.depth())
+            if ticket.trace is not None:
+                # Terminal span: the rejection IS the request's trace.
+                ticket.trace.resolve(REJECTED, reason=reason)
             return ticket
 
+        if ticket.trace is not None:
+            ticket.trace.queue_span = self.tracer.begin(
+                trace_mod.QUEUE_WAIT, parent=ticket.trace.request_span,
+                request_id=request.request_id, family=request.family,
+            )
         self._pending.setdefault(request.family, []).append(ticket)
         self.emit(kind="submitted", request_id=request.request_id,
                   family=request.family, horizon=request.horizon,
@@ -236,6 +271,9 @@ class AdmissionQueue:
                               request_id=t.request.request_id,
                               family=family, missed=MISSED_IN_QUEUE,
                               slo=t.slo.to_event())
+                    if t.trace is not None:
+                        t.trace.resolve(DEADLINE_MISSED,
+                                        missed=MISSED_IN_QUEUE)
                     missed.append(t)
                 else:
                     keep.append(t)

@@ -32,6 +32,7 @@ import time
 import numpy as np
 
 from tpu_aerial_transport.harness import checkpoint
+from tpu_aerial_transport.obs import trace as trace_mod
 from tpu_aerial_transport.serving import batcher as batcher_mod
 from tpu_aerial_transport.serving import queue as queue_mod
 from tpu_aerial_transport.serving.batcher import (
@@ -71,7 +72,7 @@ class ScenarioServer:
                  capacity: int = 256, bundle=None,
                  require_bundle: bool = False, run_dir: str | None = None,
                  metrics=None, guard=None, interrupt=None, mesh=None,
-                 clock=time.monotonic):
+                 tracer=None, clock=time.monotonic):
         from tpu_aerial_transport.obs import export as export_mod
         from tpu_aerial_transport.resilience import backend as backend_mod
         from tpu_aerial_transport.resilience.recovery import RunJournal
@@ -89,7 +90,17 @@ class ScenarioServer:
         if isinstance(metrics, str):
             metrics = export_mod.MetricsWriter(metrics)
         self.metrics = metrics
+        # Distributed tracing (obs.trace): tracer=None is the zero-cost
+        # path — no span objects, no per-request allocation, and (since
+        # tracing is host-only) the compiled HLO is identical either way
+        # (asserted by tests/test_trace.py). Batch/device spans live on
+        # the server's OWN trace; request spans each get their own.
+        self.tracer = tracer
+        self._server_trace = (None if tracer is None
+                              else trace_mod.new_trace_id())
         self.guard = guard or backend_mod.BackendGuard(metrics=metrics)
+        if self.guard.tracer is None:
+            self.guard.tracer = tracer
         self.interrupt = interrupt
         self.preempted = False
         self.run_dir = run_dir
@@ -105,7 +116,7 @@ class ScenarioServer:
 
         self.queue = queue_mod.AdmissionQueue(
             self._coverage, capacity=capacity, clock=clock,
-            emit=self._emit,
+            emit=self._emit, tracer=tracer,
         )
         self.tickets: dict[str, queue_mod.Ticket] = {}
         self.done_requests: set[str] = set()  # filled by resume().
@@ -190,8 +201,13 @@ class ScenarioServer:
         ticket = self.queue.submit(request)
         self.tickets[request.request_id] = ticket
         if ticket.status == queue_mod.PENDING and self.journal is not None:
+            # ticket.request, NOT the caller's argument: admission mints
+            # the trace_id onto a replaced request object, and the
+            # journal must carry it or resume re-mints and the pre/post
+            # spans land on different traces.
             self.journal.append({
-                "event": "serving_request", "request": request.to_json(),
+                "event": "serving_request",
+                "request": ticket.request.to_json(),
             })
         return ticket
 
@@ -248,11 +264,21 @@ class ScenarioServer:
         bucket = batcher_mod.bucket_for(
             self.queue.depth(fam.name), self._family_buckets(fam)
         )
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.begin(
+                trace_mod.BATCH_FORM, parent=None,
+                trace_id=self._server_trace, family=fam.name,
+                bucket=bucket,
+            )
         batch = Batch(fam, bucket, fam.template_carry_host(),
                       self.clock, self._emit)
         self._batches[fam.name] = batch
         for lane, ticket in enumerate(self.queue.take(fam.name, bucket)):
             batch.admit(ticket, lane)
+        if span is not None:
+            self.tracer.end(span, batch_id=batch.batch_id,
+                            lanes=batch.lane_map())
         self._emit(kind="batch_launch", family=fam.name,
                    batch_id=batch.batch_id, bucket=bucket,
                    lanes=batch.active_lanes)
@@ -267,19 +293,55 @@ class ScenarioServer:
 
             carry = mesh_mod.shard_scenarios(self.mesh, carry, "scenario")
         label = f"{fam.name}:b{batch.batch_id}:c{batch.chunks_done}"
-        (out, serve_rung), guard_rung = self._dispatch(
-            fam, (carry, i0), label
-        )
-        new_carry, _logs = out
-        batch.carry_host = self._boundary_host(new_carry)
-        batch.harvest()
-        for lane in batch.free_lanes():
-            late = self.queue.take(fam.name, 1)
-            if not late:
-                break
-            batch.admit(late[0], lane)
-        occupancy = batch.occupancy_samples[-1]
-        self._snapshot_boundary(fam, batch)
+        dspan = None
+        if self.tracer is not None:
+            # The shared device span: the lane map links every member
+            # request's trace to it (the critical-path accountant's
+            # "device" segment).
+            dspan = self.tracer.begin(
+                trace_mod.CHUNK_DISPATCH, parent=None,
+                trace_id=self._server_trace, family=fam.name,
+                batch_id=batch.batch_id, chunk=batch.chunks_done,
+                bucket=batch.bucket, lanes=batch.lane_map(),
+            )
+        try:
+            (out, serve_rung), guard_rung = self._dispatch(
+                fam, (carry, i0), label, trace_parent=dspan
+            )
+            new_carry, _logs = out
+        except BaseException:
+            if dspan is not None:
+                self.tracer.end(dspan, error=True)
+            raise
+        if dspan is not None:
+            self.tracer.end(dspan, rung=serve_rung, guard_rung=guard_rung)
+        hspan = None
+        if self.tracer is not None:
+            hspan = self.tracer.begin(
+                trace_mod.HARVEST, parent=None,
+                trace_id=self._server_trace, family=fam.name,
+                batch_id=batch.batch_id, chunk=batch.chunks_done + 1,
+                lanes=batch.lane_map(),
+            )
+        try:
+            batch.carry_host = self._boundary_host(new_carry)
+            batch.harvest()
+            for lane in batch.free_lanes():
+                late = self.queue.take(fam.name, 1)
+                if not late:
+                    break
+                batch.admit(late[0], lane)
+            occupancy = batch.occupancy_samples[-1]
+            self._snapshot_boundary(fam, batch)
+        except BaseException:
+            # Same rule as dspan: the boundary where something broke
+            # (a SnapshotError from the boundary publish) must not be
+            # the one with no harvest record.
+            if hspan is not None:
+                self.tracer.end(hspan, error=True)
+            raise
+        if hspan is not None:
+            self.tracer.end(hspan)
         self._emit(kind="batch_boundary", family=fam.name,
                    batch_id=batch.batch_id, chunk=batch.chunks_done,
                    occupancy=occupancy, rung=serve_rung,
@@ -304,7 +366,7 @@ class ScenarioServer:
                 return pods.host_global(carry)
         return host_copy(carry)
 
-    def _dispatch(self, fam: Family, args, label: str):
+    def _dispatch(self, fam: Family, args, label: str, trace_parent=None):
         """One guarded chunk through the serve ladder. Returns
         ``((out, serve_rung), guard_rung)``."""
         from tpu_aerial_transport.aot import loader as loader_mod
@@ -325,7 +387,8 @@ class ScenarioServer:
                 None, entry, args, jit_fallback=fam.batched_jit,
                 metrics=self.metrics, label=label + ":cpu",
             ))
-        return self.guard.run(label, primary, fallback_fn=fallback)
+        return self.guard.run(label, primary, fallback_fn=fallback,
+                              trace_parent=trace_parent)
 
     def _snapshot_boundary(self, fam: Family, batch: Batch) -> None:
         if self.journal is None:
@@ -446,6 +509,20 @@ class ScenarioServer:
             batch.chunks_done = e["chunk"]
             for lane, rid, rem in live:
                 ticket = queue_mod.Ticket(requests[rid])
+                if server.tracer is not None:
+                    # Same trace_id as the preempted run (journaled on
+                    # the request): the stitched trace shows pre- and
+                    # post-resume spans on one trace, this root marked
+                    # restored.
+                    root = server.tracer.begin(
+                        trace_mod.REQUEST, parent=None,
+                        trace_id=requests[rid].trace_id,
+                        request_id=rid, family=e["family"],
+                        restored=True,
+                    )
+                    ticket.trace = trace_mod.RequestTrace(
+                        server.tracer, root
+                    )
                 now = server.clock()
                 ticket.slo.t_submit = now
                 if requests[rid].deadline_s is not None:
