@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Serving-fleet demo: a seeded mixed-tenant request stream through N
+replica ``ScenarioServer`` processes behind the consistent-hash
+admission front (``tpu_aerial_transport/serving/fleet.py``), with an
+optional chaos storm layered on top.
+
+This is a thin, opinionated wrapper over ``tools/fleet_local.py`` — the
+harness owns the process discipline (own-session workers, group kills,
+parent-pid watchdogs, fsync'd jsonl channels); the demo picks a
+believable multi-tenant workload and narrates the outcome:
+
+- three tenants with different admission contracts — ``pro`` (high
+  weight, priority), ``free`` (rate-limited token bucket), ``batch``
+  (best-effort) — so the weighted-fair dequeue and structured
+  ``tenant_rate_limited`` rejections are visible in one run;
+- ``--chaos`` arms a seeded :class:`FleetFaultPlan` (SIGKILL a replica
+  mid-batch, wedge another) and the summary shows the supervisor's
+  ``up -> down -> restarting -> up`` transitions, the failover count,
+  and — with ``--trace`` — the explicit ``retry`` segment on each
+  failed-over request's ORIGINAL trace_id in the stitched Perfetto
+  trace;
+- every completed request reports a result digest, so a chaos run can
+  be diffed bit-for-bit against a fault-free run of the same seed.
+
+Usage:
+  python examples/serve_fleet.py --replicas 2 --requests 12
+  python examples/serve_fleet.py --replicas 2 --chaos --trace
+  python examples/serve_fleet.py --replicas 3 --chaos --seed 7 \\
+      --trace --out-dir artifacts/fleet-demo
+
+On a 1-core host multi-replica runs skip with a written reason (the
+harness prints the skip JSON); pass ``--force-multi`` to override.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import fleet_local  # noqa: E402  (tools/fleet_local.py)
+
+DEMO_TENANTS = "pro:weight=4,priority=1;free:rate=2,burst=3;batch:weight=1"
+
+
+def main(argv=None) -> int:
+    parser = fleet_local.build_parser()
+    parser.description = __doc__
+    parser.set_defaults(
+        requests=12,
+        tenants=DEMO_TENANTS,
+        out_dir="artifacts/fleet-demo",
+        poisson_rate=4.0,
+        # Spread the seeded storm wide enough to land after replica
+        # boot (faults sent while a worker is still replaying its inbox
+        # are live-only and dropped — a storm over 0..4s would miss).
+        chaos_span=12.0,
+    )
+    # The demo accepts bare ``--chaos`` (arm a seeded storm) and bare
+    # ``--trace`` (auto-pathed Perfetto output); the harness parser
+    # takes explicit values for both, so backfill placeholders before
+    # parsing. Explicit values (``--chaos sigkill@2:r0``) pass through.
+    argv = list(sys.argv[1:] if argv is None else argv)
+    for flag, placeholder in (("--chaos", "seeded"), ("--trace", "auto")):
+        if flag in argv:
+            i = argv.index(flag)
+            if i + 1 == len(argv) or argv[i + 1].startswith("-"):
+                argv.insert(i + 1, placeholder)
+    args = parser.parse_args(argv)
+    if args.chaos == "seeded":
+        args.chaos = f"seeded:{args.seed}"
+    if args.trace == "auto":
+        args.trace = os.path.join(args.out_dir, "fleet.trace.json")
+
+    if (os.cpu_count() or 1) < 2 and args.replicas > 1 \
+            and not args.force_multi:
+        print(json.dumps({
+            "skipped": f"1-core host (os.cpu_count()={os.cpu_count()}): "
+                       f"cannot run {args.replicas} fleet replicas "
+                       "reliably (--force-multi overrides)"
+        }))
+        return 0
+
+    summary, rc = fleet_local.run_fleet(args)
+
+    # Narrate the interesting bits above the raw summary.
+    notes = []
+    tenants = summary.get("tenants", {})
+    for name in sorted(tenants):
+        t = tenants[name]
+        notes.append(
+            f"tenant {name}: {t['completed']}/{t['submitted']} completed"
+            + (f", {t['rejected']} rejected" if t["rejected"] else "")
+        )
+    if summary.get("failovers"):
+        notes.append(
+            f"failovers: {summary['failovers']} request(s) re-dispatched "
+            "off dead replicas (same trace_id; retry segment in trace)"
+        )
+    if summary.get("trace"):
+        notes.append(f"perfetto trace: {summary['trace']['path']}")
+    summary["notes"] = notes
+    print(json.dumps(summary, indent=1))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,666 @@
+"""Serving-fleet tier (ISSUE 16): replica supervision, consistent-hash
+routing, per-tenant admission, failover re-dispatch, chaos plan.
+
+Tier-1 tests are pure host logic on fake clocks — no subprocesses, no
+device. The chaos acceptance e2e (slow) drives tools/fleet_local.py for
+real: SIGKILL one replica mid-batch + wedge another, digests equal the
+fault-free run's, retry segment on the original trace_id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tpu_aerial_transport.obs import export as export_mod
+from tpu_aerial_transport.obs import trace as trace_mod
+from tpu_aerial_transport.resilience import backend as backend_mod
+from tpu_aerial_transport.serving import fleet as fleet_mod
+from tpu_aerial_transport.serving import queue as queue_mod
+
+pytestmark = pytest.mark.fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _supervisor(clock, ev, **kw):
+    kw.setdefault("lease_s", 1.0)
+    kw.setdefault("boot_grace_s", 10.0)
+    return fleet_mod.ReplicaSupervisor(
+        [0, 1], clock=clock, emit=lambda **f: ev.append(f), **kw
+    )
+
+
+# ---------------------------------------------------------------------
+# Replica supervisor: the health machine.
+# ---------------------------------------------------------------------
+
+class TestSupervisor:
+    def test_heartbeat_brings_starting_up(self):
+        clock, ev = FakeClock(), []
+        sup = _supervisor(clock, ev)
+        assert sup.state(0) == fleet_mod.STARTING
+        assert 0 in sup.routable()  # starting IS routable (inbox buffers).
+        sup.heartbeat(0)
+        assert sup.state(0) == fleet_mod.UP
+
+    def test_missed_leases_suspect_then_down(self):
+        clock, ev = FakeClock(), []
+        sup = _supervisor(clock, ev)
+        sup.heartbeat(0)
+        sup.heartbeat(1)
+        clock.t = 2.5  # >= 2 missed leases.
+        assert sup.tick() == []
+        assert sup.state(0) == fleet_mod.SUSPECT
+        assert 0 in sup.routable()  # suspect stays routable.
+        clock.t = 5.5  # >= 5 missed leases.
+        actions = sup.tick()
+        assert ("kill", 0) in actions and ("failover", 0) in actions
+        assert sup.state(0) == fleet_mod.RESTARTING
+        assert 0 not in sup.routable()
+        # Both replicas went down in the same tick — order-independent.
+        assert sup.state(1) == fleet_mod.RESTARTING
+
+    def test_restart_spawns_after_backoff_and_recovers(self):
+        clock, ev = FakeClock(), []
+        sup = _supervisor(clock, ev)
+        sup.heartbeat(0)
+        clock.t = 6.0
+        sup.tick()
+        assert sup.state(0) == fleet_mod.RESTARTING
+        assert sup.tick() == []  # backoff not elapsed.
+        clock.t = 6.0 + sup.backoff.initial_s + 0.01
+        acts = [a for a in sup.tick() if a[1] == 0]
+        assert ("spawn", 0) in acts
+        sup.heartbeat(0)  # the respawn's first pulse.
+        assert sup.state(0) == fleet_mod.UP
+
+    def test_exit_notification_declares_down(self):
+        clock, ev = FakeClock(), []
+        sup = _supervisor(clock, ev)
+        sup.heartbeat(0)
+        actions = sup.notify_exit(0, returncode=-9)
+        assert ("failover", 0) in actions
+        assert sup.state(0) == fleet_mod.RESTARTING
+        # A second notification for the same death is a no-op.
+        assert sup.notify_exit(0, returncode=-9) == []
+
+    def test_boot_deadline_declares_down(self):
+        clock, ev = FakeClock(), []
+        sup = _supervisor(clock, ev, boot_grace_s=10.0)
+        clock.t = 9.0
+        assert sup.tick() == []  # still within boot grace.
+        clock.t = 10.5
+        actions = sup.tick()
+        assert ("failover", 0) in actions and ("failover", 1) in actions
+
+    def test_quarantine_after_k_restart_cycles(self):
+        clock, ev = FakeClock(), []
+        sup = _supervisor(clock, ev, quarantine_after=2)
+        for cycle in range(3):
+            sup.heartbeat(0)
+            assert sup.state(0) == fleet_mod.UP
+            actions = sup.notify_exit(0, returncode=1)
+            if cycle < 2:
+                assert sup.state(0) == fleet_mod.RESTARTING
+                clock.t += 100.0
+                sup.tick()  # spawn.
+            else:
+                assert ("quarantine", 0) in actions
+        assert sup.state(0) == fleet_mod.QUARANTINED
+        assert 0 not in sup.routable()
+        # A zombie heartbeat cannot resurrect a quarantined replica.
+        sup.heartbeat(0)
+        assert sup.state(0) == fleet_mod.QUARANTINED
+        assert any(e["kind"] == "quarantine" for e in ev)
+
+    def test_infra_error_kinds_strike_breaker_compile_error_never(self):
+        clock, ev = FakeClock(), []
+        sup = _supervisor(clock, ev, breaker_threshold=3)
+        sup.heartbeat(0)
+        sup.heartbeat(1)
+        # compile_error is a program bug, not replica sickness: NO
+        # number of them may get a healthy replica killed.
+        for _ in range(10):
+            assert sup.report_error(0, "compile_error", "bad jaxpr") == []
+        assert sup.state(0) == fleet_mod.UP
+        # Infra kinds strike; the third opens the breaker -> down.
+        assert sup.report_error(1, "device_crash") == []
+        assert sup.report_error(1, "oom") == []
+        actions = sup.report_error(1, "wedge_timeout")
+        assert ("failover", 1) in actions
+        assert sup.state(1) == fleet_mod.RESTARTING
+
+    def test_transitions_emit_seq_ordered_fleet_events(self):
+        clock, ev = FakeClock(), []
+        sup = _supervisor(clock, ev)
+        sup.heartbeat(0)
+        clock.t = 6.0
+        sup.tick()
+        trans = [e for e in ev if e["kind"] == "transition"]
+        assert [t["seq"] for t in trans] == sorted(
+            t["seq"] for t in trans
+        )
+        assert trans[0]["from_state"] == fleet_mod.STARTING
+        assert trans[0]["to_state"] == fleet_mod.UP
+        path = [(t["from_state"], t["to_state"]) for t in trans
+                if t["replica"] == 0]
+        assert path == [("starting", "up"), ("up", "down"),
+                        ("down", "restarting")]
+        restart = [e for e in ev if e["kind"] == "restart"]
+        assert restart and restart[0]["attempt"] == 1
+
+
+# ---------------------------------------------------------------------
+# Consistent-hash ring.
+# ---------------------------------------------------------------------
+
+class TestHashRing:
+    def test_deterministic_and_total(self):
+        ring = fleet_mod.HashRing([0, 1, 2])
+        keys = [f"fam{i}:{b}" for i in range(8) for b in (8, 16, 32)]
+        a = [ring.route(k) for k in keys]
+        b = [fleet_mod.HashRing([0, 1, 2]).route(k) for k in keys]
+        assert a == b
+        assert set(a) <= {0, 1, 2}
+
+    def test_node_loss_moves_only_its_keys(self):
+        """THE consistent-hashing property the compiled-shape working
+        set rides on: removing a replica relocates only the keys it
+        owned — every other replica's shape set is undisturbed."""
+        ring = fleet_mod.HashRing([0, 1, 2, 3])
+        keys = [f"fam{i}:{b}" for i in range(32) for b in (8, 16, 32)]
+        full = {k: ring.route(k) for k in keys}
+        without_2 = {k: ring.route(k, alive={0, 1, 3}) for k in keys}
+        for k in keys:
+            if full[k] != 2:
+                assert without_2[k] == full[k]
+            else:
+                assert without_2[k] != 2
+
+    def test_empty_alive_set_returns_none(self):
+        ring = fleet_mod.HashRing([0, 1])
+        assert ring.route("k", alive=set()) is None
+
+
+# ---------------------------------------------------------------------
+# Chaos plan.
+# ---------------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_parse_to_spec_roundtrip(self):
+        spec = "sigkill@1.5:r0,wedge@2:r1=3,error@2.5:r0=oom"
+        plan = fleet_mod.FleetFaultPlan.parse(spec)
+        assert plan.to_spec() == spec
+        assert fleet_mod.FleetFaultPlan.parse(plan.to_spec()) == plan
+
+    def test_seeded_plans_are_deterministic(self):
+        a = fleet_mod.FleetFaultPlan.seeded(7, 3)
+        b = fleet_mod.FleetFaultPlan.seeded(7, 3)
+        assert a == b and a.actions
+        assert fleet_mod.FleetFaultPlan.seeded(8, 3) != a
+
+    def test_due_windows_partition_the_schedule(self):
+        plan = fleet_mod.FleetFaultPlan.parse(
+            "sigkill@1:r0,wedge@2:r1=3,sigterm@3:r0"
+        )
+        fired = []
+        for lo, hi in [(0, 1.5), (1.5, 2.5), (2.5, 10)]:
+            fired += plan.due(lo, hi)
+        assert fired == list(plan.actions)
+
+    def test_bad_tokens_raise(self):
+        for bad in ("explode@1:r0", "sigkill@x:r0", "sigkill@1:q0"):
+            with pytest.raises(ValueError):
+                fleet_mod.FleetFaultPlan.parse(bad)
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv(fleet_mod.FLEET_FAULTS_ENV, "sigkill@1:r0")
+        plan = fleet_mod.FleetFaultPlan.from_env()
+        assert plan.actions[0].action == "sigkill"
+        monkeypatch.delenv(fleet_mod.FLEET_FAULTS_ENV)
+        assert fleet_mod.FleetFaultPlan.from_env().actions == ()
+
+
+# ---------------------------------------------------------------------
+# Per-tenant admission (queue hardening).
+# ---------------------------------------------------------------------
+
+def _req(i, tenant="default", family="f", horizon=4):
+    return queue_mod.ScenarioRequest(
+        family=family, horizon=horizon, request_id=f"t{i:03d}",
+        tenant=tenant,
+    )
+
+
+def _queue(clock, tenants=None, capacity=64, emit=None):
+    return queue_mod.AdmissionQueue(
+        lambda fam: 2 if fam == "f" else None, capacity=capacity,
+        clock=clock, tenants=tenants, emit=emit,
+    )
+
+
+class TestTenantAdmission:
+    def test_token_bucket_rejects_structured_and_refills(self):
+        clock = FakeClock()
+        q = _queue(clock, tenants={
+            "burst": queue_mod.TenantPolicy(rate_per_s=1.0, burst=2),
+        })
+        tickets = [q.submit(_req(i, "burst")) for i in range(3)]
+        assert [t.status for t in tickets] == [
+            queue_mod.PENDING, queue_mod.PENDING, queue_mod.REJECTED,
+        ]
+        assert tickets[2].reason == queue_mod.REASON_TENANT_RATE
+        clock.t = 1.0  # one token refilled.
+        assert q.submit(_req(3, "burst")).status == queue_mod.PENDING
+        assert q.submit(_req(4, "burst")).status == queue_mod.REJECTED
+
+    def test_rate_limit_never_masks_malformed_requests(self):
+        """Admission order contract: a malformed request is rejected AS
+        malformed and costs the tenant no tokens."""
+        clock = FakeClock()
+        q = _queue(clock, tenants={
+            "burst": queue_mod.TenantPolicy(rate_per_s=0.0, burst=1),
+        })
+        bad = q.submit(queue_mod.ScenarioRequest(
+            family="f", horizon=3, request_id="bad", tenant="burst",
+        ))  # horizon off the chunk grid.
+        assert bad.reason == queue_mod.REASON_BAD_HORIZON
+        # The token survives for a well-formed request.
+        assert q.submit(_req(0, "burst")).status == queue_mod.PENDING
+
+    def test_default_tenant_is_unlimited_fifo(self):
+        """Single-tenant backward compat: no policy table, plain FIFO —
+        the pre-fleet AdmissionQueue behavior byte-for-byte."""
+        clock = FakeClock()
+        q = _queue(clock)
+        ids = [q.submit(_req(i)).request.request_id for i in range(10)]
+        taken = [t.request.request_id for t in q.take("f", 10)]
+        assert taken == ids
+
+    def test_weighted_fair_dequeue_shares(self):
+        clock = FakeClock()
+        q = _queue(clock, tenants={
+            "heavy": queue_mod.TenantPolicy(weight=3.0),
+            "light": queue_mod.TenantPolicy(weight=1.0),
+        })
+        for i in range(8):
+            q.submit(_req(i, "heavy"))
+            q.submit(_req(100 + i, "light"))
+        taken = q.take("f", 8)
+        by_tenant = {}
+        for t in taken:
+            by_tenant[t.request.tenant] = by_tenant.get(
+                t.request.tenant, 0
+            ) + 1
+        assert by_tenant["heavy"] == 6 and by_tenant["light"] == 2
+
+    def test_priority_class_dequeues_strictly_first(self):
+        clock = FakeClock()
+        q = _queue(clock, tenants={
+            "ops": queue_mod.TenantPolicy(priority=1, weight=0.1),
+            "batch": queue_mod.TenantPolicy(priority=0, weight=100.0),
+        })
+        for i in range(3):
+            q.submit(_req(i, "batch"))
+        for i in range(3):
+            q.submit(_req(10 + i, "ops"))
+        taken = [t.request.tenant for t in q.take("f", 6)]
+        # Priority beats any weight: all ops first.
+        assert taken == ["ops"] * 3 + ["batch"] * 3
+
+    def test_tenant_survives_json_roundtrip(self):
+        r = _req(0, tenant="pro")
+        assert queue_mod.ScenarioRequest.from_json(r.to_json()).tenant \
+            == "pro"
+        # Default tenant stays off the wire (journal compat).
+        assert "tenant" not in _req(1).to_json()
+
+    def test_concurrent_submitters_thread_safety(self, tmp_path):
+        """ISSUE 16 satellite: N threads hammering submit — no ticket
+        id collisions, no lost rejections, schema-valid event stream
+        (the jsonl_append concurrent-writer pin, queue edition)."""
+        path = str(tmp_path / "subm.metrics.jsonl")
+        writer = export_mod.MetricsWriter(path)
+        clock = FakeClock()
+        capacity = 40
+        q = _queue(
+            clock, capacity=capacity,
+            emit=lambda **f: writer.emit("serving_event", **f),
+        )
+        n_threads, per_thread = 8, 10
+        tickets: list = [None] * (n_threads * per_thread)
+        barrier = threading.Barrier(n_threads)
+
+        def hammer(k):
+            barrier.wait()
+            for j in range(per_thread):
+                # Default-id path: the process-global ticket counter is
+                # what must not collide under contention.
+                tickets[k * per_thread + j] = q.submit(
+                    queue_mod.ScenarioRequest(family="f", horizon=4)
+                )
+
+        threads = [threading.Thread(target=hammer, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ids = [t.request.request_id for t in tickets]
+        assert len(set(ids)) == len(ids)  # no ticket id collisions.
+        pending = [t for t in tickets if t.status == queue_mod.PENDING]
+        rejected = [t for t in tickets if t.status == queue_mod.REJECTED]
+        # No lost submissions: capacity admitted, the rest rejected
+        # queue_full — EXACTLY (the lock makes the depth check atomic).
+        assert len(pending) == capacity
+        assert len(rejected) == n_threads * per_thread - capacity
+        assert all(t.reason == queue_mod.REASON_QUEUE_FULL
+                   for t in rejected)
+        assert q.depth() == capacity
+        # Drain sees every admitted ticket exactly once.
+        assert len(q.take("f", 1000)) == capacity
+        # The event stream stayed schema-valid under contention and
+        # recorded every outcome.
+        assert export_mod.validate_file(path) == []
+        events = export_mod.read_events(path)
+        kinds = {}
+        for e in events:
+            kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+        assert kinds["submitted"] == capacity
+        assert kinds["rejected"] == len(rejected)
+
+
+# ---------------------------------------------------------------------
+# Fleet front: routing + failover + dedup.
+# ---------------------------------------------------------------------
+
+def _front(clock, sent, tracer=None, sink=None, tenants=None,
+           replica_ids=(0, 1)):
+    sup = fleet_mod.ReplicaSupervisor(
+        list(replica_ids), lease_s=1.0, boot_grace_s=100.0,
+        clock=clock, emit=sink,
+    )
+    for r in replica_ids:
+        sup.heartbeat(r)
+    front = fleet_mod.FleetFront(
+        list(replica_ids), lambda fam: 2 if fam == "f" else None,
+        send=lambda rid, op: sent.append((rid, op)),
+        buckets=(4, 8), supervisor=sup, clock=clock,
+        metrics=sink, tracer=tracer, tenants=tenants,
+    )
+    return front, sup
+
+
+class TestFleetFront:
+    def test_routing_is_sticky_per_family_bucket(self):
+        clock, sent = FakeClock(), []
+        front, _ = _front(clock, sent)
+        for i in range(3):
+            front.submit(_req(i))
+        front.pump()
+        owners = {op["request"]["request_id"]: rid for rid, op in sent}
+        assert len(set(owners.values())) == 1  # one (family,bucket) key.
+        # The same group shape routes to the same replica again.
+        sent.clear()
+        for i in range(10, 13):
+            front.submit(_req(i))
+        front.pump()
+        again = {rid for rid, _ in sent}
+        assert again == set(owners.values())
+
+    def test_failover_redispatches_on_same_trace_id(self):
+        rows = []
+
+        class Sink:
+            def emit(self, event, **kw):
+                rows.append({"event": event, **kw})
+
+        clock, sent = FakeClock(), []
+        sink = Sink()
+        tracer = trace_mod.Tracer(sink, track="front",
+                                  clock_mono=lambda: clock.t)
+        front, sup = _front(clock, sent, tracer=tracer, sink=sink)
+        for i in range(4):
+            front.submit(_req(i))
+        front.pump()
+        dead = sent[0][0]
+        alive = 1 - dead
+        trace_ids = {op["request"]["request_id"]: op["request"]["trace_id"]
+                     for _, op in sent}
+        sup.notify_exit(dead, returncode=-9)
+        moved = front.failover(dead)
+        assert sorted(moved) == [f"t{i:03d}" for i in range(4)]
+        # Re-dispatch went to the healthy replica, SAME trace_id.
+        redis = [(rid, op) for rid, op in sent if op["op"] == "submit"
+                 and rid == alive]
+        assert len(redis) == 4
+        for rid, op in redis:
+            assert op["request"]["trace_id"] == \
+                trace_ids[op["request"]["request_id"]]
+        # Best-effort cancels went to the dead replica's inbox.
+        cancels = [op for rid, op in sent
+                   if rid == dead and op["op"] == "cancel"]
+        assert len(cancels) == 4
+        fo = [r for r in rows if r.get("kind") == "failover"]
+        assert len(fo) == 4
+        assert all(r["trace_id"] == trace_ids[r["request_id"]]
+                   for r in fo)
+
+    def test_first_result_wins_duplicate_dropped(self):
+        clock, sent = FakeClock(), []
+        rows = []
+
+        class Sink:
+            def emit(self, event, **kw):
+                rows.append({"event": event, **kw})
+
+        front, _ = _front(clock, sent, sink=Sink())
+        t = front.submit(_req(0))
+        front.pump()
+        assert front.deliver_result({
+            "request_id": "t000", "status": "completed", "digest": "aa",
+            "replica": 1,
+        })
+        assert t.status == queue_mod.COMPLETED and t.result == "aa"
+        # The restarted replica re-serves and re-reports: dropped.
+        assert not front.deliver_result({
+            "request_id": "t000", "status": "completed", "digest": "aa",
+            "replica": 0,
+        })
+        assert t.result == "aa"
+        assert front.duplicates and front.stats()[
+            "duplicates_dropped"] == 1
+        assert any(r.get("kind") == "duplicate_result" for r in rows)
+
+    def test_requests_hold_while_fleet_unroutable(self):
+        clock, sent = FakeClock(), []
+        front, sup = _front(clock, sent)
+        for r in (0, 1):
+            sup.notify_exit(r, returncode=1)
+        front.submit(_req(0))
+        assert front.pump() == 0 and sent == []  # held, not lost.
+        sup.heartbeat(0)  # one replica recovers.
+        assert front.pump() == 1
+        assert sent[0][0] == 0
+
+    def test_tenant_throttle_emits_fleet_event(self):
+        rows = []
+
+        class Sink:
+            def emit(self, event, **kw):
+                rows.append({"event": event, **kw})
+
+        clock, sent = FakeClock(), []
+        front, _ = _front(clock, sent, sink=Sink(), tenants={
+            "burst": queue_mod.TenantPolicy(rate_per_s=0.0, burst=1),
+        })
+        front.submit(_req(0, tenant="burst"))
+        t = front.submit(_req(1, tenant="burst"))
+        assert t.status == queue_mod.REJECTED  # structured, no raise.
+        throttles = [r for r in rows
+                     if r.get("kind") == "tenant_rejected"]
+        assert len(throttles) == 1
+        assert throttles[0]["tenant"] == "burst"
+
+    def test_failover_retry_segment_lands_on_original_trace(self):
+        """The PR-15 composition: after a failover, the request's
+        critical path shows an explicit retry segment — on the ORIGINAL
+        trace_id — covering the re-served window (the front's
+        guard_fallback span stays open until completion)."""
+        clock, sent = FakeClock(), []
+        tracer = trace_mod.Tracer(None, track="front",
+                                  clock_mono=lambda: clock.t)
+        front, sup = _front(clock, sent, tracer=tracer)
+        t = front.submit(_req(0))
+        tid = t.request.trace_id
+        front.pump()
+        dead = sent[0][0]
+        clock.t = 5.0
+        sup.notify_exit(dead, returncode=-9)
+        front.failover(dead)
+        # The surviving replica re-serves: its own request/queue spans
+        # on the SAME trace (what a real replica's tracer would emit).
+        rep = trace_mod.Tracer(None, track="r_alive",
+                               clock_mono=lambda: clock.t)
+        clock.t = 6.0
+        root = rep.begin(trace_mod.REQUEST, parent=None, trace_id=tid,
+                         request_id="t000")
+        qs = rep.begin(trace_mod.QUEUE_WAIT, parent=root)
+        clock.t = 6.5
+        rep.end(qs)
+        clock.t = 10.0
+        rep.end(root, status="completed")
+        front.deliver_result({"request_id": "t000",
+                              "status": "completed", "digest": "d",
+                              "replica": "x"})
+        cp = trace_mod.critical_path(tracer.rows + rep.rows)
+        mine = [q for q in cp["requests"] if q["trace_id"] == tid]
+        assert len(mine) == 1  # deduped: the re-served span won.
+        segs = mine[0]["segments"]
+        # Window [6.5, 10] is fully inside the open failover span
+        # [5, 10] -> the whole re-serve is retry time.
+        assert segs["retry"] == pytest.approx(3.5)
+        assert segs["batch_wait"] == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------
+# Harness pieces (no subprocesses).
+# ---------------------------------------------------------------------
+
+class TestHarnessPieces:
+    def test_parse_tenants_spec(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import fleet_local
+
+        policies = fleet_local.parse_tenants(
+            "free:rate=2,burst=4;pro:weight=4,priority=1"
+        )
+        assert policies["free"].rate_per_s == 2.0
+        assert policies["free"].burst == 4
+        assert policies["pro"].weight == 4.0
+        assert policies["pro"].priority == 1
+        with pytest.raises(SystemExit):
+            fleet_local.parse_tenants("x:bogus=1")
+
+    def test_make_fleet_stream_deterministic(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import fleet_local
+
+        a = fleet_local.make_fleet_stream(
+            8, ["f"], {"f": 2}, ["p", "q"], seed=3
+        )
+        b = fleet_local.make_fleet_stream(
+            8, ["f"], {"f": 2}, ["p", "q"], seed=3
+        )
+        assert [(r.request_id, r.tenant, r.horizon) for r in a] == \
+            [(r.request_id, r.tenant, r.horizon) for r in b]
+        assert {r.tenant for r in a} == {"p", "q"}
+
+    def test_bucket_hint_matches_batcher_rule(self):
+        from tpu_aerial_transport.serving import batcher
+
+        for pending in (1, 4, 8, 9, 40):
+            assert fleet_mod.bucket_hint(pending, (4, 8)) == \
+                batcher.bucket_for(pending, (4, 8))
+
+
+# ---------------------------------------------------------------------
+# Chaos acceptance e2e (subprocess; slow).
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_storm_digests_match_fault_free_run(tmp_path):
+    """ISSUE 16 acceptance: under a fault plan that SIGKILLs one replica
+    mid-batch and wedges the other, the fleet exits 0, every request
+    completes with a digest equal to the fault-free run's, nothing is
+    lost or double-completed, and the killed replica's requests carry a
+    failover retry segment on their original trace_id."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(out, chaos=""):
+        cmd = [
+            sys.executable, os.path.join(REPO, "tools/fleet_local.py"),
+            "--replicas", "2", "--force-multi", "--requests", "8",
+            "--out-dir", str(tmp_path / out),
+            "--results", str(tmp_path / f"{out}.json"),
+            "--timeout", "300", "--seed", "5",
+        ] + (["--chaos", chaos, "--lease", "1.0"] if chaos else [])
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=420,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        results = json.load(open(tmp_path / f"{out}.json"))
+        return summary, results
+
+    base_summary, base = run("fault_free")
+    assert base_summary["ok"] and not base_summary["unresolved"]
+
+    chaos_summary, chaos = run("storm", chaos="sigkill@6:r1,wedge@8:r0=2")
+    assert chaos_summary["ok"], chaos_summary
+    # No request lost or double-completed.
+    assert not chaos_summary["unresolved"]
+    assert chaos_summary["completed"] == 8
+
+    # Bit-identical to the uninterrupted run (lane independence + full
+    # replay): same ids, same digests.
+    assert set(base) == set(chaos)
+    for rid in base:
+        assert base[rid]["status"] == chaos[rid]["status"] == "completed"
+        assert base[rid]["digest"] == chaos[rid]["digest"], rid
+
+    # The killed replica's requests show the failover as an explicit
+    # retry segment on their ORIGINAL trace_id.
+    events = export_mod.read_events(chaos_summary["metrics"])
+    failed_over = {e["trace_id"] for e in events
+                   if e.get("event") == "fleet_event"
+                   and e.get("kind") == "failover"}
+    if failed_over:  # chaos timing may catch the batch already done.
+        cp = trace_mod.critical_path(
+            trace_mod.stitch(trace_mod.trace_rows(events))
+        )
+        retried = {q["trace_id"] for q in cp["requests"]
+                   if q["segments"]["retry"] > 0}
+        assert failed_over & retried, (failed_over, retried)
+    # Supervisor observed the kill and restarted the replica.
+    kinds = {}
+    for e in events:
+        if e.get("event") == "fleet_event":
+            kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    assert kinds.get("transition", 0) >= 3
+    assert kinds.get("restart", 0) >= 1

@@ -507,3 +507,90 @@ def test_run_health_serving_section_dedups_appended_rerun(tmp_path):
     # Raw event counts stay honest counts (the dedup is aggregation-
     # side).
     assert sv["kinds"]["completed"] == 2
+
+
+# --------------- schema v6: fleet_event (serving fleet) ----------------
+
+def test_fleet_event_validates_at_schema_v6(tmp_path):
+    """The fleet vocabulary (ISSUE 16): heartbeat / transition /
+    failover / tenant_rejected rows write and validate at v6."""
+    path = str(tmp_path / "fleet.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("fleet_event", kind="heartbeat", replica=0, seq=1, pid=123)
+    w.emit("fleet_event", kind="transition", replica=0,
+           from_state="up", to_state="suspect",
+           reason="2 missed heartbeat leases", seq=1)
+    w.emit("fleet_event", kind="failover", request_id="req00001",
+           from_replica="1", to_replica="0", trace_id="t1",
+           latency_s=0.004)
+    w.emit("fleet_event", kind="tenant_rejected", tenant="burst",
+           request_id="req00002", reason="tenant_rate_limited")
+    assert export_mod.validate_file(path) == []
+    events = export_mod.read_events(path)
+    assert [e["event"] for e in events] == ["fleet_event"] * 4
+    assert all(e["schema"] == export_mod.SCHEMA_VERSION >= 6
+               for e in events)
+
+
+def test_fleet_event_requires_kind(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("fleet_event", replica=0)  # no kind.
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "missing fields ['kind']" in errs[0]
+
+
+def test_v5_files_remain_valid_but_not_for_fleet_event(tmp_path):
+    """Additive bump contract, v6 edition: a v5 file still validates; a
+    fleet_event STAMPED v5 does not (the v5 reader contract never
+    defined it)."""
+    path = str(tmp_path / "old.metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "schema": 5, "event": "trace_event", "ts": 0.0,
+            "name": "chunk", "trace_id": "t", "span_id": "s",
+            "track": "p0of1", "t0_mono": 0.0, "t0_wall": 0.0,
+        }) + "\n")
+    assert export_mod.validate_file(path) == []
+    with open(path, "a") as fh:
+        fh.write(json.dumps({
+            "schema": 5, "event": "fleet_event", "ts": 0.0,
+            "kind": "heartbeat", "replica": 0,
+        }) + "\n")
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "requires schema >= 6" in errs[0]
+
+
+def test_run_health_fleet_section_dedups_appended_rerun(tmp_path):
+    """The fleet section follows the append-mode dedup rule: transitions
+    per (replica, seq), failovers and tenant admissions per request_id,
+    LAST event wins."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import run_health
+
+    path = str(tmp_path / "fleet.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    for _ in range(2):  # the re-run appends the SAME identities.
+        w.emit("fleet_event", kind="transition", replica=1,
+               from_state="up", to_state="down", reason="exited", seq=3)
+        w.emit("fleet_event", kind="failover", request_id="req1",
+               from_replica="1", to_replica="0", trace_id="t1",
+               latency_s=0.5)
+        w.emit("serving_event", kind="submitted", request_id="req1",
+               family="cadmm4", tenant="pro")
+        w.emit("serving_event", kind="completed", request_id="req1",
+               family="cadmm4", tenant="pro",
+               slo={"latency_s": 2.0})
+        w.emit("fleet_event", kind="tenant_rejected", tenant="free",
+               request_id="req2", reason="tenant_rate_limited")
+    fl = run_health.summarize(export_mod.read_events(path))["fleet"]
+    assert len(fl["transitions"]) == 1
+    assert fl["transitions"][0]["to_state"] == "down"
+    assert fl["failovers"] == 1
+    assert fl["failover_latency_s"]["count"] == 1
+    pro = fl["tenants"]["pro"]
+    assert pro["submitted"] == 1 and pro["completed"] == 1
+    assert pro["latency_s"]["count"] == 1
+    assert fl["tenants"]["free"]["throttled"] == 2
+    # Raw counts stay honest (dedup is aggregation-side).
+    assert fl["kinds"]["failover"] == 2

@@ -63,6 +63,19 @@ python tools/pods_local.py --mode parity --check-parity \
     --max-iter 2 --no-masked --out-dir artifacts/pods-smoke \
     --timeout 420 || fail=1
 
+echo "== serving fleet 2-replica smoke (tools/fleet_local.py) =="
+# Bounded fleet smoke (ISSUE 16, serving/fleet.py): 2 REAL replica
+# worker processes behind the consistent-hash admission front, a small
+# fault-free request batch, every ticket resolved. Replicas follow the
+# pods_local discipline (own session, group-killable, parent-pid
+# watchdog); a 1-core host skips with a written reason (the harness
+# prints the skip JSON and exits 0 — replicas are independent CPU
+# processes, but time-slicing 2 jax boots through one core blows the
+# smoke budget). The chaos-storm e2es live in tests/test_fleet.py
+# (-m slow).
+python tools/fleet_local.py --replicas 2 --requests 6 \
+    --out-dir artifacts/fleet-smoke --timeout 420 || fail=1
+
 echo "== aot bundle coverage (tools/aot_bundle.py check) =="
 # Registry/bundle drift gate (PR 8): the in-tree manifest-only coverage
 # record must keep matching the live entrypoint registry — a new/changed

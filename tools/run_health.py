@@ -213,6 +213,113 @@ def summarize(events: list[dict]) -> dict:
             "batches": batches,
         }
 
+    # Serving fleet (schema v6): replica lifecycle + failover +
+    # per-tenant admission from serving/fleet.py. Same append-mode dedup
+    # discipline as the serving section: transitions dedup per
+    # (replica, seq) and failovers per request_id, LAST event wins (a
+    # restarted storm re-appends; only the final record counts).
+    fevents = [e for e in events if e.get("event") == "fleet_event"]
+    if fevents:
+        kinds = {}
+        for e in fevents:
+            k = e.get("kind", "?")
+            kinds[k] = kinds.get(k, 0) + 1
+        trans_by_id: dict[tuple, dict] = {}
+        hb: dict[str, int] = {}
+        restarts: dict[str, int] = {}
+        quarantined: list = []
+        fail_by_req: dict[str, dict] = {}
+        throttled: dict[str, int] = {}
+        for e in fevents:
+            k = e.get("kind")
+            rep = str(e.get("replica", "?"))
+            if k == "transition":
+                trans_by_id[(rep, e.get("seq"))] = e
+            elif k == "heartbeat":
+                hb[rep] = hb.get(rep, 0) + 1
+            elif k == "restart":
+                restarts[rep] = max(restarts.get(rep, 0),
+                                    e.get("attempt", 0))
+            elif k == "quarantine":
+                quarantined.append(rep)
+            elif k == "failover":
+                fail_by_req[e.get("request_id", "?")] = e
+            elif k == "tenant_rejected":
+                t = str(e.get("tenant", "?"))
+                throttled[t] = throttled.get(t, 0) + 1
+        transitions = sorted(
+            trans_by_id.values(),
+            key=lambda e: (e.get("seq") is None, e.get("seq")),
+        )
+        fail_lat = [e["latency_s"] for e in fail_by_req.values()
+                    if isinstance(e.get("latency_s"), (int, float))]
+        # Per-tenant admission ledger from the serving_event stream
+        # (tenant is an additive field): admits per submitted event,
+        # terminal outcomes deduped per request_id (last wins).
+        tenant_term: dict[str, dict] = {}
+        tenants: dict[str, dict] = {}
+        seen_submit: set = set()
+        for e in sevents:
+            t = e.get("tenant")
+            if t is None:
+                continue
+            row = tenants.setdefault(str(t), {
+                "submitted": 0, "completed": 0, "rejected": 0,
+                "throttled": 0, "latency": [],
+            })
+            if e.get("kind") == "submitted":
+                # Dedup per request_id: the front, the owning replica,
+                # a failover re-dispatch and a resume each re-emit the
+                # submit — one logical admission.
+                rid = e.get("request_id")
+                if rid not in seen_submit:
+                    seen_submit.add(rid)
+                    row["submitted"] += 1
+            elif e.get("kind") in ("completed", "rejected",
+                                   "deadline_missed"):
+                tenant_term[e.get("request_id", "?")] = e
+        for e in tenant_term.values():
+            row = tenants.setdefault(str(e.get("tenant")), {
+                "submitted": 0, "completed": 0, "rejected": 0,
+                "throttled": 0, "latency": [],
+            })
+            if e.get("kind") == "completed":
+                row["completed"] += 1
+                if (isinstance(e.get("slo"), dict)
+                        and "latency_s" in e["slo"]):
+                    row["latency"].append(e["slo"]["latency_s"])
+            elif e.get("kind") == "rejected":
+                row["rejected"] += 1
+        for t, n in throttled.items():
+            tenants.setdefault(t, {
+                "submitted": 0, "completed": 0, "rejected": 0,
+                "throttled": 0, "latency": [],
+            })["throttled"] = n
+        out["fleet"] = {
+            "kinds": kinds,
+            "transitions": [
+                {k: e.get(k) for k in ("seq", "replica", "from_state",
+                                       "to_state", "reason")}
+                for e in transitions
+            ],
+            "heartbeats": hb,
+            "restarts": restarts,
+            "quarantined": sorted(set(quarantined)),
+            "failovers": len(fail_by_req),
+            "failover_latency_s": _latency_stats(fail_lat),
+            "duplicates_dropped": kinds.get("duplicate_result", 0),
+            "tenants": {
+                t: {
+                    "submitted": r["submitted"],
+                    "completed": r["completed"],
+                    "rejected": r["rejected"],
+                    "throttled": r["throttled"],
+                    "latency_s": _latency_stats(r["latency"]),
+                }
+                for t, r in sorted(tenants.items())
+            },
+        }
+
     # Critical path (schema v5, obs.trace): decompose each traced
     # request's submit→complete interval into queue-wait / batch-wait /
     # device / harvest / retry segments — "why did p99 regress" as a
@@ -511,6 +618,46 @@ def render(summary: dict) -> None:
                 print(f"| {bid} | {b['family']} | "
                       f"{b['bucket'] if b['bucket'] is not None else '—'} "
                       f"| {rungs} |")
+
+    fl = summary.get("fleet")
+    if fl:
+        print("\n## serving fleet (serving/fleet.py)")
+        print("events: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(fl["kinds"].items())
+        ))
+        if fl["transitions"]:
+            print("\n| seq | replica | transition | reason |")
+            print("|---|---|---|---|")
+            for t in fl["transitions"]:
+                print(f"| {t.get('seq', '—')} | r{t['replica']} | "
+                      f"{t['from_state']} → {t['to_state']} | "
+                      f"{(t.get('reason') or '')[:60]} |")
+        hb = ", ".join(f"r{r}={n}" for r, n in sorted(fl["heartbeats"].items()))
+        print(f"- heartbeats: {hb or 'none'}")
+        if fl["restarts"]:
+            print("- restarts: " + ", ".join(
+                f"r{r}×{n}" for r, n in sorted(fl["restarts"].items())
+            ))
+        if fl["quarantined"]:
+            print(f"- quarantined replicas: "
+                  f"{', '.join('r' + r for r in fl['quarantined'])}")
+        st = fl.get("failover_latency_s")
+        print(f"- failovers: {fl['failovers']} "
+              + (f"(re-dispatch latency p50 {_fmt(st['p50'])} s, "
+                 f"p99 {_fmt(st['p99'])} s, max {_fmt(st['max'])} s)"
+                 if st else "")
+              + (f", duplicates dropped: {fl['duplicates_dropped']}"
+                 if fl["duplicates_dropped"] else ""))
+        if fl["tenants"]:
+            print("\n| tenant | submitted | completed | rejected | "
+                  "throttled | p50 s | p99 s |")
+            print("|---|---|---|---|---|---|---|")
+            for t, r in fl["tenants"].items():
+                lat = r["latency_s"]
+                print(f"| {t} | {r['submitted']} | {r['completed']} | "
+                      f"{r['rejected']} | {r['throttled']} | "
+                      f"{_fmt(lat['p50']) if lat else '—'} | "
+                      f"{_fmt(lat['p99']) if lat else '—'} |")
 
     cp = summary.get("critical_path")
     if cp:

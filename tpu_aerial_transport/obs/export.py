@@ -39,10 +39,16 @@ from tpu_aerial_transport.obs import telemetry as telemetry_mod
 # with trace/span/parent ids, per-process track, and BOTH monotonic and
 # wall-epoch timestamp pairs so ``tools/trace_view.py`` can stitch
 # multi-process runs onto one clock).
+# v6: adds the ``fleet_event`` type (the serving-fleet tier's replica
+# lifecycle from ``serving/fleet.py`` + ``tools/fleet_local.py``:
+# per-replica heartbeat leases, health-state transitions
+# up→suspect→down→restarting(→quarantined), failover re-dispatch
+# records, per-tenant admission throttling — the rows
+# ``tools/run_health.py``'s fleet section renders).
 # Files written at older versions remain valid (see
 # :data:`SUPPORTED_SCHEMAS`) — each bump only ADDS vocabulary.
-SCHEMA_VERSION = 5
-SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4, 5})
+SCHEMA_VERSION = 6
+SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4, 5, 6})
 
 # Event vocabulary -> required fields (beyond schema/event/ts). The
 # validator rejects unknown event types and missing fields; extra fields
@@ -69,6 +75,15 @@ EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     # per-process timeline the stitcher groups by.
     "trace_event": ("name", "trace_id", "span_id", "track",
                     "t0_mono", "t0_wall"),
+    # kind in {heartbeat, transition, replica_error, restart, quarantine,
+    # failover, tenant_rejected, duplicate_result}; replica-lifecycle
+    # kinds carry ``replica`` (+ heartbeat: seq/pid; transition:
+    # from/to/reason/seq; restart: attempt/delay_s), failover carries
+    # request_id/from_replica/to_replica/trace_id/latency_s,
+    # tenant_rejected carries tenant/request_id/reason — the per-kind
+    # reader contract lives in tools/run_health.py's fleet section, same
+    # convention as serving_event.
+    "fleet_event": ("kind",),
 }
 
 # Events that did not exist before a given schema version: an event of
@@ -79,6 +94,7 @@ EVENT_MIN_SCHEMA: dict[str, int] = {
     "aot_serve": 3,
     "serving_event": 4,
     "trace_event": 5,
+    "fleet_event": 6,
 }
 
 

@@ -1,0 +1,651 @@
+"""Fault-tolerant serving fleet: replica supervision, health-checked
+routing, and failover re-dispatch over N :class:`ScenarioServer` replica
+processes.
+
+The fleet tier composes the standing substrate instead of inventing new
+machinery:
+
+- **heartbeat leases** ride the fsync'd jsonl channel
+  (``obs.export.jsonl_append`` is pinned cross-process-atomic by
+  tests/test_obs_export.py): each replica appends ``fleet_event``
+  heartbeat rows; the supervisor drives the per-replica health machine
+  ``starting/up → suspect → down → restarting`` (→ ``quarantined``)
+  from missed leases and from classified ``BackendError`` kinds
+  reported upward — infra kinds strike a per-replica circuit breaker
+  (``resilience.backend.BREAKER_KINDS``), ``compile_error`` never does;
+- **restarts** are bounded by ``resilience.backend.BackoffPolicy``,
+  with poison-replica quarantine after K restart cycles;
+- **routing** is ``(family, bucket)`` consistent hashing
+  (:class:`HashRing`) so each replica's compiled-shape working set and
+  AOT bundle stay hot: one family+shape key always lands on the same
+  live replica, and a replica loss moves ONLY that replica's keys;
+- **failover re-dispatch** replays a dead replica's in-flight requests
+  on a healthy replica ON THE SAME ``trace_id`` — the continuous-
+  batching lane-independence contract makes the replayed result
+  bit-identical to the uninterrupted run, and the front's open
+  ``guard_fallback`` span (member = the request's trace) makes the
+  failover an explicit ``retry`` segment in ``obs.trace.critical_path``;
+- **chaos** is a seeded :class:`FleetFaultPlan` (the
+  ``resilience.faults.FaultSchedule`` / ``TAT_BACKEND_FAULTS`` idiom:
+  scheduled, deterministic, env-transportable) that
+  ``tools/fleet_local.py --chaos`` turns into real SIGKILL/SIGTERM/
+  wedge/error injections.
+
+Module contract (same as ``resilience/backend.py``): NO jax import at
+module scope — the front/supervisor run in a coordinator process that
+must never pay device initialization; the one jax touch
+(:func:`result_digest`) imports lazily inside a replica process that
+already owns a runtime.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import os
+import random
+import time
+
+from tpu_aerial_transport.obs import trace as trace_mod
+from tpu_aerial_transport.resilience import backend as backend_mod
+from tpu_aerial_transport.serving import queue as queue_mod
+
+# Replica health states (the supervisor's machine; every transition
+# lands as a ``fleet_event`` row).
+STARTING = "starting"        # spawned, no heartbeat yet (boot grace).
+UP = "up"                    # lease current.
+SUSPECT = "suspect"          # missed leases, still routable.
+DOWN = "down"                # lease expired / breaker open / exit seen.
+RESTARTING = "restarting"    # killed; respawn pending under backoff.
+QUARANTINED = "quarantined"  # poison replica: K restart cycles burned.
+
+ROUTABLE_STATES = frozenset({STARTING, UP, SUSPECT})
+
+FLEET_FAULTS_ENV = "TAT_FLEET_FAULTS"
+FAULT_ACTIONS = ("sigkill", "sigterm", "wedge", "error")
+
+
+def _emit_fn(sink):
+    """Normalize a fleet-event sink: a MetricsWriter (anything with
+    ``.emit``) gets ``fleet_event`` rows, a callable gets keyword
+    fields, None is the zero-cost path."""
+    if sink is None:
+        return lambda **kw: None
+    if hasattr(sink, "emit"):
+        return lambda **kw: sink.emit("fleet_event", **kw)
+    return lambda **kw: sink(**kw)
+
+
+# ----------------------------------------------------------------------
+# Consistent-hash routing.
+# ----------------------------------------------------------------------
+
+class HashRing:
+    """Consistent hashing over replica ids with virtual nodes.
+
+    Keys are ``(family, bucket)`` strings: all requests that will batch
+    at one compiled shape route to one replica (its executable cache and
+    bundle working set stay hot), and removing a replica moves ONLY the
+    keys it owned (every other replica's shape set is undisturbed —
+    pinned by tests/test_fleet.py)."""
+
+    def __init__(self, nodes, vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        self._points: list[tuple[int, object]] = sorted(
+            (self._hash(f"{node}#{v}"), node)
+            for node in nodes for v in range(self.vnodes)
+        )
+        self._keys = [h for h, _ in self._points]
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return int.from_bytes(
+            hashlib.sha256(str(s).encode()).digest()[:8], "big"
+        )
+
+    def route(self, key, alive=None):
+        """The first live node clockwise from ``key``'s point; ``alive``
+        restricts to a live subset (None = all). Returns None only when
+        no live node exists."""
+        if not self._points:
+            return None
+        idx = bisect.bisect_right(self._keys, self._hash(str(key)))
+        n = len(self._points)
+        for i in range(n):
+            node = self._points[(idx + i) % n][1]
+            if alive is None or node in alive:
+                return node
+        return None
+
+
+def bucket_hint(pending: int, buckets) -> int:
+    """The shape bucket a ``pending``-wide dispatch group will batch at:
+    smallest admitting bucket, largest when oversubscribed (the
+    ``serving.batcher.bucket_for`` rule, restated here so the front
+    never imports the device-facing batcher)."""
+    bs = sorted(int(b) for b in buckets)
+    for b in bs:
+        if pending <= b:
+            return b
+    return bs[-1]
+
+
+# ----------------------------------------------------------------------
+# Seeded chaos plan.
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FaultAction:
+    """One scheduled fault: at ``t_s`` seconds into the storm, hit
+    ``replica`` with ``action`` (sigkill/sigterm = signal the process
+    group; wedge = stop the replica loop AND its heartbeats for ``arg``
+    seconds; error = the replica reports a classified BackendError
+    ``arg`` upward)."""
+
+    t_s: float
+    replica: int
+    action: str
+    arg: str | None = None
+
+    def token(self) -> str:
+        base = f"{self.action}@{self.t_s:g}:r{self.replica}"
+        return base + (f"={self.arg}" if self.arg is not None else "")
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetFaultPlan:
+    """A deterministic fleet chaos schedule (the ``FaultSchedule`` /
+    ``TAT_BACKEND_FAULTS`` idiom at fleet scale): parse/print round-trips
+    through the spec grammar ``ACTION@T:rR[=ARG],...`` so a plan travels
+    through :data:`FLEET_FAULTS_ENV` to the harness."""
+
+    actions: tuple[FaultAction, ...] = ()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FleetFaultPlan":
+        actions = []
+        for token in (t.strip() for t in (spec or "").split(",")):
+            if not token:
+                continue
+            head, _, arg = token.partition("=")
+            try:
+                act, _, where = head.partition("@")
+                t_s, _, rep = where.partition(":")
+                if act not in FAULT_ACTIONS or not rep.startswith("r"):
+                    raise ValueError(token)
+                actions.append(FaultAction(
+                    t_s=float(t_s), replica=int(rep[1:]), action=act,
+                    arg=arg or None,
+                ))
+            except (ValueError, IndexError):
+                raise ValueError(
+                    f"bad fault token {token!r} (grammar: "
+                    f"ACTION@T:rR[=ARG], ACTION in {FAULT_ACTIONS})"
+                ) from None
+        return cls(actions=tuple(sorted(actions, key=lambda a: a.t_s)))
+
+    def to_spec(self) -> str:
+        return ",".join(a.token() for a in self.actions)
+
+    @classmethod
+    def from_env(cls, env=None) -> "FleetFaultPlan":
+        return cls.parse((env or os.environ).get(FLEET_FAULTS_ENV, ""))
+
+    @classmethod
+    def seeded(cls, seed: int, n_replicas: int, *, t_span: float = 4.0,
+               n_faults: int = 2,
+               kinds=("sigkill", "wedge")) -> "FleetFaultPlan":
+        """A seeded random storm: same seed => same plan (the chaos
+        acceptance e2e's determinism precondition)."""
+        rng = random.Random(seed)
+        actions = []
+        for _ in range(n_faults):
+            act = kinds[rng.randrange(len(kinds))]
+            arg = None
+            if act == "wedge":
+                arg = f"{rng.uniform(1.0, 3.0):.2f}"
+            elif act == "error":
+                infra = sorted(backend_mod.BREAKER_KINDS)
+                arg = infra[rng.randrange(len(infra))]
+            actions.append(FaultAction(
+                t_s=round(rng.uniform(0.2, t_span), 2),
+                replica=rng.randrange(n_replicas), action=act, arg=arg,
+            ))
+        return cls(actions=tuple(sorted(actions, key=lambda a: a.t_s)))
+
+    def due(self, t_from: float, t_to: float) -> list[FaultAction]:
+        """Actions scheduled in ``[t_from, t_to)`` (storm-relative
+        seconds) — the harness polls this each round."""
+        return [a for a in self.actions if t_from <= a.t_s < t_to]
+
+
+# ----------------------------------------------------------------------
+# Replica supervisor.
+# ----------------------------------------------------------------------
+
+class ReplicaHealth:
+    """One replica's lease + breaker state (supervisor-internal)."""
+
+    __slots__ = ("replica", "state", "last_heartbeat", "hb_seen",
+                 "started_at", "restarts", "restart_at", "breaker",
+                 "hb_count")
+
+    def __init__(self, replica, now: float, breaker):
+        self.replica = replica
+        self.state = STARTING
+        self.last_heartbeat: float | None = None
+        self.hb_seen = False
+        self.started_at = now
+        self.restarts = 0          # completed kill→respawn cycles.
+        self.restart_at: float | None = None
+        self.breaker = breaker
+        self.hb_count = 0
+
+
+class ReplicaSupervisor:
+    """Drive each replica's health machine from heartbeats, classified
+    errors, and observed exits; hand the harness a list of actions to
+    execute (``kill`` / ``failover`` / ``spawn`` / ``quarantine``).
+
+    The supervisor is pure host logic on an injected clock — the
+    subprocess side lives in ``tools/fleet_local.py``; tier-1 tests
+    drive this class with a fake clock and no processes at all."""
+
+    def __init__(self, replica_ids, *, lease_s: float = 1.0,
+                 suspect_misses: int = 2, down_misses: int = 5,
+                 boot_grace_s: float = 120.0,
+                 backoff: backend_mod.BackoffPolicy | None = None,
+                 quarantine_after: int = 3,
+                 breaker_threshold: int = 3,
+                 clock=time.monotonic, emit=None,
+                 rng: random.Random | None = None):
+        if suspect_misses >= down_misses:
+            raise ValueError("suspect_misses must be < down_misses")
+        self.lease_s = float(lease_s)
+        self.suspect_misses = suspect_misses
+        self.down_misses = down_misses
+        self.boot_grace_s = float(boot_grace_s)
+        self.backoff = backoff or backend_mod.BackoffPolicy(
+            initial_s=0.5, factor=2.0, max_s=30.0, jitter=0.0
+        )
+        self.quarantine_after = int(quarantine_after)
+        self.clock = clock
+        self.emit = _emit_fn(emit)
+        self._rng = rng or random.Random(0)
+        self._seq = 0
+        self.replicas: dict = {}
+        now = self.clock()
+        for rid in replica_ids:
+            self.replicas[rid] = ReplicaHealth(
+                rid, now,
+                backend_mod.CircuitBreaker(
+                    failure_threshold=breaker_threshold,
+                    policy=self.backoff, clock=clock, rng=self._rng,
+                ),
+            )
+
+    # ---------------------------------------------------- transitions --
+    def _transition(self, h: ReplicaHealth, to: str, reason: str) -> None:
+        if h.state == to:
+            return
+        self._seq += 1
+        self.emit(kind="transition", replica=h.replica,
+                  from_state=h.state, to_state=to, reason=reason,
+                  seq=self._seq)
+        h.state = to
+
+    def state(self, rid) -> str:
+        return self.replicas[rid].state
+
+    def routable(self) -> list:
+        return [rid for rid, h in self.replicas.items()
+                if h.state in ROUTABLE_STATES]
+
+    # -------------------------------------------------------- signals --
+    def heartbeat(self, rid, now: float | None = None,
+                  seq: int | None = None) -> None:
+        h = self.replicas[rid]
+        if h.state == QUARANTINED:
+            return  # a poison replica's zombie heartbeat changes nothing.
+        now = self.clock() if now is None else now
+        h.last_heartbeat = now
+        h.hb_seen = True
+        h.hb_count += 1
+        if h.state in (STARTING, SUSPECT, DOWN, RESTARTING):
+            self._transition(h, UP, "heartbeat")
+
+    def report_error(self, rid, kind: str, detail: str = "") -> list:
+        """A classified ``BackendError`` kind surfaced by a replica.
+        Infra kinds strike the replica's circuit breaker (the PR-6
+        taxonomy boundary: ``compile_error`` NEVER does — a program bug
+        must not get a healthy replica killed). An opened breaker
+        declares the replica down. Returns harness actions."""
+        h = self.replicas[rid]
+        self.emit(kind="replica_error", replica=rid, error_kind=kind,
+                  detail=detail[:300])
+        if kind not in backend_mod.BREAKER_KINDS:
+            return []
+        h.breaker.record_failure(kind)
+        if (h.breaker.state == backend_mod.OPEN
+                and h.state in ROUTABLE_STATES):
+            return self._declare_down(
+                h, f"circuit open ({kind})", self.clock()
+            )
+        return []
+
+    def notify_exit(self, rid, returncode: int | None = None) -> list:
+        """The harness saw the replica process exit. Returns actions."""
+        h = self.replicas[rid]
+        if h.state in ROUTABLE_STATES:
+            return self._declare_down(
+                h, f"process exited rc={returncode}", self.clock()
+            )
+        return []
+
+    def _declare_down(self, h: ReplicaHealth, reason: str,
+                      now: float) -> list:
+        self._transition(h, DOWN, reason)
+        actions = [("kill", h.replica), ("failover", h.replica)]
+        h.restarts += 1
+        h.hb_seen = False
+        if h.restarts > self.quarantine_after:
+            self._transition(
+                h, QUARANTINED,
+                f"poison replica: {h.restarts - 1} restart cycles burned",
+            )
+            self.emit(kind="quarantine", replica=h.replica,
+                      cycles=h.restarts - 1)
+            actions.append(("quarantine", h.replica))
+        else:
+            delay = self.backoff.delay(h.restarts - 1, self._rng)
+            h.restart_at = now + delay
+            self._transition(h, RESTARTING, reason)
+            self.emit(kind="restart", replica=h.replica,
+                      attempt=h.restarts, delay_s=round(delay, 3))
+        return actions
+
+    # ----------------------------------------------------------- tick --
+    def tick(self, now: float | None = None) -> list:
+        """Advance lease accounting. Returns harness actions:
+        ``("kill", rid)`` / ``("failover", rid)`` / ``("spawn", rid)`` /
+        ``("quarantine", rid)``."""
+        now = self.clock() if now is None else now
+        actions: list = []
+        for h in self.replicas.values():
+            if h.state in (UP, SUSPECT, STARTING):
+                if not h.hb_seen:
+                    if now - h.started_at >= self.boot_grace_s:
+                        actions += self._declare_down(
+                            h, "boot deadline exceeded", now
+                        )
+                    continue
+                misses = (now - h.last_heartbeat) / self.lease_s
+                if misses >= self.down_misses:
+                    actions += self._declare_down(
+                        h, f"{int(misses)} missed heartbeat leases", now
+                    )
+                elif misses >= self.suspect_misses and h.state == UP:
+                    self._transition(
+                        h, SUSPECT,
+                        f"{int(misses)} missed heartbeat leases",
+                    )
+            elif h.state == RESTARTING:
+                if h.restart_at is not None and now >= h.restart_at:
+                    h.restart_at = None
+                    h.started_at = now
+                    actions.append(("spawn", h.replica))
+                elif (h.restart_at is None
+                      and not h.hb_seen
+                      and now - h.started_at >= self.boot_grace_s):
+                    # The respawn itself never booted: burn another cycle.
+                    actions += self._declare_down(
+                        h, "respawn boot deadline exceeded", now
+                    )
+        return actions
+
+
+# ----------------------------------------------------------------------
+# Fleet front: admission + routing + failover bookkeeping.
+# ----------------------------------------------------------------------
+
+class FleetFront:
+    """ONE admission front over N replicas.
+
+    Owns the hardened :class:`AdmissionQueue` (per-tenant token buckets,
+    weighted-fair priority dequeue), routes admitted requests by
+    ``(family, bucket)`` through the :class:`HashRing`, tracks in-flight
+    ownership, and on a replica death re-dispatches that replica's
+    incomplete requests to a healthy one — same ``request_id``, same
+    ``trace_id``, full replay (bit-identical by the lane-independence
+    contract). Completion is front-authoritative: the FIRST result row
+    per request wins; any duplicate (a restarted replica re-serving work
+    that was already failed over) is counted, emitted as a
+    ``duplicate_result`` fleet event, and dropped — no request is ever
+    double-completed.
+
+    Transport-agnostic: ``send(replica_id, op_dict)`` is injected
+    (``tools/fleet_local.py`` appends to per-replica inbox jsonls;
+    tests use in-memory queues)."""
+
+    def __init__(self, replica_ids, coverage, *, send,
+                 buckets=(8, 16, 32), capacity: int = 1024,
+                 tenants: dict | None = None,
+                 supervisor: ReplicaSupervisor | None = None,
+                 clock=time.monotonic, metrics=None, tracer=None):
+        self.replica_ids = list(replica_ids)
+        self.send = send
+        self.buckets = tuple(sorted(buckets))
+        self.supervisor = supervisor
+        self.clock = clock
+        self.metrics = metrics
+        self.tracer = tracer
+        self.emit_fleet = _emit_fn(metrics)
+        self.ring = HashRing(self.replica_ids)
+        self.queue = queue_mod.AdmissionQueue(
+            coverage, capacity=capacity, clock=clock,
+            emit=self._emit_serving, tracer=tracer, tenants=tenants,
+        )
+        self.tickets: dict[str, queue_mod.Ticket] = {}
+        self.requests: dict[str, queue_mod.ScenarioRequest] = {}
+        self.inflight: dict[str, object] = {}   # request_id -> replica.
+        self.results: dict[str, dict] = {}      # first result row wins.
+        self.duplicates: list[dict] = []
+        self.failovers = 0
+        self._failover_spans: dict[str, object] = {}
+
+    # --------------------------------------------------------- events --
+    def _emit_serving(self, **fields) -> None:
+        if self.metrics is not None:
+            self.metrics.emit("serving_event", **fields)
+        if (fields.get("kind") == "rejected"
+                and fields.get("reason") == queue_mod.REASON_TENANT_RATE):
+            # The throttle ALSO lands in the fleet vocabulary: the
+            # run_health fleet section's per-tenant throttle counts.
+            self.emit_fleet(kind="tenant_rejected",
+                            tenant=fields.get("tenant"),
+                            request_id=fields.get("request_id"),
+                            reason=queue_mod.REASON_TENANT_RATE)
+
+    # --------------------------------------------------------- submit --
+    def submit(self, request: queue_mod.ScenarioRequest
+               ) -> queue_mod.Ticket:
+        """Admit or reject (structured, never an exception — the chaos
+        storm's front loop runs this unguarded by design)."""
+        ticket = self.queue.submit(request)
+        self.tickets[ticket.request.request_id] = ticket
+        if ticket.status == queue_mod.PENDING:
+            # ticket.request, NOT the caller's argument: admission mints
+            # trace_id onto a replaced request (the server.py rule).
+            self.requests[ticket.request.request_id] = ticket.request
+        return ticket
+
+    # ------------------------------------------------------- dispatch --
+    def routable(self) -> list:
+        if self.supervisor is None:
+            return list(self.replica_ids)
+        return self.supervisor.routable()
+
+    def pump(self) -> int:
+        """One routing round: expire deadlines, then flush each family's
+        pending group to the replica owning its ``(family, bucket)``
+        key. Requests HOLD at the front while no replica is routable
+        (nothing is lost during a full-fleet outage). Returns the number
+        of requests dispatched."""
+        for t in self.queue.expire_deadlines():
+            self.requests.pop(t.request.request_id, None)
+        alive = set(self.routable())
+        if not alive:
+            return 0
+        sent = 0
+        for family in self.queue.families_pending():
+            group = self.queue.take(family, self.queue.depth(family))
+            bucket = bucket_hint(len(group), self.buckets)
+            target = self.ring.route(f"{family}:{bucket}", alive)
+            for ticket in group:
+                self._dispatch(ticket.request, target)
+                ticket.slo.t_admit = self.clock()
+                if ticket.trace is not None:
+                    ticket.trace.admitted(replica=str(target))
+                sent += 1
+        return sent
+
+    def _dispatch(self, request, replica) -> None:
+        self.inflight[request.request_id] = replica
+        self.send(replica, {"op": "submit", "request": request.to_json()})
+
+    # ------------------------------------------------------- failover --
+    def failover(self, dead_replica) -> list[str]:
+        """Re-dispatch every incomplete request owned by
+        ``dead_replica`` to a healthy replica, on the SAME trace_id.
+        The open ``guard_fallback`` span (member = the request's trace)
+        runs until the re-served completion arrives, so the critical
+        path attributes the whole re-serve to the ``retry`` segment."""
+        t_detect = self.clock()
+        alive = set(self.routable()) - {dead_replica}
+        moved: list[str] = []
+        for rid, owner in sorted(self.inflight.items()):
+            if owner != dead_replica or rid in self.results:
+                continue
+            request = self.requests.get(rid)
+            if request is None:
+                continue
+            # Best effort: the restarted replica must not re-serve work
+            # that moved (a lost cancel only costs a deduped duplicate).
+            self.send(dead_replica,
+                      {"op": "cancel", "request_id": rid})
+            bucket = bucket_hint(1, self.buckets)
+            target = (self.ring.route(f"{request.family}:{bucket}", alive)
+                      if alive else None)
+            if self.tracer is not None and request.trace_id is not None:
+                span = self.tracer.begin(
+                    trace_mod.GUARD_FALLBACK, parent=None,
+                    trace_id=request.trace_id,
+                    members=[request.trace_id], request_id=rid,
+                    failover=True, from_replica=str(dead_replica),
+                    to_replica=str(target),
+                )
+                self._failover_spans[rid] = span
+            if target is None:
+                # Full-fleet outage: hold at the front; the next pump()
+                # with a routable replica re-dispatches.
+                self.inflight.pop(rid, None)
+                self.queue._pending.setdefault(
+                    request.family, {}
+                ).setdefault(request.tenant, []).append(
+                    self.tickets[rid]
+                )
+            else:
+                self._dispatch(request, target)
+            self.failovers += 1
+            latency = self.clock() - t_detect
+            self.emit_fleet(
+                kind="failover", request_id=rid,
+                from_replica=str(dead_replica), to_replica=str(target),
+                trace_id=request.trace_id, latency_s=round(latency, 6),
+            )
+            moved.append(rid)
+        return moved
+
+    # ------------------------------------------------------ completion --
+    def deliver_result(self, row: dict) -> bool:
+        """One replica outbox row ({request_id, status, digest, ...}).
+        First result wins; duplicates are dropped and counted. Returns
+        True when the row resolved a ticket."""
+        rid = row.get("request_id")
+        if rid is None or rid in self.results:
+            self.duplicates.append(row)
+            self.emit_fleet(kind="duplicate_result", request_id=rid,
+                            replica=str(row.get("replica")))
+            return False
+        self.results[rid] = row
+        self.inflight.pop(rid, None)
+        ticket = self.tickets.get(rid)
+        status = row.get("status", queue_mod.COMPLETED)
+        span = self._failover_spans.pop(rid, None)
+        if span is not None:
+            self.tracer.end(span, status=status)
+        if ticket is None or ticket.done:
+            return False
+        ticket.slo.t_complete = self.clock()
+        ticket.steps_served = int(row.get("steps_served", 0))
+        ticket.result = row.get("digest")
+        if ticket.trace is not None:
+            ticket.trace.resolve(status, replica=str(row.get("replica")))
+        ticket._resolve(status, row.get("reason"))
+        if self.metrics is not None:
+            self.metrics.emit(
+                "serving_event", kind=status, request_id=rid,
+                family=ticket.request.family,
+                tenant=ticket.request.tenant,
+                replica=str(row.get("replica")),
+                slo=ticket.slo.to_event(),
+            )
+        return True
+
+    # ----------------------------------------------------------- stats --
+    def unresolved(self) -> list[str]:
+        return sorted(rid for rid, t in self.tickets.items()
+                      if not t.done)
+
+    def stats(self) -> dict:
+        by_status: dict[str, int] = {}
+        for t in self.tickets.values():
+            by_status[t.status] = by_status.get(t.status, 0) + 1
+        by_tenant: dict[str, dict] = {}
+        for t in self.tickets.values():
+            bt = by_tenant.setdefault(
+                t.request.tenant, {"submitted": 0, "completed": 0,
+                                   "rejected": 0}
+            )
+            bt["submitted"] += 1
+            if t.status == queue_mod.COMPLETED:
+                bt["completed"] += 1
+            elif t.status == queue_mod.REJECTED:
+                bt["rejected"] += 1
+        return {
+            "requests": len(self.tickets),
+            **by_status,
+            "failovers": self.failovers,
+            "duplicates_dropped": len(self.duplicates),
+            "tenants": by_tenant,
+        }
+
+
+# ----------------------------------------------------------------------
+# Result digest (replica-side; the cross-process bit-identity token).
+# ----------------------------------------------------------------------
+
+def result_digest(result) -> str:
+    """sha256 over the result pytree's leaf bytes (+ shape/dtype) — the
+    token the chaos acceptance compares against the fault-free run.
+    Lazy jax import: only replica processes call this."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(result):
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str((a.shape, a.dtype.str)).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()

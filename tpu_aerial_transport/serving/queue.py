@@ -44,6 +44,9 @@ REASON_QUEUE_FULL = "queue_full"
 REASON_NO_COVERAGE = "no_bucket_coverage"
 REASON_BAD_HORIZON = "horizon_not_chunk_aligned"
 REASON_DEADLINE_SPENT = "deadline_already_passed"
+REASON_TENANT_RATE = "tenant_rate_limited"
+
+DEFAULT_TENANT = "default"
 
 # Deadline-miss classification.
 MISSED_IN_QUEUE = "in_queue"
@@ -70,6 +73,11 @@ class ScenarioRequest:
     request_id: str = dataclasses.field(
         default_factory=lambda: f"req{next(_req_counter):06d}"
     )
+    # Multi-tenant admission (serving fleet tier): the tenant the
+    # request bills against — rate limits, weighted-fair dequeue share
+    # and priority class come from the queue's per-tenant policy table,
+    # never from the (client-controlled) request itself.
+    tenant: str = DEFAULT_TENANT
     # Distributed-tracing context (obs.trace): clients propagating an
     # upstream trace set it; otherwise admission mints one when the
     # server runs a tracer. Journaled with the request so a resumed
@@ -86,6 +94,8 @@ class ScenarioRequest:
             "deadline_s": (None if self.deadline_s is None
                            else float(self.deadline_s)),
             **({"trace_id": self.trace_id} if self.trace_id else {}),
+            **({"tenant": self.tenant}
+               if self.tenant != DEFAULT_TENANT else {}),
         }
 
     @classmethod
@@ -96,6 +106,7 @@ class ScenarioRequest:
             deadline_s=obj.get("deadline_s"),
             request_id=obj["request_id"],
             trace_id=obj.get("trace_id"),
+            tenant=obj.get("tenant", DEFAULT_TENANT),
         )
 
 
@@ -159,17 +170,66 @@ class Ticket:
                 + (f", {self.reason}" if self.reason else "") + ")")
 
 
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """Admission policy for one tenant.
+
+    ``rate_per_s``/``burst`` parameterize a token bucket: each submit
+    spends one token, tokens refill continuously at ``rate_per_s`` up to
+    ``burst``; an empty bucket rejects with the structured
+    ``tenant_rate_limited`` reason (never an exception in the front
+    loop). ``rate_per_s=None`` disables the bucket (the default tenant's
+    policy, so single-tenant callers see the pre-fleet behavior
+    byte-for-byte). ``weight`` is the tenant's weighted-fair dequeue
+    share WITHIN its priority class; ``priority`` classes dequeue
+    strictly high-to-low (an operator tier that must not queue behind
+    batch traffic — starvation of lower classes is the documented
+    trade)."""
+
+    rate_per_s: float | None = None
+    burst: int = 8
+    weight: float = 1.0
+    priority: int = 0
+
+
+class _TokenBucket:
+    """Continuous-refill token bucket on the queue's clock domain."""
+
+    def __init__(self, policy: TenantPolicy, now: float):
+        self.rate = float(policy.rate_per_s)
+        self.capacity = max(1.0, float(policy.burst))
+        self.tokens = self.capacity
+        self.t_last = now
+
+    def try_take(self, now: float) -> bool:
+        self.tokens = min(self.capacity,
+                          self.tokens + self.rate * (now - self.t_last))
+        self.t_last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
 class AdmissionQueue:
-    """Bounded FIFO with admission control.
+    """Bounded multi-tenant queue with admission control.
 
     ``coverage`` maps a family name to its served chunk length (``int``)
     or ``None`` when the family has no compiled-bucket coverage (unknown
     family, or — in strict bundled mode — no bundle entry/variant); the
     server supplies it so the queue never imports device code. ``emit``
-    is the server's ``serving_event`` sink (may be None)."""
+    is the server's ``serving_event`` sink (may be None).
+
+    ``tenants`` maps tenant names to :class:`TenantPolicy`; tenants not
+    in the table get the default policy (unlimited rate, weight 1,
+    priority 0), so the single-tenant path is unchanged. ``submit`` is
+    thread-safe (one lock over queue state; ticket ids come from a
+    process-global counter), the fleet front's concurrent-submitter
+    contract."""
 
     def __init__(self, coverage, capacity: int = 256,
-                 clock=time.monotonic, emit=None, tracer=None):
+                 clock=time.monotonic, emit=None, tracer=None,
+                 tenants: dict[str, TenantPolicy] | None = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.coverage = coverage
@@ -177,13 +237,26 @@ class AdmissionQueue:
         self.clock = clock
         self.emit = emit or (lambda **kw: None)
         self.tracer = tracer  # obs.trace.Tracer | None (zero-cost off).
-        self._pending: dict[str, list[Ticket]] = {}  # family -> FIFO.
+        self.tenants = dict(tenants or {})
+        self._default_policy = TenantPolicy()
+        self._buckets: dict[str, _TokenBucket] = {}
+        # family -> tenant -> FIFO (arrival order within a tenant; the
+        # cross-tenant order is weighted-fair at take() time).
+        self._pending: dict[str, dict[str, list[Ticket]]] = {}
+        # Weighted-fair bookkeeping: dequeues charged per (family,
+        # tenant), normalized by weight at selection time.
+        self._served: dict[tuple[str, str], float] = {}
+        self._lock = threading.Lock()
+
+    def policy(self, tenant: str) -> TenantPolicy:
+        return self.tenants.get(tenant, self._default_policy)
 
     # ------------------------------------------------------ admission --
     def submit(self, request: ScenarioRequest) -> Ticket:
         """Admit or reject one request. ALWAYS returns a resolved-or-
         pending ticket (rejection is a structured status + reason +
-        ``serving_event``, never an exception)."""
+        ``serving_event``, never an exception). Safe to call from
+        multiple threads concurrently."""
         if self.tracer is not None and request.trace_id is None:
             # Mint the trace context ON the request so journal replays /
             # resumes keep the same trace identity.
@@ -191,44 +264,47 @@ class AdmissionQueue:
                 request, trace_id=trace_mod.new_trace_id()
             )
         ticket = Ticket(request)
-        now = self.clock()
-        ticket.slo.t_submit = now
-        if request.deadline_s is not None:
-            ticket.slo.deadline_at = now + float(request.deadline_s)
-        if self.tracer is not None:
-            root = self.tracer.begin(
-                trace_mod.REQUEST, parent=None,
-                trace_id=request.trace_id,
-                request_id=request.request_id, family=request.family,
-                horizon=int(request.horizon),
-            )
-            ticket.trace = trace_mod.RequestTrace(self.tracer, root)
+        with self._lock:
+            now = self.clock()
+            ticket.slo.t_submit = now
+            if request.deadline_s is not None:
+                ticket.slo.deadline_at = now + float(request.deadline_s)
+            if self.tracer is not None:
+                root = self.tracer.begin(
+                    trace_mod.REQUEST, parent=None,
+                    trace_id=request.trace_id,
+                    request_id=request.request_id, family=request.family,
+                    horizon=int(request.horizon),
+                )
+                ticket.trace = trace_mod.RequestTrace(self.tracer, root)
 
-        reason = self._admission_reason(request, now)
-        if reason is not None:
-            ticket._resolve(REJECTED, reason)
-            self.emit(kind="rejected", request_id=request.request_id,
-                      family=request.family, reason=reason,
-                      depth=self.depth())
+            reason = self._admission_reason(request, now)
+            if reason is not None:
+                ticket._resolve(REJECTED, reason)
+                self.emit(kind="rejected", request_id=request.request_id,
+                          family=request.family, reason=reason,
+                          tenant=request.tenant, depth=self._depth())
+                if ticket.trace is not None:
+                    # Terminal span: the rejection IS the request's trace.
+                    ticket.trace.resolve(REJECTED, reason=reason)
+                return ticket
+
             if ticket.trace is not None:
-                # Terminal span: the rejection IS the request's trace.
-                ticket.trace.resolve(REJECTED, reason=reason)
+                ticket.trace.queue_span = self.tracer.begin(
+                    trace_mod.QUEUE_WAIT, parent=ticket.trace.request_span,
+                    request_id=request.request_id, family=request.family,
+                )
+            self._pending.setdefault(request.family, {}).setdefault(
+                request.tenant, []
+            ).append(ticket)
+            self.emit(kind="submitted", request_id=request.request_id,
+                      family=request.family, horizon=request.horizon,
+                      tenant=request.tenant, depth=self._depth())
             return ticket
-
-        if ticket.trace is not None:
-            ticket.trace.queue_span = self.tracer.begin(
-                trace_mod.QUEUE_WAIT, parent=ticket.trace.request_span,
-                request_id=request.request_id, family=request.family,
-            )
-        self._pending.setdefault(request.family, []).append(ticket)
-        self.emit(kind="submitted", request_id=request.request_id,
-                  family=request.family, horizon=request.horizon,
-                  depth=self.depth())
-        return ticket
 
     def _admission_reason(self, request: ScenarioRequest,
                           now: float) -> str | None:
-        if self.depth() >= self.capacity:
+        if self._depth() >= self.capacity:
             return REASON_QUEUE_FULL
         chunk_len = self.coverage(request.family)
         if chunk_len is None:
@@ -237,45 +313,88 @@ class AdmissionQueue:
             return REASON_BAD_HORIZON
         if request.deadline_s is not None and request.deadline_s <= 0:
             return REASON_DEADLINE_SPENT
+        # Token bucket LAST: a malformed request is rejected as such
+        # (and costs the tenant nothing), not masked as throttling.
+        policy = self.policy(request.tenant)
+        if policy.rate_per_s is not None:
+            bucket = self._buckets.get(request.tenant)
+            if bucket is None:
+                bucket = self._buckets[request.tenant] = _TokenBucket(
+                    policy, now
+                )
+            if not bucket.try_take(now):
+                return REASON_TENANT_RATE
         return None
 
     # ------------------------------------------------------- draining --
-    def depth(self, family: str | None = None) -> int:
+    def _depth(self, family: str | None = None) -> int:
         if family is not None:
-            return len(self._pending.get(family, []))
-        return sum(len(v) for v in self._pending.values())
+            return sum(len(q) for q in
+                       self._pending.get(family, {}).values())
+        return sum(len(q) for by_tenant in self._pending.values()
+                   for q in by_tenant.values())
+
+    def depth(self, family: str | None = None) -> int:
+        with self._lock:
+            return self._depth(family)
 
     def families_pending(self) -> list[str]:
-        return sorted(f for f, v in self._pending.items() if v)
+        with self._lock:
+            return sorted(
+                f for f, by_tenant in self._pending.items()
+                if any(by_tenant.values())
+            )
 
     def take(self, family: str, k: int) -> list[Ticket]:
-        """Pop up to ``k`` oldest pending tickets of ``family`` (the
-        batcher admits them into device lanes)."""
-        fifo = self._pending.get(family, [])
-        taken, self._pending[family] = fifo[:k], fifo[k:]
-        return taken
+        """Pop up to ``k`` pending tickets of ``family`` (the batcher
+        admits them into device lanes): strictly by priority class
+        (high first), weighted-fair across tenants within a class
+        (each dequeue charges the tenant 1/weight; the least-charged
+        tenant goes next), FIFO within a tenant."""
+        with self._lock:
+            by_tenant = self._pending.get(family, {})
+            taken: list[Ticket] = []
+            while len(taken) < k:
+                candidates = [t for t, q in by_tenant.items() if q]
+                if not candidates:
+                    break
+                top = max(self.policy(t).priority for t in candidates)
+                tenant = min(
+                    (t for t in candidates
+                     if self.policy(t).priority == top),
+                    key=lambda t: (self._served.get((family, t), 0.0), t),
+                )
+                taken.append(by_tenant[tenant].pop(0))
+                self._served[(family, tenant)] = (
+                    self._served.get((family, tenant), 0.0)
+                    + 1.0 / max(self.policy(tenant).weight, 1e-9)
+                )
+            return taken
 
     def expire_deadlines(self) -> list[Ticket]:
         """Resolve queued tickets whose deadline passed before admission:
         status ``deadline_missed``, classified ``in_queue``."""
-        now = self.clock()
         missed: list[Ticket] = []
-        for family, fifo in self._pending.items():
-            keep = []
-            for t in fifo:
-                if (t.slo.deadline_at is not None
-                        and now >= t.slo.deadline_at):
-                    t.slo.missed = MISSED_IN_QUEUE
-                    t._resolve(DEADLINE_MISSED)
-                    self.emit(kind="deadline_missed",
-                              request_id=t.request.request_id,
-                              family=family, missed=MISSED_IN_QUEUE,
-                              slo=t.slo.to_event())
-                    if t.trace is not None:
-                        t.trace.resolve(DEADLINE_MISSED,
-                                        missed=MISSED_IN_QUEUE)
-                    missed.append(t)
-                else:
-                    keep.append(t)
-            self._pending[family] = keep
+        with self._lock:
+            now = self.clock()
+            for family, by_tenant in self._pending.items():
+                for tenant, fifo in by_tenant.items():
+                    keep = []
+                    for t in fifo:
+                        if (t.slo.deadline_at is not None
+                                and now >= t.slo.deadline_at):
+                            t.slo.missed = MISSED_IN_QUEUE
+                            t._resolve(DEADLINE_MISSED)
+                            self.emit(kind="deadline_missed",
+                                      request_id=t.request.request_id,
+                                      family=family, tenant=tenant,
+                                      missed=MISSED_IN_QUEUE,
+                                      slo=t.slo.to_event())
+                            if t.trace is not None:
+                                t.trace.resolve(DEADLINE_MISSED,
+                                                missed=MISSED_IN_QUEUE)
+                            missed.append(t)
+                        else:
+                            keep.append(t)
+                    by_tenant[tenant] = keep
         return missed
