@@ -1,0 +1,19 @@
+"""HL001 seeded violation: wall-clock time flowing into deadline math
+and compared against monotonic anchors."""
+
+import time
+
+
+def admit(deadline_s):
+    deadline_at = time.time() + deadline_s  # expect: HL001
+    return deadline_at
+
+
+def expired(deadline_at):
+    anchor = time.monotonic()
+    return time.time() >= anchor  # expect: HL001
+
+
+def remaining(timeout_s):
+    timeout_at = time.time()  # expect: HL001
+    return timeout_at

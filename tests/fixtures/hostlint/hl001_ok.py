@@ -1,0 +1,18 @@
+"""HL001 clean twin: deadlines anchored on the monotonic clock; wall
+time only stamps record fields."""
+
+import time
+
+
+def admit(deadline_s):
+    deadline_at = time.monotonic() + deadline_s
+    return deadline_at
+
+
+def expired(deadline_at):
+    return time.monotonic() >= deadline_at
+
+
+def stamp(record):
+    record["ts"] = time.time()
+    return record

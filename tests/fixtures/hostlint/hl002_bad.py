@@ -1,0 +1,25 @@
+"""HL002 seeded violation: the PR-15 span-leak bug class, reconstructed
+— a harvest span begun and ended only on the success path, so any
+exception (or Ctrl-C) between begin and end leaks it open."""
+
+
+def harvest(self, batch):
+    hspan = self.tracer.begin("host_harvest", batch_id=batch.batch_id)  # expect: HL002
+    rows = batch.collect()
+    self.tracer.end(hspan, rows=len(rows))
+    return rows
+
+
+def snapshot(tracer, run_dir, carry):
+    sspan = tracer.begin("snapshot", run_dir=run_dir)  # expect: HL002
+    try:
+        save(run_dir, carry)
+        tracer.end(sspan)
+    except ValueError:
+        # Ends on ValueError only — KeyboardInterrupt still leaks it.
+        tracer.end(sspan, error="save")
+        raise
+
+
+def save(run_dir, carry):
+    return run_dir, carry

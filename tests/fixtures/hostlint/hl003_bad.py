@@ -1,0 +1,24 @@
+"""HL003 seeded violation: blocking syscalls inside `with <lock>`
+bodies — every other thread serializes behind the disk/sleep."""
+
+import subprocess
+import time
+
+
+class Registry:
+    def record(self, event):
+        with self._lock:
+            self._events.append(event)
+            self.emit(kind="submitted", request_id=event)  # expect: HL003
+
+    def flush(self, path):
+        with self._lock:
+            time.sleep(0.01)  # expect: HL003
+            return open(path)  # expect: HL003
+
+    def reap(self):
+        with self._mu:
+            self._proc = subprocess.Popen(  # expect: HL003
+                ["true"], start_new_session=True, stderr=None,
+            )
+            self._worker.join()  # expect: HL003

@@ -1,0 +1,27 @@
+"""HL003 clean twin: state mutates under the lock; emits, sleeps, and
+file work happen after release. A constant-separator str.join is not a
+thread join."""
+
+import time
+
+
+class Registry:
+    def record(self, event):
+        with self._lock:
+            self._events.append(event)
+            depth = len(self._events)
+        self.emit(kind="submitted", request_id=event, depth=depth)
+
+    def flush(self, path):
+        with self._lock:
+            pending = list(self._events)
+            self._events.clear()
+        time.sleep(0.01)
+        return ",".join(str(p) for p in pending), path
+
+    def reap(self):
+        with self._mu:
+            proc = self._proc
+            self._proc = None
+        if proc is not None:
+            proc.wait()

@@ -1,0 +1,28 @@
+"""HL004 seeded violation: two methods of one class acquire the same
+pair of locks in opposite orders (one directly nested, one through a
+self-call) — two threads can deadlock."""
+
+
+class Front:
+    def deliver(self, result):
+        with self._state_lock:
+            self._results.append(result)
+            with self._route_lock:
+                self._routes.pop(result, None)
+
+
+class Supervisor:  # expect: HL004
+    def heartbeat(self, rid):
+        with self._health_lock:
+            self._seen[rid] = True
+            self._route(rid)
+
+    def _route(self, rid):
+        with self._route_lock:
+            self._targets[rid] = rid
+
+    def failover(self, rid):
+        with self._route_lock:
+            target = self._targets.get(rid)
+            with self._health_lock:
+                self._seen[target] = False

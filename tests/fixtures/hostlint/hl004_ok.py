@@ -1,0 +1,20 @@
+"""HL004 clean twin: one global acquisition order (health before
+route), including through self-calls."""
+
+
+class Supervisor:
+    def heartbeat(self, rid):
+        with self._health_lock:
+            self._seen[rid] = True
+            self._route(rid)
+
+    def _route(self, rid):
+        with self._route_lock:
+            self._targets[rid] = rid
+
+    def failover(self, rid):
+        with self._health_lock:
+            self._seen[rid] = False
+            with self._route_lock:
+                target = self._targets.get(rid)
+        return target

@@ -1,0 +1,20 @@
+"""HL005 seeded violation: raw write-mode opens of *.jsonl paths —
+the durability contract (fsync per line) lives in
+obs.export.jsonl_append, not here."""
+
+import json
+import os
+
+EVENTS = "events.jsonl"
+
+
+def journal(run_dir, record):
+    path = os.path.join(run_dir, "journal.jsonl")
+    with open(path, "a") as fh:  # expect: HL005
+        fh.write(json.dumps(record) + "\n")
+
+
+def rewrite(run_dir, records):
+    with open(EVENTS, mode="w") as fh:  # expect: HL005
+        for r in records:
+            fh.write(json.dumps(r) + "\n")

@@ -1,0 +1,15 @@
+"""HL005 clean twin: jsonl appends go through the one fsync'd
+primitive; read-mode opens of jsonl files are fine."""
+
+import os
+
+from tpu_aerial_transport.obs import export as export_mod
+
+
+def journal(run_dir, record):
+    export_mod.jsonl_append(os.path.join(run_dir, "journal.jsonl"), record)
+
+
+def replay(run_dir):
+    with open(os.path.join(run_dir, "journal.jsonl")) as fh:
+        return fh.readlines()

@@ -1,0 +1,17 @@
+"""HL006 seeded violation: non-atomic artifact publishes — a rename
+without fsync, and a direct write into artifacts/."""
+
+import json
+import os
+
+
+def publish_manifest(path, manifest):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh)
+    os.replace(tmp, path)  # expect: HL006
+
+
+def publish_report(report):
+    with open("artifacts/report.json", "w") as fh:  # expect: HL006
+        json.dump(report, fh)

@@ -1,0 +1,18 @@
+"""HL006 clean twin: temp + fsync + os.replace — readers never observe
+a torn or empty artifact."""
+
+import json
+import os
+
+
+def publish_manifest(path, manifest):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def publish_report(report):
+    publish_manifest("artifacts/report.json", report)

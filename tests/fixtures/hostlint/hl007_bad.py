@@ -1,0 +1,14 @@
+"""HL007 seeded violation: event-vocabulary drift — a kind literal
+absent from obs/export.py's kind tables, a kind missing its minimum
+keys, and an unknown event type on a metrics writer."""
+
+
+class Replica:
+    def report(self, rid):
+        self.emit(kind="teleported", replica=rid)  # expect: HL007
+
+    def fail_over(self, rid):
+        self.emit_fleet(kind="failover", latency_s=0.5)  # expect: HL007
+
+    def boundary(self):
+        self.metrics.emit("serving_checkpoint", step=1)  # expect: HL007

@@ -1,0 +1,17 @@
+"""HL007 clean twin: kinds from the vocabulary with their minimum
+keys; dynamic kinds and **kwargs are out of AST reach and unflagged."""
+
+
+class Replica:
+    def report(self, rid):
+        self.emit(kind="heartbeat", replica=rid, seq=1)
+
+    def fail_over(self, rid):
+        self.emit_fleet(kind="failover", request_id=rid, latency_s=0.5)
+
+    def boundary(self, batch_id):
+        self.metrics.emit("serving_event", kind="batch_boundary",
+                          batch_id=batch_id, chunk=1)
+
+    def relay(self, kind, **fields):
+        self.emit(kind=kind, **fields)

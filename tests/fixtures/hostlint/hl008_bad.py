@@ -1,0 +1,19 @@
+"""HL008 seeded violation: TAT_*/TPU_AERIAL_* env reads not registered
+in analysis/knobs.py."""
+
+import os
+
+SECRET_ENV = "TAT_SECRET_MODE"
+
+
+def secret_mode():
+    return os.environ.get(SECRET_ENV, "")  # expect: HL008
+
+
+def turbo(env=None):
+    src = env or os.environ
+    return src.get("TPU_AERIAL_TURBO")  # expect: HL008
+
+
+def legacy():
+    return os.getenv("TAT_LEGACY_FLAG")  # expect: HL008

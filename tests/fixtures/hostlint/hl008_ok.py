@@ -1,0 +1,17 @@
+"""HL008 clean twin: registered knobs and non-knob env vars."""
+
+import os
+
+EFFORT_ENV = "TAT_EFFORT"
+
+
+def effort():
+    return os.environ.get(EFFORT_ENV, "auto")
+
+
+def faults(env=None):
+    return (env or os.environ).get("TAT_BACKEND_FAULTS", "")
+
+
+def unrelated():
+    return os.environ.get("HOME", "/")

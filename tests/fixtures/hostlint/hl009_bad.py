@@ -1,0 +1,14 @@
+"""HL009 seeded violation: Popen without the group-kill + stderr
+discipline."""
+
+import subprocess
+
+
+def spawn_orphan(cmd):
+    return subprocess.Popen(cmd)  # expect: HL009
+
+
+def spawn_wedgeable(cmd, out):
+    return subprocess.Popen(  # expect: HL009
+        cmd, stdout=out, start_new_session=False,
+    )

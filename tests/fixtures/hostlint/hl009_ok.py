@@ -1,0 +1,13 @@
+"""HL009 clean twin: the fleet_local discipline — its own session
+(one killpg reaps the tree) and stderr to a file."""
+
+import subprocess
+
+
+def spawn(cmd, err_file):
+    return subprocess.Popen(
+        cmd,
+        stdout=subprocess.DEVNULL,
+        stderr=err_file,
+        start_new_session=True,
+    )

@@ -1,0 +1,22 @@
+"""HL010 seeded violation: the PR-15 tracer=False bug class,
+reconstructed — truthiness gates on observability/guard parameters
+where the zero-cost contract is `is not None`."""
+
+
+def rollout_resumable(plan, tracer=None):
+    if tracer:  # expect: HL010
+        tracer.instant("resume", run_dir=plan)
+    return plan
+
+
+def make_server(metrics=None, guard=None):
+    sink = metrics or (lambda **kw: None)  # expect: HL010
+    if guard is True:  # expect: HL010
+        guard = None
+    return sink, guard
+
+
+def chunk_driver(carry, telemetry=None):
+    if not telemetry:  # expect: HL010
+        return carry
+    return telemetry.accumulate(carry)

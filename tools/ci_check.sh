@@ -24,6 +24,18 @@ fail=0
 echo "== jaxlint (Tier A) =="
 python tools/jaxlint.py "${PATHS[@]}" || fail=1
 
+echo "== jaxlint --host (Tier C: host-side concurrency/durability/observability) =="
+# Stdlib-only like Tier A (never imports jax): clock-domain mixing,
+# span leaks, blocking I/O under locks, lock-order cycles, jsonl
+# durability bypasses, non-atomic artifact publishes, event-vocabulary
+# drift, unregistered env knobs, subprocess hygiene, truthiness gates
+# on tracer/metrics params (the ISSUE-17 rules, HL001-HL010). Scans
+# its own fixed host-side tree (serving/, resilience/, obs/,
+# parallel/pods.py, tools/), so no paths are passed. Waivers are
+# per-site with written reasons in analysis/hostrules.py:HOST_WAIVERS;
+# stale or unreasoned waivers fail (HL000).
+python tools/jaxlint.py --host || fail=1
+
 echo "== jaxlint --contracts --target tpu (ring + fused-kernel + effort + env-query entrypoints) =="
 # TC106 off-chip TPU lowering gate + Tier-B trace contracts over the
 # ring-exchange entrypoints (PR 7), the whole-solve fused-ADMM kernel

@@ -7,6 +7,7 @@ Usage:
     python tools/jaxlint.py --format json tpu_aerial_transport/
     python tools/jaxlint.py --disable JL003,JL011 path/to/file.py
     python tools/jaxlint.py --contracts          # + Tier B (imports jax)
+    python tools/jaxlint.py --host               # Tier C hostlint (HL rules)
 
 Exit status: 0 clean, 1 error-severity findings (warnings too with
 --strict-warn), 2 if --assert-no-jax tripped.
@@ -38,10 +39,14 @@ def _load_by_path(name: str):
 
 
 def main(argv=None) -> int:
-    # Sibling-import order matters: rules/entrypoints first so linter's
-    # path-loaded fallback imports resolve to these exact modules.
+    # Sibling-import order matters: rules/entrypoints/host modules first
+    # so linter's path-loaded fallback imports resolve to these exact
+    # modules.
     _load_by_path("rules")
     _load_by_path("entrypoints")
+    _load_by_path("hostflow")
+    _load_by_path("knobs")
+    _load_by_path("hostrules")
     linter = _load_by_path("linter")
     return linter.main(argv)
 

@@ -1,0 +1,151 @@
+"""The environment-knob registry: every ``TAT_*`` / ``TPU_AERIAL_*``
+env var the package, tools, and bench harness read, with its owning
+resolver and documented default.
+
+Pure data, stdlib-only, no jax import — the same discipline as
+``entrypoints.py``. Tier C's HL008 flags any in-scope ``os.environ``
+read of a ``TAT_*``/``TPU_AERIAL_*`` name that is not registered here,
+and ``tests/test_hostlint.py`` greps the whole repo for knob names so
+a knob cannot be added (or retired) without updating this table — the
+perf-knob-resolver discipline from ROADMAP made machine-checkable.
+
+``resolver`` is the file whose code OWNS parsing the variable (other
+files should consume the resolver's output, not re-read the env);
+``default`` is the behavior when unset, as a human-readable string.
+The README "Configuration knobs" table is generated from this dict by
+:func:`readme_table` — regenerate with
+``python -c "import tpu_aerial_transport.analysis.knobs as k; print(k.readme_table())"``.
+"""
+
+from __future__ import annotations
+
+KNOBS: dict[str, dict[str, str]] = {
+    "TAT_MATMUL_PRECISION": {
+        "resolver": "tpu_aerial_transport/__init__.py",
+        "default": "highest (full-f32 matmuls; 'default' restores JAX's "
+                   "platform default)",
+        "doc": "jax_default_matmul_precision applied at import time.",
+    },
+    "TAT_EFFORT": {
+        "resolver": "tpu_aerial_transport/ops/socp.py",
+        "default": "auto (per-call heuristic)",
+        "doc": "Adaptive solver-effort mode for the fused ADMM ladder "
+               "(consumed via the resolver by control.cadmm too).",
+    },
+    "TPU_AERIAL_FUSED": {
+        "resolver": "tpu_aerial_transport/ops/socp.py",
+        "default": "auto (pallas off-CPU, scan on CPU)",
+        "doc": "Fused whole-solve kernel selection: pallas|scan|kernel.",
+    },
+    "TPU_AERIAL_PRECISION": {
+        "resolver": "tpu_aerial_transport/ops/socp.py",
+        "default": "auto",
+        "doc": "Solver precision mode for the fused kernel.",
+    },
+    "TPU_AERIAL_CONSENSUS": {
+        "resolver": "tpu_aerial_transport/parallel/ring.py",
+        "default": "auto",
+        "doc": "Ring consensus-exchange implementation selection.",
+    },
+    "TAT_ENV_QUERY": {
+        "resolver": "tpu_aerial_transport/envs/spatial.py",
+        "default": "auto (bucketed when the world qualifies)",
+        "doc": "Environment obstacle-query tier: bucketed|dense.",
+    },
+    "TAT_PODS_MESH": {
+        "resolver": "tpu_aerial_transport/parallel/pods.py",
+        "default": "auto (probe the device topology)",
+        "doc": "Force an SxA scenario-by-agent pod mesh, e.g. 2x4.",
+    },
+    "TAT_PODS_COORDINATOR": {
+        "resolver": "tpu_aerial_transport/parallel/pods.py",
+        "default": "unset (single-process)",
+        "doc": "Multi-process bootstrap: coordinator address.",
+    },
+    "TAT_PODS_NUM_PROCESSES": {
+        "resolver": "tpu_aerial_transport/parallel/pods.py",
+        "default": "unset (single-process)",
+        "doc": "Multi-process bootstrap: world size.",
+    },
+    "TAT_PODS_PROCESS_ID": {
+        "resolver": "tpu_aerial_transport/parallel/pods.py",
+        "default": "unset (single-process)",
+        "doc": "Multi-process bootstrap: this process's rank.",
+    },
+    "TAT_BACKEND_FAULTS": {
+        "resolver": "tpu_aerial_transport/resilience/backend.py",
+        "default": "empty (no injected faults)",
+        "doc": "Fault-injection spec for the backend guard's chaos "
+               "tests (resilience.FaultInjector.from_env).",
+    },
+    "TAT_BACKEND_DEADLINE_S": {
+        "resolver": "tpu_aerial_transport/resilience/backend.py",
+        "default": "backend.DEFAULT_DEADLINE_S",
+        "doc": "Primary-dispatch watchdog deadline override.",
+    },
+    "TAT_EXPECTED_DEVICES": {
+        "resolver": "tpu_aerial_transport/resilience/backend.py",
+        "default": "unset (no topology expectation)",
+        "doc": "Probe gate: required visible device count.",
+    },
+    "TAT_EXPECTED_PROCESSES": {
+        "resolver": "tpu_aerial_transport/resilience/backend.py",
+        "default": "unset (no topology expectation)",
+        "doc": "Probe gate: required process count.",
+    },
+    "TAT_AOT_BUNDLE_DIR": {
+        "resolver": "tpu_aerial_transport/resilience/backend.py",
+        "default": "unset (probe compiles its own executable)",
+        "doc": "AOT bundle whose precompiled probe executable "
+               "probe()/tools/probe_chip.py replay.",
+    },
+    "TAT_FLEET_FAULTS": {
+        "resolver": "tpu_aerial_transport/serving/fleet.py",
+        "default": "empty (no chaos)",
+        "doc": "Fleet chaos-storm plan (FleetFaultPlan.from_env).",
+    },
+    "TAT_XLA_CACHE_DIR": {
+        "resolver": "tpu_aerial_transport/utils/platform.py",
+        "default": ".cache/xla under the repo (empty string disables)",
+        "doc": "Persistent XLA compilation cache location, shared by "
+               "conftest, bench, bench_retry children, and AOT serving.",
+    },
+    "TAT_VIRTUAL_DEVICES": {
+        "resolver": "tpu_aerial_transport/utils/platform.py",
+        "default": "unset (caller's default; conftest pins 8)",
+        "doc": "Virtual CPU device count via XLA's "
+               "--xla_force_host_platform_device_count, applied through "
+               "apply_virtual_devices() only.",
+    },
+    "TAT_SWEEP_CELLS": {
+        "resolver": "bench.py",
+        "default": "empty (run every sweep cell)",
+        "doc": "Regex restricting which bench sweep cells run "
+               "(test/debug hook).",
+    },
+    "TAT_SWEEP_SHARDED_N": {
+        "resolver": "bench.py",
+        "default": "64",
+        "doc": "Agent count for the sharded bench cells (the "
+               "fault-injection e2e sweeps a cheap n=4 twin).",
+    },
+}
+
+# Literal PREFIX strings that legitimately appear in env-filtering code
+# (``k.startswith("TAT_PODS_")`` passthrough into pod workers) — they
+# name a family, not a knob, and the drift test skips them.
+PREFIX_PASSTHROUGHS: frozenset[str] = frozenset({"TAT_PODS_"})
+
+
+def readme_table() -> str:
+    """The README "Configuration knobs" markdown table, generated so
+    docs cannot drift from the registry."""
+    rows = ["| Knob | Resolver | Default | What it does |",
+            "| --- | --- | --- | --- |"]
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        rows.append(
+            f"| `{name}` | `{k['resolver']}` | {k['default']} "
+            f"| {k['doc']} |"
+        )
+    return "\n".join(rows)

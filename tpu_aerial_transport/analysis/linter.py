@@ -11,9 +11,11 @@ import sys
 
 if __package__:
     from tpu_aerial_transport.analysis import entrypoints as _entry
+    from tpu_aerial_transport.analysis import hostrules as _host
     from tpu_aerial_transport.analysis import rules as _rules
 else:  # loaded by file path (tools/jaxlint.py) — sibling modules on sys.path.
     import entrypoints as _entry  # type: ignore
+    import hostrules as _host  # type: ignore
     import rules as _rules  # type: ignore
 
 Finding = _rules.Finding
@@ -120,13 +122,14 @@ def render_text(findings: list[Finding]) -> str:
     return "\n".join(lines)
 
 
-def render_json(findings: list[Finding]) -> str:
+def render_json(findings: list[Finding],
+                rules: list[str] | None = None) -> str:
     return json.dumps(
         {
             "findings": [f.to_dict() for f in findings],
             "errors": sum(f.severity == "error" for f in findings),
             "warnings": sum(f.severity == "warn" for f in findings),
-            "rules": sorted(RULES),
+            "rules": sorted(RULES) if rules is None else sorted(rules),
         },
         indent=2,
     )
@@ -149,6 +152,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--contracts", action="store_true",
                     help="also run Tier-B trace contracts (imports jax)")
+    ap.add_argument("--host", action="store_true",
+                    help="run Tier C (hostlint, HL rules) over the host "
+                    "scan set — serving/, resilience/, obs/, "
+                    "parallel/pods.py, tools/ — instead of Tier A "
+                    "(pure AST, no jax import either)")
     ap.add_argument("--target", choices=("tpu", "cpu"), default=None,
                     help="ALSO AOT-lower every registered entrypoint for "
                     "this target (jax.export — no device needed) and run "
@@ -165,16 +173,23 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for rid in sorted(RULE_DOCS):
-            print(f"{rid}  {RULE_DOCS[rid]}")
+        for rid in sorted({**RULE_DOCS, **_host.HOST_RULE_DOCS}):
+            docs = RULE_DOCS if rid in RULE_DOCS else _host.HOST_RULE_DOCS
+            print(f"{rid}  {docs[rid]}")
         return 0
 
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = args.paths or [pkg_root]
     disabled = frozenset(
         s.strip() for s in args.disable.split(",") if s.strip()
     )
-    findings = lint_paths(paths, disabled)
+    if args.host:
+        paths = args.paths or _host.host_paths(os.path.dirname(pkg_root))
+        findings = _host.lint_host_files(
+            list(iter_py_files(paths)), disabled
+        )
+    else:
+        paths = args.paths or [pkg_root]
+        findings = lint_paths(paths, disabled)
 
     if args.contracts or args.target:
         sys.path.insert(0, os.path.dirname(pkg_root))
@@ -197,8 +212,9 @@ def main(argv: list[str] | None = None) -> int:
                 names=only, target=args.target, disabled=disabled
             ))
 
-    print(render_json(findings) if args.format == "json"
-          else render_text(findings))
+    json_rules = sorted(_host.HOST_RULES) if args.host else None
+    print(render_json(findings, rules=json_rules)
+          if args.format == "json" else render_text(findings))
 
     if args.assert_no_jax and "jax" in sys.modules:
         print("jaxlint: FAIL — Tier A imported jax", file=sys.stderr)

@@ -64,26 +64,56 @@ EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "rollout_summary": ("logs",),
     "backend_event": ("kind", "label"),
     "aot_serve": ("entry", "rung"),
-    # kind in {submitted, rejected, admitted, completed, deadline_missed,
-    # batch_launch, batch_boundary, preempted, resumed}; request-lifecycle
-    # kinds also carry request_id, batch kinds carry batch_id (extra
-    # fields are schema-legal — the reader contract is per-kind, rendered
-    # by tools/run_health.py's serving SLO section).
+    # Per-kind minimums live in SERVING_EVENT_KINDS (extra fields are
+    # schema-legal — the reader contract is per-kind, rendered by
+    # tools/run_health.py's serving SLO section).
     "serving_event": ("kind",),
     # One finished span (obs.trace.Span.to_row()): t1_* present for
     # spans, absent for instants; parent_id/attrs optional; track is the
     # per-process timeline the stitcher groups by.
     "trace_event": ("name", "trace_id", "span_id", "track",
                     "t0_mono", "t0_wall"),
-    # kind in {heartbeat, transition, replica_error, restart, quarantine,
-    # failover, tenant_rejected, duplicate_result}; replica-lifecycle
-    # kinds carry ``replica`` (+ heartbeat: seq/pid; transition:
-    # from/to/reason/seq; restart: attempt/delay_s), failover carries
-    # request_id/from_replica/to_replica/trace_id/latency_s,
-    # tenant_rejected carries tenant/request_id/reason — the per-kind
-    # reader contract lives in tools/run_health.py's fleet section, same
-    # convention as serving_event.
+    # Per-kind minimums live in FLEET_EVENT_KINDS (same convention as
+    # serving_event; rendered by tools/run_health.py's fleet section).
     "fleet_event": ("kind",),
+}
+
+# The serving/fleet KIND vocabularies: kind -> minimum extra keys beyond
+# the event-level required fields. These are plain literals ON PURPOSE —
+# Tier C's HL007 (analysis/hostrules.py) reads them from this module's
+# AST without importing it, so every ``kind="..."`` emitted anywhere in
+# the package is checked against this table at lint time, and
+# :func:`validate_event` enforces the same minimums at runtime. Stable
+# since each kind's introducing schema version (emitters always passed
+# these keys); extending a kind's EXTRA fields needs no bump, a new kind
+# or key does.
+SERVING_EVENT_KINDS: dict[str, tuple[str, ...]] = {
+    "submitted": ("request_id",),
+    "rejected": ("request_id", "reason"),
+    "admitted": ("request_id",),
+    "completed": ("request_id",),
+    "deadline_missed": ("request_id",),
+    "batch_launch": ("batch_id",),
+    "batch_boundary": ("batch_id",),
+    "preempted": (),
+    "resumed": (),
+}
+FLEET_EVENT_KINDS: dict[str, tuple[str, ...]] = {
+    "heartbeat": ("replica",),
+    "transition": ("replica",),
+    "replica_error": ("replica",),
+    "restart": ("replica",),
+    "quarantine": ("replica",),
+    "failover": ("request_id",),
+    "tenant_rejected": ("tenant",),
+    "duplicate_result": ("request_id",),
+}
+
+# Which kind table governs each kinded event type (disjoint vocabularies
+# — a fleet kind on a serving_event is drift, not forward compat).
+EVENT_KIND_TABLES: dict[str, dict[str, tuple[str, ...]]] = {
+    "serving_event": SERVING_EVENT_KINDS,
+    "fleet_event": FLEET_EVENT_KINDS,
 }
 
 # Events that did not exist before a given schema version: an event of
@@ -164,7 +194,10 @@ def validate_event(obj, lineno: int = 0) -> list[str]:
         )
     event = obj.get("event")
     if event not in EVENT_FIELDS:
-        errs.append(f"{where}unknown event type {event!r}")
+        errs.append(
+            f"{where}unknown event type {event!r} "
+            f"(known: {sorted(EVENT_FIELDS)})"
+        )
     elif (schema in SUPPORTED_SCHEMAS
           and schema < EVENT_MIN_SCHEMA.get(event, 0)):
         errs.append(
@@ -175,6 +208,21 @@ def validate_event(obj, lineno: int = 0) -> list[str]:
         missing = [k for k in EVENT_FIELDS[event] if k not in obj]
         if missing:
             errs.append(f"{where}event {event!r} missing fields {missing}")
+        kinds = EVENT_KIND_TABLES.get(event)
+        kind = obj.get("kind")
+        if kinds is not None and "kind" in obj:
+            if kind not in kinds:
+                errs.append(
+                    f"{where}event {event!r} has unknown kind {kind!r} "
+                    f"(known: {sorted(kinds)})"
+                )
+            else:
+                kmissing = [k for k in kinds[kind] if k not in obj]
+                if kmissing:
+                    errs.append(
+                        f"{where}event {event!r} kind {kind!r} missing "
+                        f"keys {kmissing}"
+                    )
     if not isinstance(obj.get("ts"), (int, float)):
         errs.append(f"{where}missing/non-numeric ts")
     return errs

@@ -460,6 +460,10 @@ def write_chrome_trace(path: str, rows: list[dict]) -> dict:
     tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(obj, fh)
+        fh.flush()
+        # HL006: fsync BEFORE the rename — otherwise a crash can land
+        # the rename on disk ahead of the data and publish empty bytes.
+        os.fsync(fh.fileno())
     os.replace(tmp, path)
     return obj
 

@@ -806,7 +806,16 @@ class BackendGuard:
             value, primary_rung = call_with_deadline(
                 _primary, deadline, label=label
             )
-        except Exception as e:  # noqa: BLE001 — classification decides.
+        except BaseException as e:
+            if not isinstance(e, Exception):
+                # HL002: KeyboardInterrupt/SystemExit inside the
+                # watchdogged dispatch must not leak the open span —
+                # end defensively (idempotent) and re-raise unclassified.
+                if gspan is not None:
+                    self.tracer.end(gspan, kind="interrupted")
+                raise
+            # Ordinary exceptions: classification decides (device errors
+            # have no common base class across backends).
             kind = classify(e)
             if gspan is not None:
                 # The classified kind + the rung that failed are the span

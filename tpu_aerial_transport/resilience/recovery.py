@@ -478,6 +478,18 @@ def run_chunks(
             carry = jax.tree.map(jnp.asarray, carry_host)
             carry = place(carry) if place is not None else carry
             continue
+        except BaseException:
+            # HL002: KeyboardInterrupt/SystemExit mid-chunk must not
+            # leak the open spans — end defensively (end() is
+            # idempotent) and re-raise. A handler, NOT a finally: the
+            # success path below ends cspan WITH its rung attribute,
+            # which a finally-side end would preempt.
+            if tracer is not None:
+                if sspan is not None and not sspan.ended:
+                    tracer.end(sspan, error="interrupted")
+                tracer.end(cspan, error="interrupted")
+                tracer.end(run_span, status="interrupted")
+            raise
         if tracer is not None:
             tracer.end(cspan, **({"rung": rung} if rung is not None
                                  else {}))
@@ -604,49 +616,56 @@ def resume_run(
     if tracer is not None:
         rspan = tracer.begin(trace_mod.RESUME, parent=None,
                              run_dir=run_dir)
-    skipped: list[str] = []
-    start_chunk = 0
-    carry = initial_carry
-    prior_logs: list = []
-    for step, path in reversed(
-        checkpoint.list_snapshots(run_dir, plan.carry_prefix)
-    ):
-        if max_start_chunk is not None and step + 1 > max_start_chunk:
-            skipped.append(
-                f"[beyond_cap] {path}: boundary {step + 1} > agreed "
-                f"start cap {max_start_chunk} (peer processes lost it)"
-            )
-            continue
-        try:
-            cand, _ = checkpoint.load_snapshot(
-                path, initial_carry, config_hash=check_hash
-            )
-            cand_logs = []
-            for lc in range(step + 1):
-                lpath = checkpoint.snapshot_path(
-                    run_dir, lc, plan.logs_prefix
+    try:
+        skipped: list[str] = []
+        start_chunk = 0
+        carry = initial_carry
+        prior_logs: list = []
+        for step, path in reversed(
+            checkpoint.list_snapshots(run_dir, plan.carry_prefix)
+        ):
+            if max_start_chunk is not None and step + 1 > max_start_chunk:
+                skipped.append(
+                    f"[beyond_cap] {path}: boundary {step + 1} > agreed "
+                    f"start cap {max_start_chunk} (peer processes lost it)"
                 )
-                lg, _ = checkpoint.load_snapshot(
-                    lpath, logs_template, config_hash=check_hash
+                continue
+            try:
+                cand, _ = checkpoint.load_snapshot(
+                    path, initial_carry, config_hash=check_hash
                 )
-                cand_logs.append(lg)
-        except checkpoint.SnapshotError as e:
-            skipped.append(str(e))
-            continue
-        start_chunk = step + 1
-        carry = cand
-        prior_logs = cand_logs
-        break
-    journal.append({
-        "event": "resume", "start_chunk": start_chunk,
-        "skipped": skipped[:8],
-    })
-    if isinstance(metrics, str):
-        metrics = export_mod.MetricsWriter(metrics)
-    if metrics is not None:
-        metrics.emit(
-            "resume", start_chunk=start_chunk, skipped=skipped[:8]
-        )
+                cand_logs = []
+                for lc in range(step + 1):
+                    lpath = checkpoint.snapshot_path(
+                        run_dir, lc, plan.logs_prefix
+                    )
+                    lg, _ = checkpoint.load_snapshot(
+                        lpath, logs_template, config_hash=check_hash
+                    )
+                    cand_logs.append(lg)
+            except checkpoint.SnapshotError as e:
+                skipped.append(str(e))
+                continue
+            start_chunk = step + 1
+            carry = cand
+            prior_logs = cand_logs
+            break
+        journal.append({
+            "event": "resume", "start_chunk": start_chunk,
+            "skipped": skipped[:8],
+        })
+        if isinstance(metrics, str):
+            metrics = export_mod.MetricsWriter(metrics)
+        if metrics is not None:
+            metrics.emit(
+                "resume", start_chunk=start_chunk, skipped=skipped[:8]
+            )
+    except BaseException:
+        # HL002: a snapshot-walk failure (or Ctrl-C during it) must not
+        # leak the open resume span.
+        if tracer is not None:
+            tracer.end(rspan, error="interrupted")
+        raise
     if tracer is not None:
         tracer.end(rspan, start_chunk=start_chunk,
                    skipped=len(skipped))

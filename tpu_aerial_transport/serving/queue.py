@@ -235,7 +235,9 @@ class AdmissionQueue:
         self.coverage = coverage
         self.capacity = capacity
         self.clock = clock
-        self.emit = emit or (lambda **kw: None)
+        # `is None`, not truthiness: a falsy-but-callable sink (a Mock,
+        # a partial with no __bool__ guarantee) must still be used.
+        self.emit = (lambda **kw: None) if emit is None else emit
         self.tracer = tracer  # obs.trace.Tracer | None (zero-cost off).
         self.tenants = dict(tenants or {})
         self._default_policy = TenantPolicy()
@@ -279,28 +281,37 @@ class AdmissionQueue:
                 ticket.trace = trace_mod.RequestTrace(self.tracer, root)
 
             reason = self._admission_reason(request, now)
-            if reason is not None:
-                ticket._resolve(REJECTED, reason)
-                self.emit(kind="rejected", request_id=request.request_id,
-                          family=request.family, reason=reason,
-                          tenant=request.tenant, depth=self._depth())
+            if reason is None:
                 if ticket.trace is not None:
-                    # Terminal span: the rejection IS the request's trace.
-                    ticket.trace.resolve(REJECTED, reason=reason)
-                return ticket
-
+                    ticket.trace.queue_span = self.tracer.begin(
+                        trace_mod.QUEUE_WAIT,
+                        parent=ticket.trace.request_span,
+                        request_id=request.request_id,
+                        family=request.family,
+                    )
+                self._pending.setdefault(request.family, {}).setdefault(
+                    request.tenant, []
+                ).append(ticket)
+            depth = self._depth()
+        # Emit + trace-resolve AFTER release (HL003): the metrics sink
+        # fsyncs per event and span ends write trace rows — holding the
+        # admission lock across those syscalls would serialize every
+        # concurrent submitter behind disk. Resolving the rejected
+        # ticket out here is safe: it was never appended to the pending
+        # FIFO, so no other thread can reach it until submit returns.
+        if reason is not None:
+            ticket._resolve(REJECTED, reason)
+            self.emit(kind="rejected", request_id=request.request_id,
+                      family=request.family, reason=reason,
+                      tenant=request.tenant, depth=depth)
             if ticket.trace is not None:
-                ticket.trace.queue_span = self.tracer.begin(
-                    trace_mod.QUEUE_WAIT, parent=ticket.trace.request_span,
-                    request_id=request.request_id, family=request.family,
-                )
-            self._pending.setdefault(request.family, {}).setdefault(
-                request.tenant, []
-            ).append(ticket)
-            self.emit(kind="submitted", request_id=request.request_id,
-                      family=request.family, horizon=request.horizon,
-                      tenant=request.tenant, depth=self._depth())
+                # Terminal span: the rejection IS the request's trace.
+                ticket.trace.resolve(REJECTED, reason=reason)
             return ticket
+        self.emit(kind="submitted", request_id=request.request_id,
+                  family=request.family, horizon=request.horizon,
+                  tenant=request.tenant, depth=depth)
+        return ticket
 
     def _admission_reason(self, request: ScenarioRequest,
                           now: float) -> str | None:
@@ -374,7 +385,7 @@ class AdmissionQueue:
     def expire_deadlines(self) -> list[Ticket]:
         """Resolve queued tickets whose deadline passed before admission:
         status ``deadline_missed``, classified ``in_queue``."""
-        missed: list[Ticket] = []
+        missed: list[tuple[Ticket, str, str]] = []
         with self._lock:
             now = self.clock()
             for family, by_tenant in self._pending.items():
@@ -385,16 +396,18 @@ class AdmissionQueue:
                                 and now >= t.slo.deadline_at):
                             t.slo.missed = MISSED_IN_QUEUE
                             t._resolve(DEADLINE_MISSED)
-                            self.emit(kind="deadline_missed",
-                                      request_id=t.request.request_id,
-                                      family=family, tenant=tenant,
-                                      missed=MISSED_IN_QUEUE,
-                                      slo=t.slo.to_event())
-                            if t.trace is not None:
-                                t.trace.resolve(DEADLINE_MISSED,
-                                                missed=MISSED_IN_QUEUE)
-                            missed.append(t)
+                            missed.append((t, family, tenant))
                         else:
                             keep.append(t)
                     by_tenant[tenant] = keep
-        return missed
+        # Emit + trace-resolve after release (HL003): state changed
+        # atomically above; the fsync'd events need no lock.
+        for t, family, tenant in missed:
+            self.emit(kind="deadline_missed",
+                      request_id=t.request.request_id,
+                      family=family, tenant=tenant,
+                      missed=MISSED_IN_QUEUE,
+                      slo=t.slo.to_event())
+            if t.trace is not None:
+                t.trace.resolve(DEADLINE_MISSED, missed=MISSED_IN_QUEUE)
+        return [t for t, _, _ in missed]

@@ -98,7 +98,10 @@ class ScenarioServer:
         self.tracer = tracer
         self._server_trace = (None if tracer is None
                               else trace_mod.new_trace_id())
-        self.guard = guard or backend_mod.BackendGuard(metrics=metrics)
+        # `is None`, not truthiness (the PR-15 tracer=False bug class):
+        # a caller-built guard must be used even if it tests falsy.
+        self.guard = (backend_mod.BackendGuard(metrics=metrics)
+                      if guard is None else guard)
         if self.guard.tracer is None:
             self.guard.tracer = tracer
         self.interrupt = interrupt
@@ -271,11 +274,20 @@ class ScenarioServer:
                 trace_id=self._server_trace, family=fam.name,
                 bucket=bucket,
             )
-        batch = Batch(fam, bucket, fam.template_carry_host(),
-                      self.clock, self._emit)
-        self._batches[fam.name] = batch
-        for lane, ticket in enumerate(self.queue.take(fam.name, bucket)):
-            batch.admit(ticket, lane)
+        try:
+            batch = Batch(fam, bucket, fam.template_carry_host(),
+                          self.clock, self._emit)
+            self._batches[fam.name] = batch
+            for lane, ticket in enumerate(
+                self.queue.take(fam.name, bucket)
+            ):
+                batch.admit(ticket, lane)
+        except BaseException:
+            # HL002: the forming span must not leak if admission dies
+            # (end() is idempotent, so this defensive close is free).
+            if span is not None:
+                self.tracer.end(span, error=True)
+            raise
         if span is not None:
             self.tracer.end(span, batch_id=batch.batch_id,
                             lanes=batch.lane_map())
