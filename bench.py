@@ -1433,7 +1433,8 @@ def _coldstart_cell(mode: str, platform: str) -> dict:
 
 def _serving_cell(families=("cadmm4",), n_requests: int = 64,
                   buckets=(8, 16), seed: int = 0,
-                  rate_scale: float = 2.0) -> dict:
+                  rate_scale: float = 2.0, surgery=None, dispatch=None,
+                  trace: bool = False) -> dict:
     """Continuous-batching serving-tier cell (tpu_aerial_transport/
     serving/): a Poisson request stream through the ScenarioServer on the
     jit rung, reporting completed scenario-MPC-steps/s and mean batch
@@ -1442,12 +1443,27 @@ def _serving_cell(families=("cadmm4",), n_requests: int = 64,
     bucket of arrivals per chunk wall) on any host — the acceptance bar
     is mean occupancy >= 0.75 on the CPU tier. Compilation of every
     (family, bucket) program happens in the warmup, OUTSIDE the timed
-    window, and is reported as compile_wall_s like every other cell."""
-    from tpu_aerial_transport.serving import batcher, server as server_mod
+    window, and is reported as compile_wall_s like every other cell.
+
+    ``surgery``/``dispatch`` forward the ISSUE-18 serving knobs (the
+    ``serving_surgery_*`` / ``serving_dispatch_*`` A/B cells); ``trace``
+    runs the host tracer and reports the critical-path boundary-stall
+    decomposition (surgery+publish+harvest+batch_wait per completed
+    request — the dispatch knob's flip criterion) plus a content digest
+    of every completed result so the A/B arms assert equal outputs, not
+    just comparable walls."""
+    import hashlib
+
+    from tpu_aerial_transport.obs import trace as trace_lib
+    from tpu_aerial_transport.serving import batcher, lanes
+    from tpu_aerial_transport.serving import server as server_mod
     from tpu_aerial_transport.serving.queue import ScenarioRequest
 
     fams = [batcher.make_family(f) for f in families]
     buckets = tuple(sorted(buckets))
+    surgery_mode = lanes.resolve_surgery(surgery)
+    if lanes.resolve_dispatch(dispatch) == "pipelined":
+        surgery_mode = "device"
 
     # Warm every (family, bucket) compiled program; time the warmup as
     # the cell's compile cost and one warm chunk for rate calibration.
@@ -1459,6 +1475,19 @@ def _serving_cell(families=("cadmm4",), n_requests: int = 64,
                 fam.template_carry_host(),
             )
             jax.block_until_ready(fam.batched_jit(carry, np.int32(0)))
+            if surgery_mode == "device" and fam.surgery_entry:
+                probe = ScenarioRequest(
+                    family=fam.name, horizon=fam.chunk_len,
+                    x0=(0.1, 0.0, 0.0),
+                )
+                sargs = lanes.make_surgery_args(
+                    fam.batched_template_host(b), [(0, probe)], [1], b
+                )
+                carry = jax.tree.map(
+                    lambda x: np.stack([np.asarray(x)] * b),
+                    fam.template_carry_host(),
+                )
+                jax.block_until_ready(fam.surgery_jit(carry, *sargs))
     compile_wall_s = time.perf_counter() - t0
     fam0 = fams[0]
     carry = jax.tree.map(
@@ -1470,35 +1499,144 @@ def _serving_cell(families=("cadmm4",), n_requests: int = 64,
     chunk_wall_s = max(time.perf_counter() - t0, 1e-4)
     rate_hz = rate_scale * buckets[-1] * len(fams) / chunk_wall_s
 
+    tracer = trace_lib.Tracer(track="bench") if trace else None
     srv = server_mod.ScenarioServer(
         families=fams, buckets=buckets, capacity=4 * n_requests,
+        surgery=surgery, dispatch=dispatch, tracer=tracer,
     )
     rng = np.random.default_rng(seed)
     stream = []
-    for _ in range(n_requests):
+    for i in range(n_requests):
         fam = fams[int(rng.integers(len(fams)))]
         stream.append(ScenarioRequest(
             family=fam.name,
             horizon=int(rng.integers(1, 4)) * fam.chunk_len,
             x0=tuple(float(v) for v in rng.normal(0, 1.0, 3)),
+            # Deterministic ids: the default process-global counter would
+            # make result_digest differ across arms of the same sweep.
+            request_id=f"bench{i:05d}",
         ))
+    tickets = []
     t0 = time.perf_counter()
     next_due = t0
     while stream or srv.has_work():
         now = time.perf_counter()
         while stream and now >= next_due:
-            srv.submit(stream.pop(0))
+            tickets.append(srv.submit(stream.pop(0)))
             next_due += rng.exponential(1.0 / rate_hz)
         srv.pump()
     wall_s = time.perf_counter() - t0
     stats = srv.stats()
-    return {
+    # Content digest of the completed results IN SUBMIT ORDER: the A/B
+    # arms run the same seeded stream, so equal digests mean the knob
+    # changed nothing but the wall clock (the bitwise contract).
+    h = hashlib.sha256()
+    for t in tickets:
+        if t.result is not None:
+            h.update(t.request.request_id.encode())
+            for leaf in jax.tree.leaves(t.result):
+                h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    out = {
         "scenario_mpc_steps_per_sec": stats["scenario_steps"] / wall_s,
         "mean_occupancy": stats["mean_occupancy"],
         "completed": stats.get("completed", 0),
         "requests": stats["requests"],
         "poisson_rate_hz": round(rate_hz, 1),
+        "surgery": stats["surgery"],
+        "dispatch": stats["dispatch"],
+        "result_digest": h.hexdigest()[:16],
         "compile_wall_s": compile_wall_s,
+    }
+    if tracer is not None:
+        cp = trace_lib.critical_path(tracer.rows)
+        per = cp.get("per_segment", {})
+        stall = ("batch_wait", "surgery", "publish", "harvest")
+        out["boundary_stall_s_per_request"] = (
+            sum(per[s]["mean"] for s in stall if s in per)
+        )
+        out["segments_mean_s"] = {
+            s: round(st["mean"], 6) for s, st in per.items()
+        }
+    return out
+
+
+def _serving_donate_cell(canonical: str = "cadmm4", bucket: int = 8,
+                         n_boundaries: int = 6) -> dict:
+    """Donated-vs-undonated serving boundary carry A/B — the serving
+    twin of ``chunked_resume_donate_ab``. The loop each arm times is the
+    device-surgery server's steady state: batched chunk -> lane surgery
+    (one late join, one filler reset mid-run), carry device-resident
+    throughout. The donated arm is the registered
+    ``serving.lanes:lane_surgery`` jit (TC105, donate_argnums=(0,)); the
+    undonated arm is the same program without aliasing. Bit-identity
+    fields answer the same wart question as the resume cell: can a
+    serving replica rely on donated boundary carries on THIS backend
+    (selects copy bits, so only allocation-history effects could
+    differ)."""
+    from tpu_aerial_transport.serving import batcher, lanes
+    from tpu_aerial_transport.serving.queue import ScenarioRequest
+
+    fam = batcher.make_family(canonical)
+    template_b = fam.batched_template_host(bucket)
+    probe = ScenarioRequest(
+        family=canonical, horizon=fam.chunk_len, x0=(0.2, -0.1, 0.05),
+        v0=(0.0, 0.02, 0.0),
+    )
+
+    def run_arm(donate):
+        surgery = jax.jit(
+            lanes.lane_surgery,
+            donate_argnums=(0,) if donate else (),
+        )
+        chunk = fam.batched_jit  # shared, non-donating (both arms).
+
+        def once():
+            carry = jax.tree.map(
+                lambda x: np.array(np.asarray(x), copy=True), template_b
+            )
+            for k in range(n_boundaries):
+                carry, _logs = chunk(
+                    carry, np.int32(k * fam.chunk_len)
+                )
+                joins = [(0, probe)] if k == 1 else []
+                resets = [1] if k == 2 else []
+                sargs = lanes.make_surgery_args(
+                    template_b, joins, resets, bucket
+                )
+                carry, harvested = surgery(carry, *sargs)
+            jax.block_until_ready(carry)
+            return jax.tree.map(np.asarray, carry)
+
+        t0 = time.perf_counter()
+        once()  # compile + warm.
+        compile_wall_s = time.perf_counter() - t0
+        times, finals = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            finals.append(once())
+            times.append(time.perf_counter() - t0)
+        return (float(np.median(times)) / n_boundaries * 1e3, finals,
+                compile_wall_s)
+
+    undonated_ms, finals_u, compile_u = run_arm(False)
+    donated_ms, finals_d, compile_d = run_arm(True)
+
+    def bitexact(a, b):
+        return bool(all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        ))
+
+    return {
+        "donated_ms_per_boundary": donated_ms,
+        "undonated_ms_per_boundary": undonated_ms,
+        "speedup": undonated_ms / donated_ms,
+        "donated_bitexact_vs_undonated": bitexact(
+            finals_d[-1], finals_u[-1]
+        ),
+        "donated_replay_bitexact": bitexact(finals_d[-1], finals_d[-2]),
+        "bucket": bucket, "boundaries": n_boundaries,
+        "compile_wall_s": compile_u + compile_d,
     }
 
 
@@ -2068,12 +2206,42 @@ def sweep(resume: bool = False, platform: str | None = None,
          dict(families=("cadmm4",), n_requests=64)),
         ("serving_soak_mixed",
          dict(families=("cadmm4", "centralized4"), n_requests=128)),
+        # ISSUE-18 serving-knob A/B cells (serving/lanes.py resolvers).
+        # Surgery pair: host splice vs the device-resident donated select
+        # program — flip criterion lives in lanes.resolve_surgery.
+        # Dispatch pair: sync vs double-buffered chunk dispatch (both on
+        # device surgery so ONLY the dispatch mode differs) — the flip
+        # reads boundary_stall_s_per_request at equal result_digest
+        # (lanes.resolve_dispatch). Traced so the stall decomposition is
+        # measured, not inferred.
+        ("serving_surgery_host",
+         dict(families=("cadmm4",), n_requests=48, surgery="host",
+              trace=True)),
+        ("serving_surgery_device",
+         dict(families=("cadmm4",), n_requests=48, surgery="device",
+              trace=True)),
+        ("serving_dispatch_sync",
+         dict(families=("cadmm4",), n_requests=48, surgery="device",
+              dispatch="sync", trace=True)),
+        ("serving_dispatch_pipelined",
+         dict(families=("cadmm4",), n_requests=48,
+              dispatch="pipelined", trace=True)),
     ):
         if not want(key) or (key in results
                              and "error" not in results[key]):
             continue
         try:
             record(key, guarded_cell(key, _serving_cell, **skw))
+        except Exception as e:
+            record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    # Donated-vs-undonated serving boundary carry (the serving twin of
+    # chunked_resume_donate_ab — TC105's serving-side wart question).
+    key = "serving_donate_ab"
+    if want(key) and not (key in results
+                          and "error" not in results[key]):
+        try:
+            record(key, guarded_cell(key, _serving_donate_cell))
         except Exception as e:
             record(key, {"error": f"{type(e).__name__}: {e}"[:300]})
 
@@ -2276,6 +2444,13 @@ def sweep(resume: bool = False, platform: str | None = None,
         if "donated_ms_per_step" in r:  # the donated-resume A/B cell.
             print(f"| {key} | donated {r['donated_ms_per_step']:.2f} ms vs "
                   f"{r['undonated_ms_per_step']:.2f} ms "
+                  f"({r['speedup']:.2f}x; bitexact="
+                  f"{r['donated_bitexact_vs_undonated']}) | — | — |")
+            continue
+        if "donated_ms_per_boundary" in r:  # serving-surgery donate A/B.
+            print(f"| {key} | donated "
+                  f"{r['donated_ms_per_boundary']:.2f} ms/boundary vs "
+                  f"{r['undonated_ms_per_boundary']:.2f} ms "
                   f"({r['speedup']:.2f}x; bitexact="
                   f"{r['donated_bitexact_vs_undonated']}) | — | — |")
             continue

@@ -123,6 +123,18 @@ def main(argv=None) -> int:
                     help="explicit comma-separated wave sizes (overrides "
                          "--waves); must sum to <= --requests")
     ap.add_argument("--deadline-s", type=float, default=None)
+    ap.add_argument("--surgery", default="",
+                    help="boundary lane-surgery impl: host|device "
+                         "(default: resolver — TAT_SERVING_SURGERY else "
+                         "host)")
+    ap.add_argument("--dispatch", default="",
+                    help="chunk dispatch mode: sync|pipelined (pipelined "
+                         "double-buffers chunk k+1 and forces device "
+                         "surgery)")
+    ap.add_argument("--cache", type=int, default=0,
+                    help="content-addressed result cache size (0 = off); "
+                         "repeat submits of an identical request resolve "
+                         "without a dispatch")
     ap.add_argument("--bundle", default="")
     ap.add_argument("--require-bundle", action="store_true")
     ap.add_argument("--expect-zero-compile", action="store_true",
@@ -176,6 +188,8 @@ def main(argv=None) -> int:
         metrics=(tracer.sink if tracer is not None and tracer.sink
                  else args.metrics or None),
         tracer=tracer,
+        surgery=args.surgery or None, dispatch=args.dispatch or None,
+        cache=(args.cache or None),
     )
 
     with GracefulInterrupt() as interrupt:

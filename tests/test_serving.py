@@ -470,6 +470,259 @@ def test_run_health_serving_section(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# ISSUE 18: device-resident lane surgery, double-buffered dispatch,
+# content-addressed result cache.
+# ----------------------------------------------------------------------
+
+def _assert_same_leaves(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_device_surgery_composition_independent(cadmm_family):
+    """The composition-independence claim through the DEVICE surgery
+    path: alone / busy / late-join all produce states bitwise equal to
+    the host-surgery reference (jnp.where selects copy bits — the knob
+    may change wall clock, never values)."""
+    probe = dict(family="cadmm4", horizon=4, x0=(1.2, -0.4, 0.8))
+
+    ref_srv = _mk_server(cadmm_family)  # host default.
+    t_ref = ref_srv.submit(ScenarioRequest(request_id="ref", **probe))
+    _drain(ref_srv)
+    assert ref_srv.stats()["surgery"] == "host"
+
+    srv = _mk_server(cadmm_family, surgery="device")
+    assert srv.stats()["surgery"] == "device"
+    t_alone = srv.submit(ScenarioRequest(request_id="d_alone", **probe))
+    _drain(srv)
+
+    busy = _mk_server(cadmm_family, surgery="device")
+    tickets = [busy.submit(_req(i, horizon=(4 if i % 2 else 8)))
+               for i in range(6)]
+    t_busy = busy.submit(ScenarioRequest(request_id="d_busy", **probe))
+    busy.pump()  # chunk 1 in flight.
+    t_late = busy.submit(ScenarioRequest(request_id="d_late", **probe))
+    launched_batch = t_busy.batch_id
+    _drain(busy)
+
+    for t in tickets + [t_alone, t_busy, t_late]:
+        assert t.status == queue_mod.COMPLETED, t
+    assert t_late.batch_id == launched_batch  # joined at a boundary.
+    for t in (t_alone, t_busy, t_late):
+        _assert_same_leaves(t_ref.result, t.result)
+
+
+def test_pipelined_dispatch_bit_identity(cadmm_family):
+    """sync vs pipelined dispatch (and host vs device surgery) over a
+    mixed-horizon stream with a mid-stream late join: identical results.
+    Pipelined speculatively launches chunk k+1 before harvesting chunk k
+    — legal because the boundary plan is admission-counter arithmetic,
+    data-independent of chunk k's values."""
+    def serve(**kw):
+        srv = _mk_server(cadmm_family, **kw)
+        tickets = [srv.submit(_req(i, horizon=(8 if i % 3 else 4)))
+                   for i in range(5)]
+        srv.pump()
+        tickets.append(srv.submit(_req(99, horizon=4)))  # late join.
+        _drain(srv)
+        assert all(t.status == queue_mod.COMPLETED for t in tickets)
+        return srv, {t.request.request_id: t.result for t in tickets}
+
+    _, ref = serve()  # host + sync (the pre-knob path).
+    srv_p, got_p = serve(dispatch="pipelined")
+    assert (srv_p.stats()["surgery"], srv_p.stats()["dispatch"]) == \
+        ("device", "pipelined")
+    _, got_s = serve(surgery="device", dispatch="sync")
+    for got in (got_p, got_s):
+        assert set(got) == set(ref)
+        for rid in ref:
+            _assert_same_leaves(ref[rid], got[rid])
+
+
+@pytest.mark.parametrize("mode", [
+    dict(surgery="device"), dict(dispatch="pipelined"),
+])
+def test_device_preempt_resume_bit_identity(cadmm_family, tmp_path, mode):
+    """SIGTERM + resume through the device-surgery (and pipelined) path:
+    preemption lands at the chunk boundary with the journaled lane map
+    matching the published carry, and the merged results are bitwise the
+    uninterrupted host run's."""
+    def stream():
+        return [_req(i, horizon=6) for i in range(6)]
+
+    ref_srv = _mk_server(cadmm_family)
+    ref = {t.request.request_id: t for t in
+           [ref_srv.submit(r) for r in stream()]}
+    _drain(ref_srv)
+
+    run_dir = str(tmp_path / "run")
+    fi = FakeInterrupt()
+    srv1 = _mk_server(cadmm_family, run_dir=run_dir, interrupt=fi, **mode)
+    t1 = [srv1.submit(r) for r in stream()]
+    srv1.pump()
+    fi.triggered = "SIGTERM"
+    assert srv1.pump() is False
+    assert srv1.preempted
+    done1 = {t.request.request_id: t.result for t in t1
+             if t.status == queue_mod.COMPLETED}
+
+    srv2 = server_mod.ScenarioServer.resume(
+        run_dir, families=[cadmm_family], buckets=(4, 8), **mode)
+    _drain(srv2)
+    done2 = {rid: t.result for rid, t in srv2.tickets.items()
+             if t.status == queue_mod.COMPLETED}
+    merged = {**done1, **done2}
+    assert set(merged) == set(ref)
+    for rid in ref:
+        _assert_same_leaves(ref[rid].result, merged[rid])
+
+
+def test_host_default_zero_cost(monkeypatch):
+    """With the knobs off the server is the pre-ISSUE-18 one: host
+    surgery + sync dispatch, the surgery program is never built (no
+    hidden compile), the chunk program's lowered HLO is byte-identical
+    to what a device-knobbed process lowers (the knobs touch only
+    boundary code), and the server grew no threading primitives (the
+    pipeline is dispatch-async, not thread-based)."""
+    import inspect
+
+    from tpu_aerial_transport.serving import lanes
+
+    monkeypatch.delenv("TAT_SERVING_SURGERY", raising=False)
+    monkeypatch.delenv("TAT_SERVING_DISPATCH", raising=False)
+    assert lanes.resolve_surgery(None) == "host"
+    assert lanes.resolve_dispatch(None) == "sync"
+
+    fam = batcher.make_family("cadmm4")  # fresh: no shared jit state.
+    srv = server_mod.ScenarioServer(families=[fam], buckets=(4,))
+    t = srv.submit(_req(0, horizon=4))
+    _drain(srv)
+    assert t.status == queue_mod.COMPLETED
+    assert fam._surgery_jit is None  # host path never builds it.
+
+    carry = jax.tree.map(
+        lambda x: np.stack([np.asarray(x)] * 4),
+        fam.template_carry_host(),
+    )
+    text_default = fam.batched_jit.lower(carry, np.int32(0)).as_text()
+    monkeypatch.setenv("TAT_SERVING_SURGERY", "device")
+    fam2 = batcher.make_family("cadmm4")
+    text_device = fam2.batched_jit.lower(carry, np.int32(0)).as_text()
+    assert text_default == text_device
+
+    src = inspect.getsource(server_mod)
+    assert "import threading" not in src and "Lock(" not in src
+
+
+def test_serving_knob_resolvers(monkeypatch):
+    """Env force > config > default; bad values raise; pipelined implies
+    device surgery; device surgery rejects a mesh (the mesh boundary IS
+    host surgery via pods.host_global)."""
+    from tpu_aerial_transport.serving import lanes
+
+    monkeypatch.delenv("TAT_SERVING_SURGERY", raising=False)
+    monkeypatch.delenv("TAT_SERVING_DISPATCH", raising=False)
+    assert lanes.resolve_surgery("auto") == "host"
+    assert lanes.resolve_surgery("device") == "device"
+    with pytest.raises(ValueError):
+        lanes.resolve_surgery("gpu")
+    with pytest.raises(ValueError):
+        lanes.resolve_dispatch("async")
+
+    monkeypatch.setenv("TAT_SERVING_SURGERY", "device")
+    monkeypatch.setenv("TAT_SERVING_DISPATCH", "pipelined")
+    assert lanes.resolve_surgery(None) == "device"
+    assert lanes.resolve_surgery("host") == "device"  # force wins.
+    assert lanes.resolve_dispatch("sync") == "pipelined"
+    monkeypatch.setenv("TAT_SERVING_SURGERY", "lanes")
+    with pytest.raises(ValueError):
+        lanes.resolve_surgery(None)
+    monkeypatch.delenv("TAT_SERVING_SURGERY")
+    monkeypatch.delenv("TAT_SERVING_DISPATCH")
+
+    srv = server_mod.ScenarioServer(
+        families=["cadmm4"], buckets=(4,), dispatch="pipelined")
+    assert (srv.surgery, srv.dispatch) == ("device", "pipelined")
+    with pytest.raises(ValueError, match="single-device"):
+        server_mod.ScenarioServer(
+            families=["cadmm4"], buckets=(4,), surgery="device",
+            mesh=object())
+
+
+def test_result_cache_hit_skips_dispatch(cadmm_family, tmp_path):
+    """A repeat submit of a content-identical request (different id)
+    resolves at SUBMIT time from the cache — no admission, no batch
+    launch — bitwise equal to the computed result, with a schema-valid
+    cache_hit event and the hit surfacing in stats() and run_health."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import run_health
+
+    path = str(tmp_path / "cache.metrics.jsonl")
+    srv = _mk_server(cadmm_family, metrics=path, cache=4)
+    t1 = srv.submit(ScenarioRequest(family="cadmm4", horizon=4,
+                                    x0=(0.5, -0.2, 0.9),
+                                    request_id="orig"))
+    _drain(srv)
+    assert t1.status == queue_mod.COMPLETED
+
+    events = export_mod.read_events(path)
+    launches_before = sum(1 for e in events
+                          if e.get("kind") == "batch_launch")
+    t2 = srv.submit(ScenarioRequest(family="cadmm4", horizon=4,
+                                    x0=(0.5, -0.2, 0.9),
+                                    request_id="replay"))
+    # Resolved at submit: COMPLETED before any pump, nothing in flight.
+    assert t2.status == queue_mod.COMPLETED
+    assert not srv.has_work()
+    assert t2.steps_served == t1.steps_served
+    _assert_same_leaves(t1.result, t2.result)
+
+    assert export_mod.validate_file(path) == []
+    events = export_mod.read_events(path)
+    assert sum(1 for e in events
+               if e.get("kind") == "batch_launch") == launches_before
+    hits = [e for e in events if e.get("kind") == "cache_hit"]
+    assert len(hits) == 1 and hits[0]["request_id"] == "replay"
+    assert srv.stats()["cache"]["hits"] == 1
+
+    sv = run_health.summarize(events)["serving"]
+    assert sv["cache_hits"] == 1
+    assert sv["cache_hit_rate"] == pytest.approx(0.5)
+
+
+def test_result_cache_lru_and_keying():
+    """Unit contract of serving/cache.py: content addressing ignores the
+    request id, distinguishes payloads, and the LRU bound evicts the
+    least-recently-used entry."""
+    from tpu_aerial_transport.serving import cache as cache_mod
+
+    r = ScenarioRequest(family="cadmm4", horizon=4, x0=(0.1, 0.2, 0.3),
+                        request_id="a")
+    same = ScenarioRequest(family="cadmm4", horizon=4, x0=(0.1, 0.2, 0.3),
+                           request_id="b")
+    other = ScenarioRequest(family="cadmm4", horizon=4,
+                            x0=(0.1, 0.2, 0.30000001), request_id="c")
+    assert cache_mod.request_key("h", r) == cache_mod.request_key("h", same)
+    assert cache_mod.request_key("h", r) != cache_mod.request_key("h", other)
+    assert cache_mod.request_key("h", r) != cache_mod.request_key("g", r)
+
+    c = cache_mod.ResultCache(max_entries=2)
+    c.put("k1", {"x": np.ones(3)}, 4)
+    c.put("k2", {"x": np.zeros(3)}, 4)
+    assert c.get("k1") is not None  # touch: k1 now most-recent.
+    c.put("k3", {"x": np.full(3, 2.0)}, 8)
+    assert c.get("k2") is None  # LRU evicted.
+    hit = c.get("k1")
+    assert hit is not None and hit[1] == 4
+    # Deep-copied both ways: mutating the hit never corrupts the cache.
+    hit[0]["x"][0] = 123.0
+    assert c.get("k1")[0]["x"][0] == 1.0
+    assert c.stats()["entries"] == 2
+
+
+# ----------------------------------------------------------------------
 # The acceptance e2e (slow): zero-compile mixed-shape soak + SIGTERM.
 # ----------------------------------------------------------------------
 

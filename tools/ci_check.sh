@@ -36,7 +36,7 @@ echo "== jaxlint --host (Tier C: host-side concurrency/durability/observability)
 # stale or unreasoned waivers fail (HL000).
 python tools/jaxlint.py --host || fail=1
 
-echo "== jaxlint --contracts --target tpu (ring + fused-kernel + effort + env-query entrypoints) =="
+echo "== jaxlint --contracts --target tpu (ring + fused-kernel + effort + env-query + lane-surgery entrypoints) =="
 # TC106 off-chip TPU lowering gate + Tier-B trace contracts over the
 # ring-exchange entrypoints (PR 7), the whole-solve fused-ADMM kernel
 # entrypoints (PR 12: ops.admm_kernel:fused_solve_{interpret,pallas} —
@@ -48,7 +48,12 @@ echo "== jaxlint --contracts --target tpu (ring + fused-kernel + effort + env-qu
 # steps control.{cadmm,dd}:control_adaptive, and the bucketed
 # environment-query tier (envs.spatial:env_query_{bucketed,dense} —
 # the candidate-slab gather + shared sweep math must keep TPU-target
-# lowering clean off-chip, no waiver). The ring entries need a
+# lowering clean off-chip, no waiver), and the serving boundary
+# lane-surgery entrypoints (ISSUE 18:
+# serving.lanes:lane_surgery{,_centralized} — the donated on-device
+# select program must keep TC105 aliasing and TPU lowering clean so
+# device-resident batching can flip on without a chip round). The ring
+# entries need a
 # >=4-device mesh, so force a virtual-device CPU host through the ONE
 # shared knob (utils/platform.py TAT_VIRTUAL_DEVICES; default 4 here) —
 # min_devices/waived entries silently skip on 1-device boxes otherwise —
@@ -58,8 +63,8 @@ echo "== jaxlint --contracts --target tpu (ring + fused-kernel + effort + env-qu
 JAX_PLATFORMS=cpu \
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${TAT_VIRTUAL_DEVICES:-4}" \
 python tools/jaxlint.py --contracts --target tpu \
-    --only parallel.ring:consensus_exchange,parallel.ring:consensus_exchange_pallas,parallel.mesh:cadmm_control_sharded_ring,ops.admm_kernel:fused_solve_interpret,ops.admm_kernel:fused_solve_pallas,ops.admm_kernel:fused_solve_earlyexit_interpret,ops.admm_kernel:fused_solve_earlyexit_pallas,control.cadmm:control_adaptive,control.dd:control_adaptive,envs.spatial:env_query_bucketed,envs.spatial:env_query_dense \
-    tpu_aerial_transport/parallel/ring.py tpu_aerial_transport/ops/admm_kernel.py tpu_aerial_transport/control/cadmm.py tpu_aerial_transport/control/dd.py tpu_aerial_transport/envs/spatial.py || fail=1
+    --only parallel.ring:consensus_exchange,parallel.ring:consensus_exchange_pallas,parallel.mesh:cadmm_control_sharded_ring,ops.admm_kernel:fused_solve_interpret,ops.admm_kernel:fused_solve_pallas,ops.admm_kernel:fused_solve_earlyexit_interpret,ops.admm_kernel:fused_solve_earlyexit_pallas,control.cadmm:control_adaptive,control.dd:control_adaptive,envs.spatial:env_query_bucketed,envs.spatial:env_query_dense,serving.lanes:lane_surgery,serving.lanes:lane_surgery_centralized \
+    tpu_aerial_transport/parallel/ring.py tpu_aerial_transport/ops/admm_kernel.py tpu_aerial_transport/control/cadmm.py tpu_aerial_transport/control/dd.py tpu_aerial_transport/envs/spatial.py tpu_aerial_transport/serving/lanes.py || fail=1
 
 echo "== pods 2-process parity smoke (tools/pods_local.py) =="
 # Bounded multi-process smoke of the pods tier (parallel/pods.py): 2

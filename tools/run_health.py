@@ -202,6 +202,12 @@ def summarize(events: list[dict]) -> dict:
             )
             r = e.get("rung", "?")
             b["rungs"][r] = b["rungs"].get(r, 0) + 1
+        # Result-cache effectiveness (schema v7, serving/cache.py):
+        # hit rate over all submit-side outcomes — a cache_hit resolves
+        # at submit INSTEAD of a "submitted" event, so the denominator is
+        # their sum, not a subset.
+        cache_hits = kinds.get("cache_hit", 0)
+        cache_lookups = cache_hits + kinds.get("submitted", 0)
         out["serving"] = {
             "kinds": kinds,
             "completed": len(completed),
@@ -210,6 +216,9 @@ def summarize(events: list[dict]) -> dict:
             "latency_s": _latency_stats(lat),
             "admit_to_complete_s": _latency_stats(a2c),
             "mean_occupancy": (sum(occ) / len(occ)) if occ else None,
+            "cache_hits": cache_hits,
+            "cache_hit_rate": (cache_hits / cache_lookups
+                               if cache_lookups else None),
             "batches": batches,
         }
 
@@ -607,6 +616,11 @@ def render(summary: dict) -> None:
         if sv["mean_occupancy"] is not None:
             print(f"- mean batch occupancy: "
                   f"{sv['mean_occupancy']:.3f}")
+        if sv.get("cache_hits"):
+            rate = sv.get("cache_hit_rate")
+            print(f"- result-cache hits: {sv['cache_hits']}"
+                  + (f" (hit rate {rate:.3f})" if rate is not None
+                     else ""))
         if sv["batches"]:
             print("\n| batch | family | bucket | rungs |")
             print("|---|---|---|---|")

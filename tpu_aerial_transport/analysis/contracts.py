@@ -1028,6 +1028,58 @@ def _build_serving_chunk_centralized():
     return _serving_chunk_build("centralized4")
 
 
+def _lane_surgery_build(canonical: str):
+    """Shared builder for the on-device boundary lane-surgery entries
+    (serving/lanes.py): the family's batched carry at the smallest shape
+    bucket, pre-jitted WITH carry donation — check_entry uses the real
+    compiled object, so the TC105 aliasing count sees the donated
+    boundary carry (the server's jit rung and the bundle build both
+    start from this same registered callable). make_args exercises one
+    late-join lane and one filler reset per call (runtime mask values —
+    the compiled select program is identical for any mask)."""
+    import numpy as np
+
+    from tpu_aerial_transport.serving import batcher
+    from tpu_aerial_transport.serving import lanes
+    from tpu_aerial_transport.serving import queue as squeue
+
+    fam = batcher.make_family(canonical)
+    bucket = batcher.DEFAULT_BUCKETS[0]
+    fn = jax.jit(lanes.lane_surgery, donate_argnums=(0,))
+
+    def make_args():
+        # Fresh numpy leaves per call: the donated carry is consumed by
+        # each run, and the retrace check needs independent pytrees.
+        carry = jax.tree.map(
+            lambda x: np.stack([np.array(x, copy=True)] * bucket),
+            fam.template_carry_host(),
+        )
+        req = squeue.ScenarioRequest(
+            family=canonical, horizon=fam.chunk_len,
+            x0=(0.1, -0.2, 0.3), v0=(0.01, 0.02, -0.03),
+        )
+        # Copy the cached per-bucket template too: make_args contracts
+        # to return INDEPENDENT pytrees on every call.
+        template_b = jax.tree.map(
+            np.copy, fam.batched_template_host(bucket)
+        )
+        return (carry,) + lanes.make_surgery_args(
+            template_b, [(0, req)], [1], bucket
+        )
+
+    return fn, make_args
+
+
+@_register("serving.lanes:lane_surgery")
+def _build_lane_surgery():
+    return _lane_surgery_build("cadmm4")
+
+
+@_register("serving.lanes:lane_surgery_centralized")
+def _build_lane_surgery_centralized():
+    return _lane_surgery_build("centralized4")
+
+
 # ----------------------------------------------------------------------
 # Checks.
 # ----------------------------------------------------------------------

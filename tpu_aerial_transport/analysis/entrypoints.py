@@ -91,6 +91,7 @@ TRACED_FUNCTIONS: dict[str, tuple[str, ...]] = {
     "tpu_aerial_transport/parallel/pods.py": (
         "pods_control_step", "_physics_substeps",
     ),
+    "tpu_aerial_transport/serving/lanes.py": ("lane_surgery",),
 }
 
 # name -> short description; analysis.contracts.REGISTRY must carry
@@ -177,6 +178,15 @@ CONTRACT_ENTRYPOINTS: dict[str, str] = {
     "serving.batcher:serving_chunk_centralized":
         "serving chunk for the canonical centralized family (the mixed-"
         "stream twin of serving_chunk)",
+    "serving.lanes:lane_surgery":
+        "on-device boundary lane surgery (canonical cadmm family): "
+        "harvest-read + filler-reset + late-join select program over the "
+        "batched boundary carry, carry donated — the device-surgery "
+        "serving knob's compiled/bundled boundary surface",
+    "serving.lanes:lane_surgery_centralized":
+        "boundary lane surgery for the canonical centralized family "
+        "(same select program; per-family entry because the carry "
+        "pytree/signature differs per controller)",
     "envs.spatial:env_query_bucketed":
         "spatial-hash bucketed environment query: grid-cell candidate-"
         "slab gather + the exact dense per-tree capsule sweep over "
@@ -295,4 +305,8 @@ DONATION_CONTRACTS: dict[str, int] = {
     "harness.rollout:chunked_rollout": 6,
     "resilience.rollout:resilient_rollout_donated": 6,
     "parallel.mesh:scenario_rollout": 6,
+    # The serving boundary carry: its scenario state holds the same six
+    # physics leaves, batched over lanes.
+    "serving.lanes:lane_surgery": 6,
+    "serving.lanes:lane_surgery_centralized": 6,
 }

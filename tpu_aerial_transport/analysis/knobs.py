@@ -117,6 +117,22 @@ KNOBS: dict[str, dict[str, str]] = {
                "--xla_force_host_platform_device_count, applied through "
                "apply_virtual_devices() only.",
     },
+    "TAT_SERVING_SURGERY": {
+        "resolver": "tpu_aerial_transport/serving/lanes.py",
+        "default": "host (numpy splice on the boundary host copy)",
+        "doc": "Serving boundary lane-surgery implementation: "
+               "host|device. Device keeps the batch carry device-"
+               "resident and runs the donated select program "
+               "(serving.lanes:lane_surgery); flip criterion in the "
+               "resolver docstring.",
+    },
+    "TAT_SERVING_DISPATCH": {
+        "resolver": "tpu_aerial_transport/serving/lanes.py",
+        "default": "sync (block on chunk k before its boundary)",
+        "doc": "Serving chunk-dispatch mode: sync|pipelined. Pipelined "
+               "double-buffers — chunk k+1 dispatches before blocking "
+               "on chunk k's harvest — and forces device surgery.",
+    },
     "TAT_SWEEP_CELLS": {
         "resolver": "bench.py",
         "default": "empty (run every sweep cell)",

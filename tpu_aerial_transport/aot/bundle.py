@@ -80,6 +80,12 @@ BUCKETED_ENTRIES: dict[str, int] = {
     "parallel.mesh:scenario_rollout": 0,
     "serving.batcher:serving_chunk": 0,
     "serving.batcher:serving_chunk_centralized": 0,
+    # The boundary lane-surgery programs ride the same buckets as their
+    # chunk entries: device-surgery replicas serve BOTH per boundary, so
+    # bucket coverage must agree or admission would be zero-compile for
+    # the chunk and jit-compile for the surgery.
+    "serving.lanes:lane_surgery": 0,
+    "serving.lanes:lane_surgery_centralized": 0,
 }
 
 

@@ -373,9 +373,18 @@ INTEGRITY_KINDS = frozenset({"corrupt", "unreadable", "schema"})
 
 def serve_entry(bundle: Bundle | None, name: str, args, *,
                 jit_fallback=None, metrics=None, journal=None,
-                label: str | None = None):
+                label: str | None = None, block: bool = True):
     """Serve one entrypoint call through the fallback ladder and journal
     what this process paid. Returns ``(out, rung)``.
+
+    ``block=False`` skips the ``block_until_ready`` on the result — the
+    pipelined-dispatch path (serving/server.py): every rung's underlying
+    call (exec replay's ``execute_sharded``, the export/jit paths) is
+    natively asynchronous, so the caller gets the output handles back at
+    dispatch time and overlaps host work with device compute. The
+    journaled ``wall_s`` then measures DISPATCH cost only; execution
+    errors surface at the caller's eventual blocking read, outside any
+    fallback this ladder could have taken.
 
     ``bundle`` None (or a bundle COVERAGE miss — ``missing_entry``,
     ``signature_mismatch``, ``treedef_mismatch``, a stale/absent exec)
@@ -411,7 +420,8 @@ def serve_entry(bundle: Bundle | None, name: str, args, *,
     if bundle is not None:
         try:
             out, rung = bundle.call(name, args)
-            jax.block_until_ready(out)
+            if block:
+                jax.block_until_ready(out)
             emit(rung)
             return out, rung
         except BundleError as e:
@@ -431,6 +441,7 @@ def serve_entry(bundle: Bundle | None, name: str, args, *,
     jitted = (jit_fallback if hasattr(jit_fallback, "lower")
               else jax.jit(jit_fallback))
     out = jitted(*args)
-    jax.block_until_ready(out)
+    if block:
+        jax.block_until_ready(out)
     emit(rung)
     return out, rung

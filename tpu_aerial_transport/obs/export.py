@@ -45,10 +45,13 @@ from tpu_aerial_transport.obs import telemetry as telemetry_mod
 # up→suspect→down→restarting(→quarantined), failover re-dispatch
 # records, per-tenant admission throttling — the rows
 # ``tools/run_health.py``'s fleet section renders).
+# v7: adds the ``cache_hit`` serving_event kind (the content-addressed
+# result cache, ``serving/cache.py``: a submit resolved from a prior
+# COMPLETED result with no queue/lane/dispatch).
 # Files written at older versions remain valid (see
 # :data:`SUPPORTED_SCHEMAS`) — each bump only ADDS vocabulary.
-SCHEMA_VERSION = 6
-SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4, 5, 6})
+SCHEMA_VERSION = 7
+SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4, 5, 6, 7})
 
 # Event vocabulary -> required fields (beyond schema/event/ts). The
 # validator rejects unknown event types and missing fields; extra fields
@@ -91,6 +94,10 @@ SERVING_EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "submitted": ("request_id",),
     "rejected": ("request_id", "reason"),
     "admitted": ("request_id",),
+    # Content-addressed result-cache hit (serving/cache.py): the request
+    # resolves at submit with no queue/lane/dispatch; a ``completed``
+    # event (with ``cached: true``) follows immediately.
+    "cache_hit": ("request_id",),
     "completed": ("request_id",),
     "deadline_missed": ("request_id",),
     "batch_launch": ("batch_id",),

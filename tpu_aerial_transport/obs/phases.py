@@ -49,6 +49,10 @@ SHARDED_STEP = "sharded_step"  # shard_map plumbing outside finer scopes.
 SERVING_CHUNK = "serving_chunk"  # vmap plumbing of the serving tier's
 #                                  batched chunk (serving/batcher.py);
 #                                  finer controller scopes inside win.
+LANE_SURGERY = "lane_surgery"  # on-device boundary lane surgery
+#                                (serving/lanes.py): harvest-read +
+#                                filler-reset + late-join select on the
+#                                batched boundary carry.
 PODS_STEP = "pods_step"        # 2-D (scenario, agent) pods-mesh shard_map
 #                                plumbing (parallel/pods.py); the
 #                                controllers' fine scopes inside win.
@@ -56,7 +60,7 @@ PODS_STEP = "pods_step"        # 2-D (scenario, agent) pods-mesh shard_map
 PHASES = (
     QP_BUILD, CBF_ROWS, ENV_QUERY, LOCAL_SOLVE, FUSED_SOLVE, CONSENSUS,
     CONSENSUS_EXCHANGE, DUAL_UPDATE, DYNAMICS, PAD, FAULTS, FALLBACK,
-    TELEMETRY, SHARDED_STEP, SERVING_CHUNK, PODS_STEP,
+    TELEMETRY, SHARDED_STEP, SERVING_CHUNK, LANE_SURGERY, PODS_STEP,
 )
 
 

@@ -40,7 +40,8 @@ free; :func:`chrome_trace` converts stitched rows to Chrome/Perfetto
 trace-event JSON (``tools/trace_view.py`` is the CLI). On top of the
 span graph, :func:`critical_path` decomposes each request's
 submit→complete interval into queue-wait / batch-wait / device /
-harvest / retry segments that sum to the interval EXACTLY by
+surgery / publish / harvest / retry segments that sum to the interval
+EXACTLY by
 construction — "why did p99 regress" becomes a table.
 
 **Zero-cost contract** (the ``no_faults()`` / ``telemetry=None``
@@ -74,6 +75,9 @@ QUEUE_WAIT = "queue_wait"       # submit -> admitted into a device lane.
 BATCH_FORM = "batch_form"       # batch launch: bucket pick + admissions.
 CHUNK_DISPATCH = "chunk_dispatch"  # one device chunk of a batch.
 HARVEST = "harvest"             # boundary: host copy, resolve, late joins.
+LANE_SURGERY = "lane_surgery"   # boundary lane surgery (host splice or
+#                                 the serving/lanes.py device entrypoint).
+BOUNDARY_PUBLISH = "boundary_publish"  # snapshot + journal publication.
 GUARD_DISPATCH = "guard_dispatch"  # BackendGuard primary attempt.
 GUARD_FALLBACK = "guard_fallback"  # BackendGuard degrade/retry on CPU.
 RUN = "run"                     # recovery.run_chunks whole-run root.
@@ -84,8 +88,13 @@ RETRY = "retry"                 # host-level requeue marker (instant).
 
 # Critical-path segment order (also the subtraction priority for
 # overlapping spans inside a request's in-batch window — see
-# :func:`critical_path`).
-SEGMENTS = ("queue_wait", "batch_wait", "device", "harvest", "retry")
+# :func:`critical_path`). ``surgery`` and ``publish`` decompose what the
+# pre-ISSUE-18 accountant folded into ``harvest``/``batch_wait``: the
+# boundary lane-surgery work and the snapshot/journal publication are
+# carved FIRST (they nest inside the harvest window in sync mode), so
+# the pipelined-dispatch win is measured, not inferred.
+SEGMENTS = ("queue_wait", "batch_wait", "device", "surgery", "publish",
+            "harvest", "retry")
 
 # Process-unique id prefix: pid alone recycles, so add entropy once per
 # process. Ids only need to be unique, not secret or sortable.
@@ -621,10 +630,12 @@ def critical_path(rows: list[dict]) -> dict:
     for r in rows:
         if r.get("name") == QUEUE_WAIT and _t1(r) is not None:
             queue_by_trace.setdefault(r["trace_id"], []).append(r)
-        elif (r.get("name") in (CHUNK_DISPATCH, HARVEST, GUARD_FALLBACK)
+        elif (r.get("name") in (CHUNK_DISPATCH, HARVEST, GUARD_FALLBACK,
+                                LANE_SURGERY, BOUNDARY_PUBLISH)
               and _t1(r) is not None):
             seg = {CHUNK_DISPATCH: "device", HARVEST: "harvest",
-                   GUARD_FALLBACK: "retry"}[r["name"]]
+                   GUARD_FALLBACK: "retry", LANE_SURGERY: "surgery",
+                   BOUNDARY_PUBLISH: "publish"}[r["name"]]
             for member in _members(r, by_id):
                 member_spans.setdefault(member, {}).setdefault(
                     seg, []
@@ -649,7 +660,10 @@ def critical_path(rows: list[dict]) -> dict:
         window = _clip([(win_lo, t1)], t0, t1)
         taken: list[tuple[float, float]] = []
         segs = {"queue_wait": queue_s}
-        for seg in ("retry", "device", "harvest"):
+        # surgery/publish carve BEFORE harvest: in sync mode their spans
+        # nest inside the harvest window, and the decomposition must
+        # attribute that time to the finer segment, not the envelope.
+        for seg in ("retry", "device", "surgery", "publish", "harvest"):
             ivs = _clip(
                 _merge(member_spans.get(tid, {}).get(seg, [])), win_lo, t1
             )
@@ -658,7 +672,7 @@ def critical_path(rows: list[dict]) -> dict:
             taken = _merge(taken + ivs)
         segs["batch_wait"] = max(
             0.0, _measure(window) - segs["retry"] - segs["device"]
-            - segs["harvest"]
+            - segs["surgery"] - segs["publish"] - segs["harvest"]
         )
         out_reqs.append({
             "trace_id": tid,

@@ -57,6 +57,11 @@ class FamilySpec:
     max_iter: int = 2
     inner_iters: int = 4
     entry: str | None = None
+    # The family's on-device boundary lane-surgery entrypoint
+    # (serving/lanes.py), when it has one: device-surgery mode serves it
+    # through the same ladder as ``entry`` so zero-compile replicas stay
+    # zero-compile. None => host splice only.
+    surgery_entry: str | None = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -72,10 +77,12 @@ CANONICAL_FAMILIES: dict[str, FamilySpec] = {
     "cadmm4": FamilySpec(
         name="cadmm4", controller="cadmm", n=4,
         entry="serving.batcher:serving_chunk",
+        surgery_entry="serving.lanes:lane_surgery",
     ),
     "centralized4": FamilySpec(
         name="centralized4", controller="centralized", n=4,
         entry="serving.batcher:serving_chunk_centralized",
+        surgery_entry="serving.lanes:lane_surgery_centralized",
     ),
 }
 
@@ -92,6 +99,9 @@ class Family:
         self._built = None
         self._batched_jit = None
         self._template_host = None
+        self._surgery_jit = None
+        self._templates_b: dict[int, object] = {}
+        self._config_hash: str | None = None
 
     @property
     def name(self) -> str:
@@ -159,6 +169,39 @@ class Family:
         """Install an externally sourced template (the bundle's
         ``args_sample`` lane) — numpy leaves, no device work."""
         self._template_host = template
+        self._templates_b = {}
+
+    def batched_template_host(self, bucket: int):
+        """The template carry stacked to ``bucket`` lanes (host numpy,
+        cached per bucket) — the device lane surgery's ``template_b``
+        operand and the launch-time batch padding source."""
+        if bucket not in self._templates_b:
+            self._templates_b[bucket] = _tree_map(
+                lambda x: np.stack([np.asarray(x)] * bucket),
+                self.template_carry_host(),
+            )
+        return self._templates_b[bucket]
+
+    @property
+    def surgery_entry(self) -> str | None:
+        return self.spec.surgery_entry
+
+    @property
+    def surgery_jit(self):
+        """The family's ONE pre-jitted donated lane-surgery program
+        (serving/lanes.py) — the jit-rung fallback for device-surgery
+        mode. The carry is donated: the chunk output it consumes is dead
+        after the boundary (the chunk program itself is non-donating, so
+        the PREVIOUS carry stays valid for host snapshots)."""
+        if self._surgery_jit is None:
+            import jax
+
+            from tpu_aerial_transport.serving import lanes as lanes_mod
+
+            self._surgery_jit = jax.jit(
+                lanes_mod.lane_surgery, donate_argnums=(0,)
+            )
+        return self._surgery_jit
 
     # ------------------------------------------------- host-side lanes --
     def lane_carry(self, template, request: queue_mod.ScenarioRequest):
@@ -190,11 +233,17 @@ class Family:
         )
 
     def config_hash(self) -> str:
-        from tpu_aerial_transport.harness.checkpoint import (
-            config_fingerprint,
-        )
+        # Memoized: the spec is frozen and the result-cache path hashes
+        # per submit.
+        if self._config_hash is None:
+            from tpu_aerial_transport.harness.checkpoint import (
+                config_fingerprint,
+            )
 
-        return config_fingerprint(family=self.spec.to_json())
+            self._config_hash = config_fingerprint(
+                family=self.spec.to_json()
+            )
+        return self._config_hash
 
 
 def _build_chunk(spec: FamilySpec):
@@ -332,6 +381,13 @@ class Batch:
         self.remaining = np.zeros(bucket, np.int64)
         self.chunks_done = 0
         self.occupancy_samples: list[float] = []
+        # Device-surgery mode (serving/lanes.py): the post-surgery carry
+        # stays device-resident between chunks; carry_host is then only
+        # refreshed for snapshot publication. None => host mode.
+        self.carry_dev = None
+        # Pipelined dispatch: the not-yet-blocked-on chunk dispatch
+        # (server-owned record; discarded on preemption/retire).
+        self.inflight = None
 
     # --------------------------------------------------------- lanes ---
     @property
@@ -346,18 +402,23 @@ class Batch:
         return [i for i, t in enumerate(self.tickets) if t is None]
 
     def admit(self, ticket: queue_mod.Ticket, lane: int,
-              remaining: int | None = None) -> None:
+              remaining: int | None = None, *,
+              write_carry: bool = True) -> None:
         """Lane surgery at a boundary (or at launch): write the request's
         initial carry into ``lane`` of the boundary carry and start its
-        chunk countdown."""
+        chunk countdown. ``write_carry=False`` is the device-surgery
+        path: the carry write already happened on device
+        (serving.lanes.lane_surgery) and this call does the ticket/SLO
+        bookkeeping only."""
         req = ticket.request
-        lane_carry = self.family.lane_carry(
-            self.family.template_carry_host(), req
-        )
-        for dst, src in zip(
-            _leaves(self.carry_host), _leaves(lane_carry)
-        ):
-            dst[lane] = src
+        if write_carry:
+            lane_carry = self.family.lane_carry(
+                self.family.template_carry_host(), req
+            )
+            for dst, src in zip(
+                _leaves(self.carry_host), _leaves(lane_carry)
+            ):
+                dst[lane] = src
         self.tickets[lane] = ticket
         self.remaining[lane] = (
             req.horizon // self.family.chunk_len
@@ -401,12 +462,30 @@ class Batch:
                 t.slo.t_launch = now
         self.occupancy_samples.append(self.active_lanes / self.bucket)
 
-    def harvest(self) -> list[queue_mod.Ticket]:
+    def plan_finishing(self) -> list[int]:
+        """Lanes whose requests finish at the NEXT boundary (their chunk
+        countdown hits zero) — pure host admission-counter arithmetic,
+        data-independent of the chunk's numeric results. This is what
+        makes the device boundary plan (and with it double-buffered
+        dispatch) legal: the surgery masks can be built, and chunk k+1
+        dispatched, before chunk k's values ever reach the host."""
+        return [lane for lane, t in enumerate(self.tickets)
+                if t is not None and self.remaining[lane] <= 1]
+
+    def harvest(self, state_host=None) -> list[queue_mod.Ticket]:
         """Process one completed chunk boundary: decrement countdowns,
         resolve lanes that finished their horizon (deadline-classified),
-        free their lanes. Returns the resolved tickets."""
+        free their lanes. Returns the resolved tickets.
+
+        ``state_host`` (device-surgery mode): the harvested batched
+        scenario state — the surgery program's second output transferred
+        to host — read for lane results instead of ``carry_host`` (which
+        device mode does not refresh per boundary)."""
         self.chunks_done += 1
         now = self.clock()
+        results_src = (
+            (state_host,) if state_host is not None else self.carry_host
+        )
         finished: list[queue_mod.Ticket] = []
         for lane, ticket in enumerate(self.tickets):
             if ticket is None:
@@ -415,7 +494,7 @@ class Batch:
             if self.remaining[lane] > 0:
                 continue
             ticket.slo.t_complete = now
-            ticket.result = self.family.lane_result(self.carry_host, lane)
+            ticket.result = self.family.lane_result(results_src, lane)
             ticket.steps_served = (
                 ticket.request.horizon // self.family.chunk_len
             ) * self.family.chunk_len

@@ -1,0 +1,87 @@
+"""Content-addressed serving result cache (ISSUE 18 satellite): a
+scenario request is a PURE function of ``(family program, x0, v0,
+horizon)`` — the serving chunk is deterministic by the chunked-rollout
+contract and the family's config hash pins every solver/shape knob — so
+a completed result can be served again without touching the device.
+
+Keys are sha256 over the family's ``config_hash`` (which already folds
+the full :class:`FamilySpec`), the horizon, and the canonical little-
+endian float bytes of ``x0``/``v0`` (the ``aot/`` content-addressing
+discipline; tenant/deadline/request identity deliberately excluded —
+they change SLO accounting, not the computed trajectory). Values are
+deep-copied numpy result pytrees plus the served step count; the cache
+is LRU-bounded and hits/misses are counted for ``run_health``'s hit
+rate. Host-only and lock-free by design: it lives inside the server's
+single-threaded pump loop, same as the batcher's bookkeeping.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+
+import numpy as np
+
+
+def request_key(config_hash: str, request) -> str:
+    """The content address of one request's result (see module doc)."""
+    h = hashlib.sha256()
+    h.update(config_hash.encode())
+    h.update(str(int(request.horizon)).encode())
+    for vec in (request.x0, request.v0):
+        h.update(np.asarray(vec, np.float64).astype("<f8").tobytes())
+    return h.hexdigest()
+
+
+def _copy_tree(tree):
+    import jax
+
+    return jax.tree.map(lambda x: np.array(x, copy=True), tree)
+
+
+class ResultCache:
+    """LRU-bounded completed-result cache. ``get`` returns
+    ``(result, steps_served)`` copies (callers own their ticket results
+    and may mutate them) or ``None``; ``put`` stores COMPLETED results
+    only — the caller enforces that, because a deadline-missed ticket's
+    result is legitimate data but its status is an SLO verdict that must
+    not be replayed onto a fresh request."""
+
+    def __init__(self, max_entries: int = 256):
+        if max_entries < 1:
+            raise ValueError("ResultCache needs max_entries >= 1")
+        self.max_entries = int(max_entries)
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key: str):
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        result, steps = entry
+        return _copy_tree(result), steps
+
+    def put(self, key: str, result, steps_served: int) -> None:
+        if result is None:
+            return
+        self._entries[key] = (_copy_tree(result), int(steps_served))
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+
+    def stats(self) -> dict:
+        lookups = self.hits + self.misses
+        return {
+            "entries": len(self._entries),
+            "max_entries": self.max_entries,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": (self.hits / lookups) if lookups else None,
+        }

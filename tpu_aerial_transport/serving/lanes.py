@@ -1,0 +1,196 @@
+"""On-device boundary lane surgery for the continuous batcher
+(ISSUE 18): harvest-read + filler-reset + late-join write as ONE jitted
+select program over the batched boundary carry, plus the resolvers for
+the serving tier's two perf knobs (surgery impl, dispatch mode).
+
+The host-side seam this replaces (``serving/batcher.py``): after every
+chunk the server copies the whole batch carry to host, splices late
+joiners' initial states into freed lanes with numpy assignments, and
+reads finished lanes' results out of the host copy — so the carry
+round-trips host<->device once per boundary and chunk k+1 cannot
+dispatch until the splice completes. :func:`lane_surgery` keeps the
+carry device-resident: the harvested scenario state is returned as a
+SECOND output (the pre-surgery ``carry[0]`` — exactly what the host
+splice read), join lanes receive the family template with the request's
+``x0``/``v0`` selected in, and freed-but-unfilled lanes are reset to the
+pristine template (quarantined filler, same as launch padding). Every
+write is a ``jnp.where`` lane select — selects copy exact bits, so the
+device path is BITWISE-equal to the host splice (asserted across
+alone/busy/late-join compositions and SIGTERM+resume by
+tests/test_serving.py).
+
+The batched surgery is registered per canonical family
+(``serving.lanes:lane_surgery`` / ``:lane_surgery_centralized`` — the
+carry pytree differs per controller), donated on the carry (TC105) and
+bundled with batch-bucket variants (``aot/bundle.py BUCKETED_ENTRIES``)
+so zero-compile replicas stay zero-compile: the boundary plan (which
+lanes finish, which join) is pure host numpy over admission counters —
+data-independent of the chunk's numeric results, which is also what
+makes double-buffered dispatch legal (``serving/server.py``).
+"""
+
+from __future__ import annotations
+
+import os
+
+# ----------------------------------------------------------------------
+# Knob resolvers (analysis/knobs.py registers both; HL008-checked).
+# ----------------------------------------------------------------------
+
+SURGERY_MODES = ("host", "device")
+DISPATCH_MODES = ("sync", "pipelined")
+
+
+def resolve_surgery(configured: str | None = None) -> str:
+    """Resolve the serving lane-surgery implementation: ``host`` (the
+    numpy splice on a host boundary copy) or ``device`` (the
+    :func:`lane_surgery` select program on a device-resident carry).
+
+    Precedence: ``TAT_SERVING_SURGERY`` env force > the server's
+    ``surgery=`` config field > auto. Auto resolves to ``host``: on
+    XLA-CPU the device "transfer" is a memcpy, so the surgery A/B
+    (``bench.py serving_surgery_{host,device}``) measures select-program
+    overhead against numpy splice cost with no PCIe term — host wins or
+    ties there.
+
+    FLIP CRITERION (the perf-knob discipline): flip the default to
+    ``device`` when, on a real accelerator, the ``serving_surgery_device``
+    sweep cell shows lower per-boundary wall time than
+    ``serving_surgery_host`` AND the critical-path decomposition's
+    ``surgery``+``harvest`` segments shrink at equal throughput — i.e.
+    when eliminating the per-boundary host round-trip of the full batch
+    carry (the real-chip cost the CPU tier cannot see) beats the extra
+    select program. Device mode is also the prerequisite for pipelined
+    dispatch, which has its own criterion below.
+    """
+    forced = os.environ.get("TAT_SERVING_SURGERY", "").strip().lower()
+    mode = forced or (configured or "").strip().lower() or "host"
+    if mode == "auto":
+        mode = "host"
+    if mode not in SURGERY_MODES:
+        raise ValueError(
+            f"TAT_SERVING_SURGERY/surgery={mode!r}: expected one of "
+            f"{SURGERY_MODES} (or 'auto')"
+        )
+    return mode
+
+
+def resolve_dispatch(configured: str | None = None) -> str:
+    """Resolve the serving chunk-dispatch mode: ``sync`` (block on chunk
+    k before planning boundary k) or ``pipelined`` (dispatch surgery and
+    chunk k+1 asynchronously BEFORE blocking on chunk k's harvest
+    transfer — legal because the boundary plan depends only on host
+    admission counters, never on chunk k's numeric results).
+
+    Precedence: ``TAT_SERVING_DISPATCH`` env force > the server's
+    ``dispatch=`` config field > auto (``sync``). Pipelined dispatch
+    requires device surgery (a host splice needs the chunk result on
+    host, which is the serialization being removed); the server forces
+    ``surgery=device`` when dispatch resolves pipelined.
+
+    FLIP CRITERION: flip the default to ``pipelined`` when the
+    ``serving_dispatch_pipelined`` sweep cell shows reduced boundary
+    stall (the critical-path ``surgery``+``publish``+``harvest``+
+    ``batch_wait`` sum per completed request) versus
+    ``serving_dispatch_sync`` at equal result digests, on the serving
+    deployment's real backend. On XLA-CPU compute and "transfer" share
+    the host cores, so overlap buys little there — the cell exists to
+    measure the seam, and the decision belongs to the chip round.
+    """
+    forced = os.environ.get("TAT_SERVING_DISPATCH", "").strip().lower()
+    mode = forced or (configured or "").strip().lower() or "sync"
+    if mode == "auto":
+        mode = "sync"
+    if mode not in DISPATCH_MODES:
+        raise ValueError(
+            f"TAT_SERVING_DISPATCH/dispatch={mode!r}: expected one of "
+            f"{DISPATCH_MODES} (or 'auto')"
+        )
+    return mode
+
+
+# ----------------------------------------------------------------------
+# The surgery program.
+# ----------------------------------------------------------------------
+
+def lane_surgery(carry, template_b, x0, v0, join_mask, reset_mask):
+    """One boundary's lane surgery on a batched chunk carry.
+
+    Args (all batched over the leading lane axis ``B``):
+
+    - ``carry``: the chunk program's output carry (``carry[0]`` is the
+      batched scenario state; the rest is controller state) — donated by
+      the registered jit;
+    - ``template_b``: the family's pristine initial carry stacked to
+      ``B`` lanes (host numpy from ``Family.template_carry_host`` or the
+      bundle's ``args_sample`` — the zero-compile template source);
+    - ``x0`` / ``v0``: ``(B, 3)`` initial payload position/velocity,
+      row ``i`` meaningful only where ``join_mask[i]``;
+    - ``join_mask``: ``(B,)`` bool — lanes a late-join request enters
+      (template written in, then ``x0``/``v0`` selected into the
+      scenario state — the exact writes ``Family.lane_carry`` + the
+      host splice perform);
+    - ``reset_mask``: ``(B,)`` bool — lanes freed at this boundary with
+      no joiner: reset to the pristine template (quarantined filler,
+      identical to launch-time padding).
+
+    Returns ``(new_carry, harvested_state)`` where ``harvested_state``
+    is the PRE-surgery ``carry[0]`` — the host reads finished lanes'
+    results out of it (``Batch.harvest``), exactly as it read the
+    boundary host copy before. Selects copy bits verbatim, so active
+    lanes and harvested results are bitwise-identical to host surgery.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_aerial_transport.obs import phases
+
+    with phases.scope(phases.LANE_SURGERY):
+        harvested = carry[0]
+        write = jnp.logical_or(join_mask, reset_mask)
+
+        def lane_select(mask):
+            def sel(new, old):
+                m = jnp.reshape(mask, (-1,) + (1,) * (old.ndim - 1))
+                return jnp.where(m, new.astype(old.dtype), old)
+
+            return sel
+
+        new_carry = jax.tree.map(
+            lane_select(write), tuple(template_b), tuple(carry)
+        )
+        state = new_carry[0]
+        state = state.replace(
+            xl=lane_select(join_mask)(x0, state.xl),
+            vl=lane_select(join_mask)(v0, state.vl),
+        )
+        return (state,) + tuple(new_carry[1:]), harvested
+
+
+# The centralized family's surgery entry: the SAME select program — the
+# registry/bundle entry is per-family only because the carry pytree (and
+# with it the entry's abstract signature / precompiled variants) differs
+# per controller.
+lane_surgery_centralized = lane_surgery
+
+
+def make_surgery_args(template_b, joins, resets, bucket: int):
+    """Host-numpy operand build for :func:`lane_surgery` (everything
+    after the carry): ``joins`` is ``[(lane, request), ...]``, ``resets``
+    a lane list. Pure numpy — zero-compile replicas call this per
+    boundary, so no jax ops and no device-array indexing here."""
+    import numpy as np
+
+    state = template_b[0]
+    dtype = np.asarray(state.xl).dtype
+    x0 = np.zeros((bucket, 3), dtype)
+    v0 = np.zeros((bucket, 3), dtype)
+    join_mask = np.zeros(bucket, bool)
+    reset_mask = np.zeros(bucket, bool)
+    for lane, req in joins:
+        join_mask[lane] = True
+        x0[lane] = np.asarray(req.x0, dtype)
+        v0[lane] = np.asarray(req.v0, dtype)
+    for lane in resets:
+        reset_mask[lane] = True
+    return (template_b, x0, v0, join_mask, reset_mask)

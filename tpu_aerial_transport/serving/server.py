@@ -27,6 +27,7 @@ is the ticket — ``submit()`` never blocks on device work and consumers
 
 from __future__ import annotations
 
+import dataclasses
 import time
 
 import numpy as np
@@ -34,6 +35,8 @@ import numpy as np
 from tpu_aerial_transport.harness import checkpoint
 from tpu_aerial_transport.obs import trace as trace_mod
 from tpu_aerial_transport.serving import batcher as batcher_mod
+from tpu_aerial_transport.serving import cache as cache_mod
+from tpu_aerial_transport.serving import lanes as lanes_mod
 from tpu_aerial_transport.serving import queue as queue_mod
 from tpu_aerial_transport.serving.batcher import (
     DEFAULT_BUCKETS,
@@ -72,10 +75,39 @@ class ScenarioServer:
                  capacity: int = 256, bundle=None,
                  require_bundle: bool = False, run_dir: str | None = None,
                  metrics=None, guard=None, interrupt=None, mesh=None,
-                 tracer=None, clock=time.monotonic):
+                 tracer=None, clock=time.monotonic,
+                 surgery: str | None = None, dispatch: str | None = None,
+                 cache=None):
         from tpu_aerial_transport.obs import export as export_mod
         from tpu_aerial_transport.resilience import backend as backend_mod
         from tpu_aerial_transport.resilience.recovery import RunJournal
+
+        # The ISSUE-18 impl knobs, resolved ONCE at build time
+        # (serving/lanes.py resolvers; TAT_SERVING_SURGERY /
+        # TAT_SERVING_DISPATCH env forces). Host+sync is the default and
+        # its code path is the pre-knob one verbatim — behavior and the
+        # chunk program's HLO are byte-identical when the knobs are off.
+        self.surgery = lanes_mod.resolve_surgery(surgery)
+        self.dispatch = lanes_mod.resolve_dispatch(dispatch)
+        if self.dispatch == "pipelined":
+            # A host splice needs chunk k's values on host before chunk
+            # k+1 can launch — the serialization pipelining removes —
+            # so pipelined dispatch implies device surgery (resolver doc).
+            self.surgery = "device"
+        if self.surgery == "device" and mesh is not None:
+            raise ValueError(
+                "surgery='device' is single-device serving only: the "
+                "mesh path assembles host-global boundary carries "
+                "(pods.host_global), which IS host surgery. Use "
+                "surgery='host' (default) with a mesh."
+            )
+        # Content-addressed result cache (serving/cache.py): None =>
+        # disabled (default — repeat-query dedup changes how a request
+        # is served, so it is opt-in); an int => LRU capacity.
+        if cache is None or isinstance(cache, cache_mod.ResultCache):
+            self.cache = cache
+        else:
+            self.cache = cache_mod.ResultCache(int(cache))
 
         if families is None:
             families = list(batcher_mod.CANONICAL_FAMILIES.values())
@@ -200,7 +232,17 @@ class ScenarioServer:
     # ---------------------------------------------------------- submit --
     def submit(self, request: queue_mod.ScenarioRequest) -> queue_mod.Ticket:
         """Admit or reject one request (never raises out of admission —
-        rejection is a resolved ticket with a structured reason)."""
+        rejection is a resolved ticket with a structured reason). With a
+        result cache configured, a content-address hit resolves the
+        ticket right here — no queue, no lane, no device dispatch."""
+        if self.cache is not None:
+            fam = self.families.get(request.family)
+            if fam is not None:
+                hit = self.cache.get(
+                    cache_mod.request_key(fam.config_hash(), request)
+                )
+                if hit is not None:
+                    return self._resolve_cached(request, fam, hit)
         ticket = self.queue.submit(request)
         self.tickets[request.request_id] = ticket
         if ticket.status == queue_mod.PENDING and self.journal is not None:
@@ -213,6 +255,59 @@ class ScenarioServer:
                 "request": ticket.request.to_json(),
             })
         return ticket
+
+    def _resolve_cached(self, request: queue_mod.ScenarioRequest,
+                        fam: Family, hit) -> queue_mod.Ticket:
+        """Resolve a content-address cache hit: mint a ticket outside the
+        admission queue, stamp a zero-length SLO window (submit = admit =
+        complete — the request never waited, never held a lane), and emit
+        ``cache_hit`` + ``completed``. The journal's ``serving_done``
+        record still lands (via ``_emit``) so a client replaying its
+        stream after a crash dedupes cache-resolved requests the same as
+        device-resolved ones."""
+        if self.tracer is not None and request.trace_id is None:
+            request = dataclasses.replace(
+                request, trace_id=trace_mod.new_trace_id()
+            )
+        ticket = queue_mod.Ticket(request)
+        now = self.clock()
+        ticket.slo.t_submit = now
+        ticket.slo.t_admit = now
+        ticket.slo.t_complete = now
+        if self.tracer is not None:
+            root = self.tracer.begin(
+                trace_mod.REQUEST, parent=None,
+                trace_id=request.trace_id,
+                request_id=request.request_id, family=request.family,
+                horizon=int(request.horizon), cached=True,
+            )
+            ticket.trace = trace_mod.RequestTrace(self.tracer, root)
+        ticket.result, ticket.steps_served = hit
+        ticket._resolve(queue_mod.COMPLETED)
+        self.tickets[request.request_id] = ticket
+        self._emit(kind="cache_hit", request_id=request.request_id,
+                   family=request.family)
+        self._emit(kind="completed", request_id=request.request_id,
+                   family=request.family, steps=ticket.steps_served,
+                   cached=True, slo=ticket.slo.to_event())
+        if ticket.trace is not None:
+            ticket.trace.resolve(queue_mod.COMPLETED,
+                                 steps=ticket.steps_served, cached=True)
+        return ticket
+
+    def _cache_put(self, fam: Family, finished) -> None:
+        """Populate the result cache from a boundary's resolved tickets —
+        COMPLETED only (a deadline-missed result is real data but its
+        status is an SLO verdict that must not replay onto a fresh
+        request)."""
+        if self.cache is None:
+            return
+        for t in finished:
+            if t.status == queue_mod.COMPLETED:
+                self.cache.put(
+                    cache_mod.request_key(fam.config_hash(), t.request),
+                    t.result, t.steps_served,
+                )
 
     # ------------------------------------------------------ scheduling --
     def _check_preempt(self) -> bool:
@@ -297,36 +392,61 @@ class ScenarioServer:
         return batch
 
     def _advance(self, fam: Family, batch: Batch) -> None:
-        batch.record_launch()
-        i0 = np.int32(batch.chunks_done * fam.chunk_len)
-        carry = batch.carry_host
-        if self.mesh is not None:
-            from tpu_aerial_transport.parallel import mesh as mesh_mod
+        """Advance one batch by one chunk + its boundary. Impl selection
+        (the ISSUE-18 knob): device surgery needs a registered surgery
+        entrypoint — families without one fall back to the host splice
+        even in device mode (ad-hoc families stay servable)."""
+        if self.surgery == "device" and fam.surgery_entry is not None:
+            self._advance_device(fam, batch)
+        else:
+            self._advance_host(fam, batch)
 
-            carry = mesh_mod.shard_scenarios(self.mesh, carry, "scenario")
-        label = f"{fam.name}:b{batch.batch_id}:c{batch.chunks_done}"
+    def _chunk_once(self, fam: Family, batch: Batch, carry,
+                    chunk_index: int, *, block: bool = True):
+        """One chunk dispatch under its shared CHUNK_DISPATCH span (the
+        lane map links every member request's trace to it — the
+        critical-path accountant's "device" segment). ``block=False`` is
+        the pipelined path: the span then measures dispatch only, and the
+        device wait surfaces in the boundary's harvest transfer /
+        ``batch_wait`` — the stall the A/B cells exist to expose."""
+        label = f"{fam.name}:b{batch.batch_id}:c{chunk_index}"
+        i0 = np.int32(chunk_index * fam.chunk_len)
         dspan = None
         if self.tracer is not None:
-            # The shared device span: the lane map links every member
-            # request's trace to it (the critical-path accountant's
-            # "device" segment).
             dspan = self.tracer.begin(
                 trace_mod.CHUNK_DISPATCH, parent=None,
                 trace_id=self._server_trace, family=fam.name,
-                batch_id=batch.batch_id, chunk=batch.chunks_done,
+                batch_id=batch.batch_id, chunk=chunk_index,
                 bucket=batch.bucket, lanes=batch.lane_map(),
             )
         try:
             (out, serve_rung), guard_rung = self._dispatch(
-                fam, (carry, i0), label, trace_parent=dspan
+                fam, (carry, i0), label, trace_parent=dspan, block=block
             )
-            new_carry, _logs = out
         except BaseException:
             if dspan is not None:
                 self.tracer.end(dspan, error=True)
             raise
         if dspan is not None:
             self.tracer.end(dspan, rung=serve_rung, guard_rung=guard_rung)
+        return out, serve_rung, guard_rung
+
+    def _advance_host(self, fam: Family, batch: Batch) -> None:
+        """The pre-knob boundary path, verbatim: chunk on device, full
+        boundary carry back to host, numpy splice. (Only the trace
+        decomposition is new — LANE_SURGERY around the late-join splice,
+        BOUNDARY_PUBLISH around the snapshot — both host-only, so the
+        compiled chunk HLO is byte-identical to the pre-knob server.)"""
+        batch.record_launch()
+        carry = batch.carry_host
+        if self.mesh is not None:
+            from tpu_aerial_transport.parallel import mesh as mesh_mod
+
+            carry = mesh_mod.shard_scenarios(self.mesh, carry, "scenario")
+        out, serve_rung, guard_rung = self._chunk_once(
+            fam, batch, carry, batch.chunks_done
+        )
+        new_carry, _logs = out
         hspan = None
         if self.tracer is not None:
             hspan = self.tracer.begin(
@@ -337,29 +457,159 @@ class ScenarioServer:
             )
         try:
             batch.carry_host = self._boundary_host(new_carry)
-            batch.harvest()
-            for lane in batch.free_lanes():
-                late = self.queue.take(fam.name, 1)
-                if not late:
-                    break
-                batch.admit(late[0], lane)
+            finished = batch.harvest()
+            sspan = None
+            if self.tracer is not None:
+                sspan = self.tracer.begin(
+                    trace_mod.LANE_SURGERY, parent=hspan,
+                    trace_id=self._server_trace, family=fam.name,
+                    batch_id=batch.batch_id, impl="host",
+                )
+            try:
+                for lane in batch.free_lanes():
+                    late = self.queue.take(fam.name, 1)
+                    if not late:
+                        break
+                    batch.admit(late[0], lane)
+            except BaseException:
+                if sspan is not None:
+                    self.tracer.end(sspan, error=True)
+                raise
+            if sspan is not None:
+                self.tracer.end(sspan, lanes=batch.lane_map())
             occupancy = batch.occupancy_samples[-1]
-            self._snapshot_boundary(fam, batch)
+            self._publish_boundary(fam, batch)
         except BaseException:
-            # Same rule as dspan: the boundary where something broke
-            # (a SnapshotError from the boundary publish) must not be
-            # the one with no harvest record.
+            # Same rule as the dispatch span: the boundary where
+            # something broke (a SnapshotError from the boundary publish)
+            # must not be the one with no harvest record.
             if hspan is not None:
                 self.tracer.end(hspan, error=True)
             raise
         if hspan is not None:
             self.tracer.end(hspan)
+        self._cache_put(fam, finished)
         self._emit(kind="batch_boundary", family=fam.name,
                    batch_id=batch.batch_id, chunk=batch.chunks_done,
                    occupancy=occupancy, rung=serve_rung,
                    guard_rung=guard_rung)
         if batch.retired:
             self._occupancy.extend(batch.occupancy_samples)
+
+    def _advance_device(self, fam: Family, batch: Batch) -> None:
+        """The ISSUE-18 device boundary: chunk k's carry never leaves the
+        device. The boundary plan (which lanes finish = admission
+        counters; who joins = queue state) is pure host numpy and
+        data-independent of chunk k's numeric results
+        (``Batch.plan_finishing``) — so the surgery masks are built, the
+        donated surgery program runs on the device-resident carry, and
+        (pipelined mode) chunk k+1 is dispatched BEFORE anything blocks
+        on chunk k's values. Only the harvested scenario state (the
+        surgery program's second output) is transferred, and only when a
+        lane actually finished. Ordering is load-bearing:
+        plan -> surgery -> [speculative dispatch] -> harvest transfer ->
+        resolve -> bind joins -> publish. Joins must bind AFTER
+        ``Batch.harvest`` (it decrements every ticketed lane's countdown)
+        and the snapshot must follow the binds so the journaled lane map
+        matches the published carry — the resume bit-identity contract."""
+        batch.record_launch()
+        pipelined = self.dispatch == "pipelined"
+
+        # --- chunk k: the previous boundary's speculative dispatch, or
+        # dispatch it now (first chunk / sync mode / post-resume).
+        if batch.inflight is not None:
+            out, serve_rung, guard_rung = batch.inflight
+            batch.inflight = None
+        else:
+            carry = (batch.carry_dev if batch.carry_dev is not None
+                     else batch.carry_host)
+            out, serve_rung, guard_rung = self._chunk_once(
+                fam, batch, carry, batch.chunks_done, block=not pipelined
+            )
+        new_carry, _logs = out
+
+        # --- boundary plan: host counters only, no device values.
+        finishing = batch.plan_finishing()
+        free_after = sorted(set(batch.free_lanes()) | set(finishing))
+        late = self.queue.take(fam.name, len(free_after))
+        joins = list(zip(free_after, late))
+        joined = {lane for lane, _ in joins}
+        # Freed-with-no-joiner lanes reset to pristine filler; lanes that
+        # were ALREADY filler are left alone (same as the host path,
+        # which only ever splices admitted lanes).
+        resets = [lane for lane in finishing if lane not in joined]
+
+        # --- surgery: one donated select program on the device carry.
+        sspan = None
+        if self.tracer is not None:
+            sspan = self.tracer.begin(
+                trace_mod.LANE_SURGERY, parent=None,
+                trace_id=self._server_trace, family=fam.name,
+                batch_id=batch.batch_id, impl="device",
+                lanes=batch.lane_map(),
+            )
+        try:
+            args = (new_carry,) + lanes_mod.make_surgery_args(
+                fam.batched_template_host(batch.bucket),
+                [(lane, t.request) for lane, t in joins], resets,
+                batch.bucket,
+            )
+            (sout, s_rung), s_guard = self._dispatch(
+                fam, args,
+                f"{fam.name}:b{batch.batch_id}:s{batch.chunks_done}",
+                trace_parent=sspan, entry=fam.surgery_entry,
+                jit_fallback=fam.surgery_jit, block=not pipelined,
+            )
+            new_carry2, harvested = sout
+        except BaseException:
+            if sspan is not None:
+                self.tracer.end(sspan, error=True)
+            raise
+        if sspan is not None:
+            self.tracer.end(sspan, rung=s_rung, guard_rung=s_guard)
+        batch.carry_dev = new_carry2
+
+        # --- speculative chunk k+1 (pipelined): dispatched before the
+        # harvest transfer blocks, IF any lane stays active.
+        if pipelined and (batch.active_lanes - len(finishing)
+                          + len(joins)) > 0:
+            batch.inflight = self._chunk_once(
+                fam, batch, new_carry2, batch.chunks_done + 1, block=False
+            )
+
+        # --- harvest: transfer the pre-surgery scenario state (only if
+        # a lane finished), resolve, THEN bind joins.
+        hspan = None
+        if self.tracer is not None:
+            hspan = self.tracer.begin(
+                trace_mod.HARVEST, parent=None,
+                trace_id=self._server_trace, family=fam.name,
+                batch_id=batch.batch_id, chunk=batch.chunks_done + 1,
+                lanes=batch.lane_map(),
+            )
+        try:
+            state_host = None
+            if finishing:
+                state_host = _tree_map(np.asarray, harvested)
+            finished = batch.harvest(state_host=state_host)
+            for lane, ticket in joins:
+                batch.admit(ticket, lane, write_carry=False)
+            occupancy = batch.occupancy_samples[-1]
+            self._publish_boundary(fam, batch, carry_dev=new_carry2)
+        except BaseException:
+            if hspan is not None:
+                self.tracer.end(hspan, error=True)
+            raise
+        if hspan is not None:
+            self.tracer.end(hspan, lanes=batch.lane_map())
+        self._cache_put(fam, finished)
+        self._emit(kind="batch_boundary", family=fam.name,
+                   batch_id=batch.batch_id, chunk=batch.chunks_done,
+                   occupancy=occupancy, rung=serve_rung,
+                   guard_rung=guard_rung)
+        if batch.retired:
+            self._occupancy.extend(batch.occupancy_samples)
+            batch.inflight = None  # nothing admissible rode along.
 
     def _boundary_host(self, carry):
         """Boundary carry back to host. The server loop is host-global by
@@ -378,44 +628,82 @@ class ScenarioServer:
                 return pods.host_global(carry)
         return host_copy(carry)
 
-    def _dispatch(self, fam: Family, args, label: str, trace_parent=None):
-        """One guarded chunk through the serve ladder. Returns
-        ``((out, serve_rung), guard_rung)``."""
+    def _dispatch(self, fam: Family, args, label: str, trace_parent=None,
+                  *, entry: str | None = None, jit_fallback=None,
+                  block: bool = True):
+        """One guarded call through the serve ladder. Returns
+        ``((out, serve_rung), guard_rung)``. Defaults serve the family's
+        batched chunk; device-surgery dispatches pass
+        ``entry=fam.surgery_entry`` / ``jit_fallback=fam.surgery_jit`` —
+        same ladder, so a bundled replica's surgery replays a serialized
+        executable and the process stays zero-compile. ``block=False``
+        (pipelined) skips the ladder's block_until_ready: the call
+        returns as soon as the work is enqueued and errors surface at
+        the boundary's harvest transfer."""
         from tpu_aerial_transport.aot import loader as loader_mod
         from tpu_aerial_transport.resilience import backend as backend_mod
 
-        entry = fam.entry or fam.name
-        jit_fb = None if self.require_bundle else fam.batched_jit
+        entry = entry if entry is not None else (fam.entry or fam.name)
+        if jit_fallback is None and not self.require_bundle:
+            jit_fallback = fam.batched_jit
+        jit_fb = None if self.require_bundle else jit_fallback
 
         def primary():
             return loader_mod.serve_entry(
                 self.bundle, entry, args, jit_fallback=jit_fb,
-                metrics=self.metrics, label=label,
+                metrics=self.metrics, label=label, block=block,
             )
 
         fallback = None
         if not self.require_bundle:
             fallback = backend_mod.run_on_cpu(lambda: loader_mod.serve_entry(
-                None, entry, args, jit_fallback=fam.batched_jit,
-                metrics=self.metrics, label=label + ":cpu",
+                None, entry, args, jit_fallback=jit_fallback,
+                metrics=self.metrics, label=label + ":cpu", block=block,
             ))
         return self.guard.run(label, primary, fallback_fn=fallback,
                               trace_parent=trace_parent)
 
-    def _snapshot_boundary(self, fam: Family, batch: Batch) -> None:
+    def _publish_boundary(self, fam: Family, batch: Batch,
+                          carry_dev=None) -> None:
+        """Boundary durability publication under its BOUNDARY_PUBLISH
+        span (the critical path's "publish" segment): atomic snapshot +
+        journaled lane map. Device-surgery mode passes ``carry_dev`` (the
+        post-surgery device carry) and pays the host transfer HERE — only
+        when a journal is configured; an un-journaled device server never
+        round-trips the carry, which is the knob's perf point."""
         if self.journal is None:
             return
-        checkpoint.save_snapshot(
-            self.run_dir, batch.chunks_done, batch.carry_host,
-            prefix=f"{SNAP_PREFIX}{batch.batch_id}",
-            config_hash=fam.config_hash(), keep_last=2,
-            meta={"family": fam.name, "bucket": batch.bucket},
-        )
-        self.journal.append({
-            "event": "serving_batch", "batch_id": batch.batch_id,
-            "family": fam.name, "bucket": batch.bucket,
-            "chunk": batch.chunks_done, "lanes": batch.lanes_json(),
-        })
+        pspan = None
+        if self.tracer is not None:
+            pspan = self.tracer.begin(
+                trace_mod.BOUNDARY_PUBLISH, parent=None,
+                trace_id=self._server_trace, family=fam.name,
+                batch_id=batch.batch_id, chunk=batch.chunks_done,
+                lanes=batch.lane_map(),
+            )
+        try:
+            if carry_dev is not None:
+                batch.carry_host = _tree_map(
+                    lambda x: np.array(np.asarray(x), copy=True),
+                    carry_dev,
+                )
+            checkpoint.save_snapshot(
+                self.run_dir, batch.chunks_done, batch.carry_host,
+                prefix=f"{SNAP_PREFIX}{batch.batch_id}",
+                config_hash=fam.config_hash(), keep_last=2,
+                meta={"family": fam.name, "bucket": batch.bucket},
+            )
+            self.journal.append({
+                "event": "serving_batch", "batch_id": batch.batch_id,
+                "family": fam.name, "bucket": batch.bucket,
+                "chunk": batch.chunks_done, "lanes": batch.lanes_json(),
+            })
+        except BaseException:
+            if pspan is not None:
+                self.tracer.end(pspan, error=True)
+            raise
+        if pspan is not None:
+            self.tracer.end(pspan)
 
     # ----------------------------------------------------------- stats --
     def stats(self) -> dict:
@@ -434,13 +722,18 @@ class ScenarioServer:
             for s in b.occupancy_samples
         ]
         occ = self._occupancy + live
-        return {
+        out = {
             "requests": len(self.tickets),
             **by_status,
             "scenario_steps": steps,
             "mean_occupancy": float(np.mean(occ)) if occ else None,
             "preempted": self.preempted,
+            "surgery": self.surgery,
+            "dispatch": self.dispatch,
         }
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        return out
 
     # ---------------------------------------------------------- resume --
     @classmethod
