@@ -594,3 +594,106 @@ def test_run_health_fleet_section_dedups_appended_rerun(tmp_path):
     assert fl["tenants"]["free"]["throttled"] == 2
     # Raw counts stay honest (dedup is aggregation-side).
     assert fl["kinds"]["failover"] == 2
+
+
+# ------------- schema v8: session_event (closed-loop sessions) ---------
+
+def test_session_event_validates_at_schema_v8(tmp_path):
+    """The session vocabulary (closed-loop serving): lease lifecycle +
+    step admission + per-step SLO rows write and validate at v8."""
+    path = str(tmp_path / "sess.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("session_event", kind="opened", session_id="c0",
+           lease="c0:l0", family="cadmm4", epoch=0, reconnect=False)
+    w.emit("session_event", kind="renewed", session_id="c0", gap_s=0.2)
+    w.emit("session_event", kind="step_submitted", session_id="c0",
+           step_seq=1, request_id="c0.s000001")
+    w.emit("session_event", kind="step_done", session_id="c0",
+           step_seq=1, rung="served", request_id="c0.s000001",
+           slo={"latency_s": 0.01})
+    w.emit("session_event", kind="step_degraded", session_id="c0",
+           step_seq=2, rung="hold_last", missed="in_flight",
+           request_id="c0.s000002")
+    w.emit("session_event", kind="stale_step", session_id="c0",
+           step_seq=2, expected=3)
+    w.emit("session_event", kind="evicted", session_id="c0",
+           lease="c0:l0", gap_s=31.0, step_seq=2)
+    w.emit("session_event", kind="fenced", session_id="c0", op="step",
+           lease="c0:l0")
+    w.emit("session_event", kind="rehomed", session_id="c0",
+           to_replica="1", from_replica="0")
+    w.emit("fleet_event", kind="autoscale", hint="scale_up",
+           queue_depth=20, sessions=4)
+    assert export_mod.validate_file(path) == []
+    events = export_mod.read_events(path)
+    assert all(e["schema"] == export_mod.SCHEMA_VERSION >= 8
+               for e in events)
+
+
+def test_session_event_requires_kind_and_kind_keys(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("session_event", session_id="c0")  # no kind.
+    w.emit("session_event", kind="opened", session_id="c0")  # no lease.
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 2
+    assert "missing fields ['kind']" in errs[0]
+    assert "missing keys" in errs[1] and "lease" in errs[1]
+
+
+def test_v7_files_remain_valid_but_not_for_session_event(tmp_path):
+    """Additive bump contract, v8 edition: a v7 file still validates; a
+    session_event STAMPED v7 does not (the v7 reader contract never
+    defined it)."""
+    path = str(tmp_path / "old.metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "schema": 7, "event": "fleet_event", "ts": 0.0,
+            "kind": "heartbeat", "replica": 0,
+        }) + "\n")
+    assert export_mod.validate_file(path) == []
+    with open(path, "a") as fh:
+        fh.write(json.dumps({
+            "schema": 7, "event": "session_event", "ts": 0.0,
+            "kind": "fenced", "session_id": "c0",
+        }) + "\n")
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "requires schema >= 8" in errs[0]
+
+
+def test_run_health_sessions_section_dedups_appended_rerun(tmp_path):
+    """The sessions section follows the append-mode dedup rule:
+    lifecycle per session_id, step terminals per (session_id, step_seq),
+    LAST event wins; raw kind counts stay honest."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import run_health
+
+    path = str(tmp_path / "sess.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    for latency in (1.0, 3.0):  # the re-run appends SAME identities.
+        w.emit("session_event", kind="opened", session_id="c0",
+               lease="c0:l0")
+        w.emit("session_event", kind="renewed", session_id="c0",
+               gap_s=0.3)
+        w.emit("session_event", kind="step_done", session_id="c0",
+               step_seq=1, rung="served", request_id="c0.s000001",
+               slo={"latency_s": latency})
+        w.emit("session_event", kind="step_degraded", session_id="c0",
+               step_seq=2, rung="hold_last", missed="in_queue",
+               request_id="c0.s000002")
+    w.emit("session_event", kind="evicted", session_id="c0",
+           lease="c0:l0", gap_s=31.0)
+    sx = run_health.summarize(export_mod.read_events(path))["sessions"]
+    # One session, final state evicted — not two opens.
+    assert (sx["live"], sx["evicted"], sx["closed"]) == (0, 1, 0)
+    # One terminal per step: percentiles from the LAST run's numbers.
+    assert sx["steps"] == 2
+    assert sx["step_latency_s"]["count"] == 1
+    assert sx["step_latency_s"]["p50"] == 3.0
+    assert sx["degraded_steps"] == 1 and sx["served_steps"] == 1
+    assert sx["degraded_rate"] == 0.5
+    # Heartbeat-gap histogram spans renewals and the eviction gap.
+    assert sx["heartbeat_gap_hist"]["0.1-0.5"] == 2
+    assert sx["heartbeat_gap_hist"][">=30.0"] == 1
+    # Raw counts stay honest (dedup is aggregation-side).
+    assert sx["kinds"]["opened"] == 2 and sx["kinds"]["step_done"] == 2

@@ -1,0 +1,628 @@
+"""Closed-loop session serving (tpu_aerial_transport/serving/
+sessions.py): lease lifecycle with fenced eviction (a zombie's stale
+token can NEVER write into a reclaimed lane), step-sequenced admission
+(replay/out-of-order -> structured ``stale_step``), per-step deadline
+SLOs that degrade to an explicit ``hold_last`` rung instead of raising,
+crash-safe session tables (bitwise acceptance across a mid-stream
+SIGTERM+resume), fleet re-homing on the SAME trace_id, the autoscale
+hint's no-flap hysteresis, and the result-cache refusal for delta-state
+steps."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_aerial_transport.obs import export as export_mod
+from tpu_aerial_transport.obs import trace as trace_mod
+from tpu_aerial_transport.serving import batcher, cache as cache_mod
+from tpu_aerial_transport.serving import fleet as fleet_mod
+from tpu_aerial_transport.serving import queue as queue_mod
+from tpu_aerial_transport.serving import server as server_mod
+from tpu_aerial_transport.serving import sessions as sessions_mod
+from tpu_aerial_transport.serving.queue import ScenarioRequest
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeInterrupt:
+    triggered = None
+
+
+@pytest.fixture(scope="session")
+def cadmm_family():
+    """ONE family instance per session so its batched chunk compiles
+    once across every jit-path test in this module."""
+    return batcher.make_family("cadmm4")
+
+
+def _mk_server(fam, tmp_path=None, **kw):
+    kw.setdefault("families", [fam])
+    kw.setdefault("buckets", (4, 8))
+    if tmp_path is not None:
+        kw.setdefault("metrics", str(tmp_path / "sess.metrics.jsonl"))
+    return server_mod.ScenarioServer(**kw)
+
+
+def _drain(host):
+    while host.pump():
+        pass
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb) and la
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ----------------------------------------------------------------------
+# Lease lifecycle (no device work — fake clock only).
+# ----------------------------------------------------------------------
+
+def test_lease_lifecycle_renew_evict_fence_reconnect(
+        cadmm_family, tmp_path):
+    """The state machine end to end on a fake clock: heartbeat renews,
+    TTL expiry evicts and fences, the zombie's token is rejected
+    structurally, reconnect mints the next epoch and resets the
+    watermark."""
+    now = [0.0]
+    srv = _mk_server(cadmm_family, tmp_path, clock=lambda: now[0])
+    host = sessions_mod.SessionHost(srv, lease_s=5.0)
+
+    grant = host.open("alice", "cadmm4", (0.2, 0.1, 1.0))
+    assert grant["ok"] and grant["lease"] == "alice:l0"
+    assert grant["step_seq"] == 0
+
+    now[0] = 4.0  # inside the TTL: renew works, gap recorded.
+    hb = host.heartbeat("alice", "alice:l0")
+    assert hb["ok"] and hb["expires_in_s"] == 5.0
+
+    now[0] = 8.0  # 4s gap < TTL: still live.
+    assert host.sweep() == []
+    now[0] = 9.5  # 5.5s of silence: the sweep evicts and fences.
+    assert host.sweep() == ["alice"]
+    assert host.sessions["alice"].status == sessions_mod.EVICTED
+
+    # The zombie: heartbeat AND step with the fenced token both get the
+    # structured rejection — never an exception, never a server write.
+    hb = host.heartbeat("alice", "alice:l0")
+    assert (hb["ok"], hb["reason"]) == (
+        False, queue_mod.REASON_LEASE_FENCED)
+    zs = host.step("alice", "alice:l0", 1)
+    assert (zs.status, zs.reason) == (
+        queue_mod.REJECTED, queue_mod.REASON_LEASE_FENCED)
+    assert not srv.has_work()
+
+    # Reconnect: NEW lease (next epoch), watermark reset.
+    grant2 = host.open("alice", "cadmm4", (0.3, 0.1, 1.0))
+    assert grant2["ok"] and grant2["lease"] == "alice:l1"
+    assert host.sessions["alice"].step_seq == 0
+    # ... and the OLD token stays fenced even while the session lives.
+    zs = host.step("alice", "alice:l0", 1)
+    assert zs.reason == queue_mod.REASON_LEASE_FENCED
+    assert host.stats()["fenced_rejections"] == 3
+
+    assert export_mod.validate_file(
+        str(tmp_path / "sess.metrics.jsonl")) == []
+
+
+def test_open_unknown_family_structured(cadmm_family):
+    host = sessions_mod.SessionHost(_mk_server(cadmm_family))
+    grant = host.open("a", "martian")
+    assert (grant["ok"], grant["reason"]) == (
+        False, queue_mod.REASON_NO_COVERAGE)
+
+
+def test_duplicate_open_fences_the_first_writer(cadmm_family):
+    """Two clients claiming one session_id: the second open supersedes —
+    exactly one lease can ever write."""
+    host = sessions_mod.SessionHost(_mk_server(cadmm_family))
+    first = host.open("s", "cadmm4")["lease"]
+    second = host.open("s", "cadmm4")["lease"]
+    assert first != second
+    assert host.heartbeat("s", first)["reason"] == \
+        queue_mod.REASON_LEASE_FENCED
+    assert host.heartbeat("s", second)["ok"]
+
+
+def test_resolve_lease_s_env_force(monkeypatch):
+    assert sessions_mod.resolve_lease_s(None) == \
+        sessions_mod.DEFAULT_LEASE_S
+    assert sessions_mod.resolve_lease_s(2.5) == 2.5
+    monkeypatch.setenv("TAT_SESSION_LEASE_S", "0.25")
+    assert sessions_mod.resolve_lease_s(60.0) == 0.25  # env force wins.
+    monkeypatch.setenv("TAT_SESSION_LEASE_S", "nope")
+    with pytest.raises(ValueError):
+        sessions_mod.resolve_lease_s(None)
+    monkeypatch.setenv("TAT_SESSION_LEASE_S", "-1")
+    with pytest.raises(ValueError):
+        sessions_mod.resolve_lease_s(None)
+
+
+# ----------------------------------------------------------------------
+# Step-sequenced admission.
+# ----------------------------------------------------------------------
+
+def test_stale_step_replay_and_out_of_order(cadmm_family, tmp_path):
+    """A replayed or skipped-ahead step_seq rejects ``stale_step`` and
+    the watermark does not move; the in-order step then serves."""
+    srv = _mk_server(cadmm_family, tmp_path)
+    host = sessions_mod.SessionHost(srv, lease_s=1e6)
+    lease = host.open("s", "cadmm4", (0.4, 0.1, 1.0))["lease"]
+
+    s1 = host.step("s", lease, 1, (0.01, 0.0, 0.0))
+    replay = host.step("s", lease, 1, (9.9, 9.9, 9.9))
+    assert (replay.status, replay.reason) == (
+        queue_mod.REJECTED, queue_mod.REASON_STALE_STEP)
+    skip = host.step("s", lease, 3, (9.9, 9.9, 9.9))
+    assert skip.reason == queue_mod.REASON_STALE_STEP
+    assert host.sessions["s"].step_seq == 1  # watermark unmoved.
+    # The rejected deltas did NOT touch the state stream.
+    np.testing.assert_array_equal(
+        host.sessions["s"].x,
+        np.asarray((0.4, 0.1, 1.0), np.float64)
+        + np.asarray((0.01, 0.0, 0.0), np.float64))
+
+    s2 = host.step("s", lease, 2, (0.01, 0.0, 0.0))
+    _drain(host)
+    assert s1.rung == s2.rung == sessions_mod.RUNG_SERVED
+    assert host.stats()["stale_rejections"] == 2
+
+    events = export_mod.read_events(str(tmp_path / "sess.metrics.jsonl"))
+    stale = [e for e in events if e.get("kind") == "stale_step"]
+    assert [(e["step_seq"], e["expected"]) for e in stale] == \
+        [(1, 2), (3, 2)]
+
+
+def test_zombie_fence_never_writes_into_reclaimed_lane(
+        cadmm_family, tmp_path):
+    """THE fencing acceptance: after eviction the zombie's step leaves
+    NO trace server-side — no ticket, no journaled serving_request, no
+    journaled session_step — and the surviving session's served stream
+    is bitwise identical to a zombie-free run."""
+    now = [0.0]
+    run_dir = str(tmp_path / "run")
+    srv = _mk_server(cadmm_family, tmp_path, clock=lambda: now[0],
+                     run_dir=run_dir)
+    host = sessions_mod.SessionHost(srv, lease_s=5.0)
+    alice = host.open("alice", "cadmm4", (0.2, 0.1, 1.0))["lease"]
+    bob = host.open("bob", "cadmm4", (0.5, 0.1, 1.0))["lease"]
+    a1 = host.step("alice", alice, 1, (0.01, 0.0, 0.0))
+    b1 = host.step("bob", bob, 1, (0.02, 0.0, 0.0))
+    _drain(host)
+    assert a1.rung == b1.rung == sessions_mod.RUNG_SERVED
+
+    now[0] = 4.0
+    host.heartbeat("bob", bob)  # bob keeps renewing...
+    now[0] = 8.0  # ...alice is now 8s silent past the 5s TTL.
+    host.heartbeat("bob", bob)
+    assert host.sessions["alice"].status == sessions_mod.EVICTED
+
+    zs = host.step("alice", alice, 2, (7.7, 7.7, 7.7))
+    assert zs.reason == queue_mod.REASON_LEASE_FENCED
+    assert zs.request_id not in srv.tickets
+    journal = [json.loads(line) for line in
+               open(os.path.join(run_dir, "serving_journal.jsonl"))]
+    assert not any(
+        e.get("event") == "serving_request"
+        and e["request"]["request_id"] == zs.request_id
+        for e in journal)
+    assert not any(
+        e.get("event") == "session_step" and e.get("step_seq") == 2
+        and e.get("session_id") == "alice"
+        for e in journal)
+
+    # Bob's NEXT step is bitwise what a zombie-free server serves for
+    # the same state (the lane the zombie aimed at is provably clean).
+    b2 = host.step("bob", bob, 2, (0.02, 0.0, 0.0))
+    _drain(host)
+    ref_srv = _mk_server(cadmm_family)
+    ref = ref_srv.submit(ScenarioRequest(
+        family="cadmm4", horizon=cadmm_family.chunk_len,
+        x0=tuple(float(t) for t in host.sessions["bob"].x),
+        v0=tuple(float(t) for t in host.sessions["bob"].v),
+        request_id="ref"))
+    while ref_srv.pump():
+        pass
+    _assert_tree_equal(b2.result, ref.result)
+
+
+# ----------------------------------------------------------------------
+# Per-step deadline SLOs: degrade, never raise.
+# ----------------------------------------------------------------------
+
+def test_deadline_miss_storm_degrades_every_step(cadmm_family, tmp_path):
+    """A deadline-miss storm resolves EVERY step with an explicit rung —
+    hold_last carrying the last served control, misses classified
+    in_queue vs in_flight, no exception in the server loop — and the
+    traced requests' critical-path segments sum exactly."""
+    now = [0.0]
+    rows = []
+
+    class Sink:
+        # A single-chunk step launches AND harvests inside one pump, so
+        # an in-flight miss needs the clock to move MID-pump: jump it
+        # when the batch_launch event lands (after admission passed the
+        # deadline gate, before the harvest reads the clock).
+        jump = None  # (kind, t)
+
+        def emit(self, event, **kw):
+            rows.append({"event": event, **kw})
+            if self.jump is not None and kw.get("kind") == self.jump[0]:
+                now[0] = self.jump[1]
+                self.jump = None
+
+    sink = Sink()
+    tracer = trace_mod.Tracer(sink, track="server",
+                              clock_mono=lambda: now[0])
+    srv = _mk_server(cadmm_family, clock=lambda: now[0], metrics=sink,
+                     tracer=tracer)
+    host = sessions_mod.SessionHost(srv, lease_s=1e9)
+    lease = host.open("s", "cadmm4", (0.3, 0.1, 1.0))["lease"]
+
+    s1 = host.step("s", lease, 1, (0.01, 0.0, 0.0))
+    _drain(host)
+    assert s1.rung == sessions_mod.RUNG_SERVED
+
+    # MISS IN QUEUE: the deadline passes before the step is launched.
+    s2 = host.step("s", lease, 2, (0.01, 0.0, 0.0), deadline_s=5.0)
+    now[0] = 20.0
+    _drain(host)
+    assert (s2.status, s2.rung, s2.missed) == (
+        queue_mod.COMPLETED, sessions_mod.RUNG_HOLD_LAST,
+        queue_mod.MISSED_IN_QUEUE)
+    _assert_tree_equal(s2.result, s1.result)  # held control.
+
+    # MISS IN FLIGHT: launched in time, finishes late — the step still
+    # degrades to hold_last, and the LATE fresh result refreshes the
+    # hold-last state for the next degradation.
+    s3 = host.step("s", lease, 3, (0.01, 0.0, 0.0), deadline_s=5.0)
+    sink.jump = ("batch_launch", 40.0)  # launched in time, harvested late.
+    _drain(host)
+    assert (s3.rung, s3.missed) == (
+        sessions_mod.RUNG_HOLD_LAST, queue_mod.MISSED_IN_FLIGHT)
+    _assert_tree_equal(s3.result, s1.result)  # held (served stream).
+    assert s3.ticket.result is not None  # the late result DID land...
+    assert host.sessions["s"].last_result is s3.ticket.result  # ...here.
+
+    s4 = host.step("s", lease, 4, (0.01, 0.0, 0.0))
+    _drain(host)
+    assert s4.rung == sessions_mod.RUNG_SERVED
+    assert host.stats()["steps_degraded"] == 2
+
+    # Every step resolved; the degradations are first-class events.
+    degraded = [r for r in rows if r.get("kind") == "step_degraded"]
+    assert [(e["step_seq"], e["missed"]) for e in degraded] == [
+        (2, queue_mod.MISSED_IN_QUEUE), (3, queue_mod.MISSED_IN_FLIGHT)]
+
+    # Spans / critical path: each completed traced request's segments
+    # sum exactly to its submit->complete window.
+    cp = trace_mod.critical_path(trace_mod.stitch(tracer.rows))
+    done = [q for q in cp["requests"] if q["status"] == "completed"]
+    assert done
+    for q in done:
+        assert sum(q["segments"].values()) == pytest.approx(
+            q["total_s"], abs=1e-9)
+
+
+# ----------------------------------------------------------------------
+# Bitwise acceptance: sessions == offline rollout, across SIGTERM+resume.
+# ----------------------------------------------------------------------
+
+def _offline_digileaves(fam, x0, v0, deltas):
+    """The offline rollout: cumulative post-delta states served as
+    one-shot requests on a FRESH server."""
+    srv = server_mod.ScenarioServer(families=[fam], buckets=(4, 8))
+    tickets = {}
+    x = np.asarray(x0, dtype=np.float64)
+    v = np.asarray(v0, dtype=np.float64)
+    for s, (dx, dv) in enumerate(deltas, start=1):
+        x = x + np.asarray(dx, dtype=np.float64)
+        v = v + np.asarray(dv, dtype=np.float64)
+        tickets[s] = srv.submit(ScenarioRequest(
+            family="cadmm4", horizon=fam.chunk_len,
+            x0=tuple(float(t) for t in x), v0=tuple(float(t) for t in v),
+            request_id=f"off{s:03d}"))
+    while srv.pump():
+        pass
+    return {s: t.result for s, t in tickets.items()}
+
+
+def test_session_stream_bitwise_equals_offline_rollout(
+        cadmm_family, tmp_path):
+    """The tentpole claim, single-process edition: a session's served
+    control stream (steps interleaved with ANOTHER session in the same
+    batches) is bitwise the offline rollout of its state stream."""
+    deltas = {
+        "p": [((0.01, 0.0, 0.0), (0.0, 0.001, 0.0)) for _ in range(3)],
+        "q": [((-0.02, 0.01, 0.0), (0.0, 0.0, 0.0)) for _ in range(3)],
+    }
+    x0 = {"p": (0.3, 0.1, 1.0), "q": (0.7, 0.2, 1.1)}
+    v0 = {"p": (0.1, 0.0, 0.0), "q": (0.0, 0.1, 0.0)}
+
+    srv = _mk_server(cadmm_family, tmp_path)
+    host = sessions_mod.SessionHost(srv, lease_s=1e9)
+    leases = {sid: host.open(sid, "cadmm4", x0[sid], v0[sid])["lease"]
+              for sid in deltas}
+    served = {}
+    for s in range(1, 4):
+        batch = [host.step(sid, leases[sid], s, *deltas[sid][s - 1])
+                 for sid in sorted(deltas)]
+        _drain(host)
+        for t in batch:
+            assert t.rung == sessions_mod.RUNG_SERVED
+            served[(t.session_id, t.step_seq)] = t.result
+
+    for sid in deltas:
+        ref = _offline_digileaves(cadmm_family, x0[sid], v0[sid],
+                                  deltas[sid])
+        for s in range(1, 4):
+            _assert_tree_equal(served[(sid, s)], ref[s])
+
+
+@pytest.mark.slow
+def test_session_sigterm_resume_bitwise_acceptance(
+        cadmm_family, tmp_path):
+    """THE acceptance e2e: mid-stream SIGTERM with a step in flight,
+    then resume — the session table restores bit-identically (lease,
+    watermark, float64 state), the in-flight step completes, post-resume
+    steps serve, and the WHOLE served stream is bitwise the offline
+    rollout."""
+    deltas = [((0.01, -0.005, 0.0), (0.001, 0.0, 0.0))
+              for _ in range(4)]
+    x0, v0 = (0.25, 0.1, 1.0), (0.1, 0.0, 0.0)
+    run_dir = str(tmp_path / "run")
+
+    fi = FakeInterrupt()
+    srv1 = _mk_server(cadmm_family, run_dir=run_dir, interrupt=fi)
+    host1 = sessions_mod.SessionHost(srv1, lease_s=1e9)
+    lease1 = host1.open("s", "cadmm4", x0, v0)["lease"]
+    served = {}
+    for s in (1, 2):
+        t = host1.step("s", lease1, s, *deltas[s - 1])
+        _drain(host1)
+        assert t.rung == sessions_mod.RUNG_SERVED
+        served[s] = t.result
+    t3 = host1.step("s", lease1, 3, *deltas[2])  # journaled, queued...
+    fi.triggered = "SIGTERM"
+    host1.pump()  # the preemption lands at pump start: t3 stays queued.
+    assert srv1.preempted and not t3.done
+
+    srv2 = server_mod.ScenarioServer.resume(
+        run_dir, families=[cadmm_family], buckets=(4, 8))
+    host2 = sessions_mod.SessionHost.resume(srv2, lease_s=1e9)
+    sess = host2.sessions["s"]
+    # Bit-identical restore: lease token, epoch, watermark, f64 state.
+    assert (sess.lease, sess.epoch, sess.step_seq) == (lease1, 0, 3)
+    want = np.asarray(x0, np.float64)
+    for d in deltas[:3]:  # sequential, the order the host applied them.
+        want = want + np.asarray(d[0], np.float64)
+    np.testing.assert_array_equal(sess.x, want)
+    assert sess.status == sessions_mod.LIVE  # lease re-armed.
+
+    r3 = host2._steps[t3.request_id]  # reattached in-flight step.
+    _drain(host2)
+    assert r3.rung == sessions_mod.RUNG_SERVED
+    served[3] = r3.result
+    t4 = host2.step("s", sess.lease, 4, *deltas[3])
+    _drain(host2)
+    assert t4.rung == sessions_mod.RUNG_SERVED
+    served[4] = t4.result
+
+    ref = _offline_digileaves(cadmm_family, x0, v0, deltas)
+    for s in range(1, 5):
+        _assert_tree_equal(served[s], ref[s])
+
+
+# ----------------------------------------------------------------------
+# Result cache x sessions: delta-state steps are NEVER cache-served.
+# ----------------------------------------------------------------------
+
+def test_session_steps_never_served_from_result_cache(
+        cadmm_family, tmp_path):
+    """Regression: a session step whose post-delta state content-matches
+    a cached one-shot result must NOT resolve from the cache (closed-
+    loop state is not idempotent request content) and must not populate
+    it either."""
+    srv = _mk_server(cadmm_family, tmp_path, cache=8)
+    host = sessions_mod.SessionHost(srv, lease_s=1e9)
+
+    # Warm the cache with a one-shot whose content equals the session's
+    # post-delta step-1 state.
+    warm = srv.submit(ScenarioRequest(
+        family="cadmm4", horizon=cadmm_family.chunk_len,
+        x0=(0.35, 0.1, 1.0), v0=(0.1, 0.0, 0.0), request_id="warm"))
+    _drain(host)
+    assert warm.status == queue_mod.COMPLETED
+    key = cache_mod.request_key(
+        cadmm_family.config_hash(), warm.request)
+    assert srv.cache.get(key) is not None
+
+    lease = host.open("s", "cadmm4", (0.3, 0.1, 1.0),
+                      (0.1, 0.0, 0.0))["lease"]
+    s1 = host.step("s", lease, 1, (0.05, 0.0, 0.0))
+    assert not s1.done  # NOT cache-resolved at submit.
+    hits_before = srv.cache.stats()["hits"]
+    _drain(host)
+    assert s1.rung == sessions_mod.RUNG_SERVED
+    assert srv.cache.stats()["hits"] == hits_before  # no hit charged.
+    # ... bitwise the same answer, computed not replayed.
+    _assert_tree_equal(s1.result, warm.result)
+
+    # And the boundary did not cache-populate from the session step: a
+    # fresh one-shot of DIFFERENT content than anything warmed misses.
+    s2 = host.step("s", lease, 2, (0.05, 0.0, 0.0))
+    _drain(host)
+    assert s2.rung == sessions_mod.RUNG_SERVED
+    probe = ScenarioRequest(
+        family="cadmm4", horizon=cadmm_family.chunk_len,
+        x0=tuple(float(t) for t in host.sessions["s"].x),
+        v0=tuple(float(t) for t in host.sessions["s"].v),
+        request_id="probe")
+    assert srv.cache.get(cache_mod.request_key(
+        cadmm_family.config_hash(), probe)) is None
+
+    events = export_mod.read_events(str(tmp_path / "sess.metrics.jsonl"))
+    assert not any(
+        e.get("kind") == "cache_hit"
+        and str(e.get("request_id", "")).startswith("s.")
+        for e in events)
+
+
+# ----------------------------------------------------------------------
+# Fleet: session re-homing + autoscale hysteresis + chaos grammar.
+# ----------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _front(clock, sent, tracer=None, sink=None, replica_ids=(0, 1)):
+    sup = fleet_mod.ReplicaSupervisor(
+        list(replica_ids), lease_s=1.0, boot_grace_s=100.0,
+        clock=clock, emit=sink)
+    for r in replica_ids:
+        sup.heartbeat(r)
+    front = fleet_mod.FleetFront(
+        list(replica_ids), lambda fam: 2 if fam == "f" else None,
+        send=lambda rid, op: sent.append((rid, op)),
+        buckets=(4, 8), supervisor=sup, clock=clock,
+        metrics=sink, tracer=tracer)
+    return front, sup
+
+
+def test_fleet_rehomes_sessions_on_same_trace_id():
+    """Replica death re-homes its sessions to a live replica on the
+    SAME trace_id, the failover span held open until the first
+    post-rehome session result."""
+    rows = []
+
+    class Sink:
+        def emit(self, event, **kw):
+            rows.append({"event": event, **kw})
+
+    clock, sent = FakeClock(), []
+    sink = Sink()
+    tracer = trace_mod.Tracer(sink, track="front",
+                              clock_mono=lambda: clock.t)
+    front, sup = _front(clock, sent, tracer=tracer, sink=sink)
+    owner = front.open_session("s1", "f", trace_id="T1")
+    assert owner in (0, 1)
+    assert sent[-1][1]["op"] == "session_open"
+    assert front.stats()["sessions"] == 1
+
+    other = 1 - owner
+    sup.notify_exit(owner, returncode=-9)
+    front.failover(owner)
+    rehome = [(rid, op) for rid, op in sent
+              if op["op"] == "session_rehome"]
+    assert rehome == [(other, {"op": "session_rehome",
+                               "session_id": "s1", "family": "f",
+                               "trace_id": "T1"})]
+    assert front.session_replica("s1") == other
+    ev = [r for r in rows if r.get("kind") == "rehomed"]
+    assert len(ev) == 1 and ev[0]["to_replica"] == str(other)
+    assert "s1" in front._rehome_spans  # held open...
+
+    clock.t = 2.0
+    front.deliver_result({"request_id": "s1.s000004",
+                          "status": "completed", "replica": str(other)})
+    assert "s1" not in front._rehome_spans  # ...until the next result.
+    spans = [r for r in rows if r.get("event") == "trace_event"
+             and r.get("name") == trace_mod.GUARD_FALLBACK
+             and r.get("t1_mono") is not None]
+    assert len(spans) == 1 and spans[0]["trace_id"] == "T1"
+    assert spans[0]["t1_mono"] - spans[0]["t0_mono"] == \
+        pytest.approx(2.0)
+
+
+def test_fleet_session_orphaned_then_rehomed_when_fleet_heals():
+    """A full-fleet outage orphans the session at the front (replica
+    None); the next pump with a routable replica re-homes it."""
+    clock, sent = FakeClock(), []
+    front, sup = _front(clock, sent)
+    owner = front.open_session("s1", "f")
+    for r in (0, 1):
+        sup.notify_exit(r, returncode=-9)
+    front.failover(owner)
+    assert front.session_replica("s1") is None  # orphaned, not lost.
+    sup.heartbeat(0)  # one replica heals.
+    front.pump()
+    assert front.session_replica("s1") == 0
+    assert [op["op"] for _, op in sent].count("session_rehome") == 1
+
+
+def test_autoscale_hysteresis_never_flaps():
+    """An input oscillating across the up threshold every observation
+    can never move the confirmed hint; N consecutive agreeing raws
+    switch it exactly once (one event per transition)."""
+    events = []
+    sig = fleet_mod.AutoscaleSignal(
+        policy=fleet_mod.AutoscalePolicy(confirm=3),
+        emit=lambda **kw: events.append(kw))
+
+    for i in range(12):  # flap storm: up, steady, up, steady, ...
+        hint = sig.observe(
+            queue_depth=(20 if i % 2 == 0 else 4), sessions=2)
+        assert hint == "steady"
+    assert events == []
+
+    for _ in range(2):
+        assert sig.observe(queue_depth=20, sessions=2) == "steady"
+    assert sig.observe(queue_depth=20, sessions=2) == "scale_up"
+    assert len(events) == 1 and events[0]["hint"] == "scale_up"
+    # Staying up emits nothing more.
+    assert sig.observe(queue_depth=30, sessions=2) == "scale_up"
+    assert len(events) == 1
+
+    # Down requires idle depth AND no sessions AND cold occupancy —
+    # a live session blocks scale_down (standing capacity demand).
+    for _ in range(6):
+        sig.observe(queue_depth=0, occupancy=0.1, sessions=1)
+    assert sig.hint == "steady"
+    for _ in range(3):
+        sig.observe(queue_depth=0, occupancy=0.1, sessions=0)
+    assert sig.hint == "scale_down"
+    assert [e["hint"] for e in events] == [
+        "scale_up", "steady", "scale_down"]
+
+
+def test_front_stats_exposes_autoscale_and_sessions():
+    clock, sent = FakeClock(), []
+    front, _ = _front(clock, sent)
+    front.open_session("s1", "f")
+    front.pump()
+    st = front.stats()
+    assert st["sessions"] == 1
+    assert st["autoscale"]["hint"] == "steady"
+    assert st["autoscale"]["sessions"] == 1
+    assert st["autoscale"]["raw"] in fleet_mod.AutoscaleSignal.HINTS
+
+
+def test_fault_plan_client_actions_roundtrip():
+    """The chaos grammar's client-side faults parse, round-trip, and
+    seed deterministically (rR indexes the CLIENT for them)."""
+    spec = "silent@1:r0,slow@2:r1=2.5,duplicate@3:r0,zombie@4:r1"
+    plan = fleet_mod.FleetFaultPlan.parse(spec)
+    assert plan.to_spec() == spec
+    assert fleet_mod.FleetFaultPlan.parse(plan.to_spec()) == plan
+    acts = {a.action for a in plan.actions}
+    assert acts == fleet_mod.CLIENT_FAULT_ACTIONS
+    with pytest.raises(ValueError):
+        fleet_mod.FleetFaultPlan.parse("zombie@1:q0")
+    # Seeded plans may draw client faults with a slow-factor arg.
+    a = fleet_mod.FleetFaultPlan.seeded(7, 3)
+    assert a == fleet_mod.FleetFaultPlan.seeded(7, 3)
+    for act in a.actions:
+        assert act.action in fleet_mod.FAULT_ACTIONS
+        if act.action in ("wedge", "slow"):
+            assert float(act.arg) > 0

@@ -93,6 +93,25 @@ echo "== serving fleet 2-replica smoke (tools/fleet_local.py) =="
 python tools/fleet_local.py --replicas 2 --requests 6 \
     --out-dir artifacts/fleet-smoke --timeout 420 || fail=1
 
+echo "== closed-loop session smoke (examples/serve_sessions.py) =="
+# Bounded session-tier smoke (serving/sessions.py): one in-process
+# replica, 4 leased sessions streaming 2 steps each, one silent client
+# evicted at lease expiry (healthy clients heartbeat through the wait),
+# its zombie retry fenced twice (heartbeat + step), reconnect served,
+# and every served step's digest proven bitwise equal to the offline
+# one-shot replay of the same state stream. Exit 4 on the wrong
+# evict/fence counts, 5 on any digest mismatch. The deadline-storm /
+# SIGTERM-resume / failover acceptance e2es live in
+# tests/test_sessions.py.
+mkdir -p artifacts/session-smoke
+JAX_PLATFORMS=cpu python examples/serve_sessions.py \
+    --clients 4 --steps 2 --lease-s 2.0 --silent-after 1 --zombie \
+    --offline-check --expect-evicted 1 --expect-fenced 2 \
+    --metrics artifacts/session-smoke/sessions.metrics.jsonl \
+    --results artifacts/session-smoke/results.json || fail=1
+python tools/run_health.py --validate \
+    artifacts/session-smoke/sessions.metrics.jsonl || fail=1
+
 echo "== aot bundle coverage (tools/aot_bundle.py check) =="
 # Registry/bundle drift gate (PR 8): the in-tree manifest-only coverage
 # record must keep matching the live entrypoint registry — a new/changed

@@ -329,6 +329,96 @@ def summarize(events: list[dict]) -> dict:
             },
         }
 
+    # Closed-loop sessions (schema v8): lease lifecycle + per-step SLO
+    # from serving/sessions.py. Append-mode dedup discipline: lifecycle
+    # state dedups per session_id (LAST opened/evicted/session_closed
+    # wins — a resumed run re-appends "opened" for restored sessions)
+    # and step terminals dedup per (session_id, step_seq) (last
+    # step_done/step_degraded wins), while the raw kind counts stay
+    # honest about every event observed.
+    xevents = [e for e in events if e.get("event") == "session_event"]
+    if xevents:
+        kinds = {}
+        for e in xevents:
+            k = e.get("kind", "?")
+            kinds[k] = kinds.get(k, 0) + 1
+        life: dict[str, str] = {}
+        steps: dict[tuple, dict] = {}
+        gaps: list[float] = []
+        for e in xevents:
+            k = e.get("kind")
+            sid = str(e.get("session_id", "?"))
+            if k == "opened":
+                life[sid] = "live"
+            elif k == "evicted":
+                life[sid] = "evicted"
+            elif k == "session_closed":
+                life[sid] = "closed"
+            elif k in ("step_done", "step_degraded"):
+                steps[(sid, e.get("step_seq"))] = e
+            if k in ("renewed", "evicted") and isinstance(
+                    e.get("gap_s"), (int, float)):
+                gaps.append(e["gap_s"])
+        lat: list[float] = []
+        degraded = 0
+        served = 0
+        rejected_steps = 0
+        for e in steps.values():
+            rung = e.get("rung")
+            if e.get("kind") == "step_degraded":
+                degraded += 1
+            elif rung == "rejected":
+                rejected_steps += 1
+            else:
+                served += 1
+            slo = e.get("slo")
+            if isinstance(slo, dict) and isinstance(
+                    slo.get("latency_s"), (int, float)):
+                lat.append(slo["latency_s"])
+        # Heartbeat-gap histogram: fixed edges in seconds. The gap is
+        # renew-to-renew (or renew-to-eviction) silence — the tail
+        # buckets are where lease tuning (TAT_SESSION_LEASE_S) lives.
+        edges = (0.1, 0.5, 1.0, 5.0, 30.0)
+        hist = {f"<{edges[0]}": 0}
+        for lo, hi in zip(edges, edges[1:]):
+            hist[f"{lo}-{hi}"] = 0
+        hist[f">={edges[-1]}"] = 0
+        for g in gaps:
+            if g < edges[0]:
+                hist[f"<{edges[0]}"] += 1
+            elif g >= edges[-1]:
+                hist[f">={edges[-1]}"] += 1
+            else:
+                for lo, hi in zip(edges, edges[1:]):
+                    if lo <= g < hi:
+                        hist[f"{lo}-{hi}"] += 1
+                        break
+        n_steps = len(steps)
+        # Autoscale hint trail rides the fleet_event stream (additive
+        # v8 kind): the LAST confirmed hint wins; the transition count
+        # is a flap meter (hysteresis should keep it tiny).
+        auto = [e for e in fevents if e.get("kind") == "autoscale"]
+        out["sessions"] = {
+            "kinds": kinds,
+            "live": sum(1 for s in life.values() if s == "live"),
+            "evicted": sum(1 for s in life.values() if s == "evicted"),
+            "closed": sum(1 for s in life.values() if s == "closed"),
+            "fence_rejections": kinds.get("fenced", 0),
+            "stale_rejections": kinds.get("stale_step", 0),
+            "steps": n_steps,
+            "step_latency_s": _latency_stats(lat),
+            "degraded_steps": degraded,
+            "served_steps": served,
+            "rejected_steps": rejected_steps,
+            "degraded_rate": (degraded / n_steps) if n_steps else None,
+            "heartbeat_gap_hist": hist,
+            "rehomed": kinds.get("rehomed", 0),
+            "autoscale": {
+                "hint": auto[-1].get("hint") if auto else None,
+                "transitions": len(auto),
+            },
+        }
+
     # Critical path (schema v5, obs.trace): decompose each traced
     # request's submit→complete interval into queue-wait / batch-wait /
     # device / harvest / retry segments — "why did p99 regress" as a
@@ -672,6 +762,41 @@ def render(summary: dict) -> None:
                       f"{r['rejected']} | {r['throttled']} | "
                       f"{_fmt(lat['p50']) if lat else '—'} | "
                       f"{_fmt(lat['p99']) if lat else '—'} |")
+
+    sx = summary.get("sessions")
+    if sx:
+        print("\n## closed-loop sessions (serving/sessions.py)")
+        print("events: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(sx["kinds"].items())
+        ))
+        print(f"- sessions: live={sx['live']}, evicted={sx['evicted']}, "
+              f"closed={sx['closed']}"
+              + (f", rehomed={sx['rehomed']}" if sx["rehomed"] else ""))
+        print(f"- rejections: fenced={sx['fence_rejections']}, "
+              f"stale_step={sx['stale_rejections']}")
+        st = sx.get("step_latency_s")
+        if st:
+            print(f"- per-step latency: p50 {_fmt(st['p50'])} s, "
+                  f"p99 {_fmt(st['p99'])} s (mean {_fmt(st['mean'])}, "
+                  f"n={st['count']})")
+        if sx["steps"]:
+            rate = sx["degraded_rate"]
+            print(f"- steps: {sx['steps']} "
+                  f"(served {sx['served_steps']}, "
+                  f"degraded {sx['degraded_steps']}, "
+                  f"rejected {sx['rejected_steps']}"
+                  + (f"; degraded-rung rate {rate:.3f}"
+                     if rate is not None else "")
+                  + ")")
+        hist = sx["heartbeat_gap_hist"]
+        if any(hist.values()):
+            print("- heartbeat gaps (s): " + ", ".join(
+                f"{b}={n}" for b, n in hist.items() if n
+            ))
+        au = sx["autoscale"]
+        if au["hint"] is not None or au["transitions"]:
+            print(f"- autoscale: hint={au['hint'] or '—'} "
+                  f"({au['transitions']} confirmed transitions)")
 
     cp = summary.get("critical_path")
     if cp:

@@ -549,8 +549,9 @@ _vocab_cache: dict[str, dict | None] = {}
 def load_event_vocab(start_path: str) -> dict | None:
     """Kind tables parsed out of obs/export.py's AST (hostlint never
     imports the package — export pulls in numpy). Returns
-    ``{"serving": {...}, "fleet": {...}, "events": {...}}`` or None
-    when no export.py is reachable above ``start_path``."""
+    ``{"serving": {...}, "fleet": {...}, "session": {...},
+    "events": {...}}`` or None when no export.py is reachable above
+    ``start_path``."""
     d = os.path.dirname(os.path.abspath(start_path))
     root = d
     while True:
@@ -568,6 +569,9 @@ def load_event_vocab(start_path: str) -> dict | None:
     vocab = {
         "serving": _flow.module_dict_literal(tree, "SERVING_EVENT_KINDS"),
         "fleet": _flow.module_dict_literal(tree, "FLEET_EVENT_KINDS"),
+        # Session table is v8 vocabulary — tolerated missing (None) so
+        # the linter still runs against older export files.
+        "session": _flow.module_dict_literal(tree, "SESSION_EVENT_KINDS"),
         "events": _flow.module_dict_literal(tree, "EVENT_FIELDS"),
     }
     if vocab["serving"] is None or vocab["fleet"] is None:
@@ -578,7 +582,7 @@ def load_event_vocab(start_path: str) -> dict | None:
 
 
 _EMIT_TERMINALS = frozenset({"emit", "_emit", "emit_fleet",
-                             "_emit_serving"})
+                             "_emit_serving", "_emit_session"})
 
 
 def rule_hl007_event_vocab(ctx: HostContext):
@@ -588,8 +592,9 @@ def rule_hl007_event_vocab(ctx: HostContext):
     if vocab is None:
         return []
     serving, fleet = vocab["serving"], vocab["fleet"]
+    session = vocab.get("session") or {}
     events = vocab["events"] or {}
-    known = {**serving, **fleet}
+    known = {**serving, **fleet, **session}
     out = []
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
@@ -628,9 +633,8 @@ def rule_hl007_event_vocab(ctx: HostContext):
                 and isinstance(kind_node.value, str)):
             continue
         kind = kind_node.value
-        table = {"serving_event": serving, "fleet_event": fleet}.get(
-            event_type, known
-        )
+        table = {"serving_event": serving, "fleet_event": fleet,
+                 "session_event": session}.get(event_type, known)
         if kind not in table:
             f = ctx.finding(
                 "HL007", node,

@@ -133,6 +133,14 @@ KNOBS: dict[str, dict[str, str]] = {
                "double-buffers — chunk k+1 dispatches before blocking "
                "on chunk k's harvest — and forces device surgery.",
     },
+    "TAT_SESSION_LEASE_S": {
+        "resolver": "tpu_aerial_transport/serving/sessions.py",
+        "default": "30 (seconds)",
+        "doc": "Closed-loop session lease TTL: a session whose client "
+               "has not heartbeated (or stepped) for this long is "
+               "evicted and its lease token fenced; tuning criterion "
+               "in the resolver docstring.",
+    },
     "TAT_SWEEP_CELLS": {
         "resolver": "bench.py",
         "default": "empty (run every sweep cell)",

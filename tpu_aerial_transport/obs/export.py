@@ -48,10 +48,17 @@ from tpu_aerial_transport.obs import telemetry as telemetry_mod
 # v7: adds the ``cache_hit`` serving_event kind (the content-addressed
 # result cache, ``serving/cache.py``: a submit resolved from a prior
 # COMPLETED result with no queue/lane/dispatch).
+# v8: adds the ``session_event`` type (the closed-loop session tier,
+# ``serving/sessions.py``: lease open/renew/evict/fence lifecycle,
+# step-sequenced delta-state admission, per-step deadline degradation —
+# the rows ``tools/run_health.py``'s sessions section renders) and the
+# ``autoscale`` fleet_event kind (the hysteresis'd scale-up/down hint
+# ``serving.fleet.AutoscaleSignal`` derives from queue-depth /
+# occupancy / live-session telemetry).
 # Files written at older versions remain valid (see
 # :data:`SUPPORTED_SCHEMAS`) — each bump only ADDS vocabulary.
-SCHEMA_VERSION = 7
-SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4, 5, 6, 7})
+SCHEMA_VERSION = 8
+SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4, 5, 6, 7, 8})
 
 # Event vocabulary -> required fields (beyond schema/event/ts). The
 # validator rejects unknown event types and missing fields; extra fields
@@ -79,6 +86,10 @@ EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     # Per-kind minimums live in FLEET_EVENT_KINDS (same convention as
     # serving_event; rendered by tools/run_health.py's fleet section).
     "fleet_event": ("kind",),
+    # Per-kind minimums live in SESSION_EVENT_KINDS (closed-loop session
+    # tier, serving/sessions.py; rendered by tools/run_health.py's
+    # sessions section).
+    "session_event": ("kind",),
 }
 
 # The serving/fleet KIND vocabularies: kind -> minimum extra keys beyond
@@ -114,6 +125,37 @@ FLEET_EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "failover": ("request_id",),
     "tenant_rejected": ("tenant",),
     "duplicate_result": ("request_id",),
+    # Hysteresis'd autoscaling hint (serving.fleet.AutoscaleSignal):
+    # emitted when the confirmed hint CHANGES (scale_up/steady/
+    # scale_down), never per observation — the no-flap contract.
+    "autoscale": ("hint",),
+}
+SESSION_EVENT_KINDS: dict[str, tuple[str, ...]] = {
+    # Lease lifecycle (serving/sessions.py SessionHost): open mints a
+    # lease token with a TTL on the host's MONOTONIC clock; heartbeats
+    # renew it (gap_s = time since the previous renewal); a silent
+    # client is evicted at expiry and its token fenced; a fenced token
+    # presented later is a structured rejection, never a lane write.
+    "opened": ("session_id", "lease"),
+    "renewed": ("session_id", "gap_s"),
+    "evicted": ("session_id", "lease"),
+    "fenced": ("session_id",),
+    # Step-sequenced delta-state admission: an out-of-order or replayed
+    # step_seq rejects structurally (stale_step); an accepted step
+    # submits one internal chunk request and resolves step_done
+    # (rung=served) or step_degraded (per-step deadline missed —
+    # rung=hold_last, missed classified in_queue/in_flight).
+    "stale_step": ("session_id", "step_seq"),
+    "step_submitted": ("session_id", "step_seq", "request_id"),
+    "step_done": ("session_id", "step_seq", "rung"),
+    "step_degraded": ("session_id", "step_seq", "rung", "missed"),
+    "session_closed": ("session_id",),
+    # Crash/failover lifecycle: sessions_resumed is one summary row per
+    # SessionHost.resume (leases re-arm — the monotonic domain dies with
+    # the process); rehomed is one row per session the fleet front
+    # re-routes off a dead replica (same trace_id, PR-16 pattern).
+    "sessions_resumed": ("live",),
+    "rehomed": ("session_id", "to_replica"),
 }
 
 # Which kind table governs each kinded event type (disjoint vocabularies
@@ -121,6 +163,7 @@ FLEET_EVENT_KINDS: dict[str, tuple[str, ...]] = {
 EVENT_KIND_TABLES: dict[str, dict[str, tuple[str, ...]]] = {
     "serving_event": SERVING_EVENT_KINDS,
     "fleet_event": FLEET_EVENT_KINDS,
+    "session_event": SESSION_EVENT_KINDS,
 }
 
 # Events that did not exist before a given schema version: an event of
@@ -132,6 +175,7 @@ EVENT_MIN_SCHEMA: dict[str, int] = {
     "serving_event": 4,
     "trace_event": 5,
     "fleet_event": 6,
+    "session_event": 8,
 }
 
 

@@ -80,6 +80,13 @@ LANE_SURGERY = "lane_surgery"   # boundary lane surgery (host splice or
 BOUNDARY_PUBLISH = "boundary_publish"  # snapshot + journal publication.
 GUARD_DISPATCH = "guard_dispatch"  # BackendGuard primary attempt.
 GUARD_FALLBACK = "guard_fallback"  # BackendGuard degrade/retry on CPU.
+SESSION_STEP = "session_step"   # one closed-loop session control step
+#                                 (serving/sessions.py): accept -> the
+#                                 step's inner request resolves. Lives on
+#                                 the SESSION's trace so a whole session
+#                                 renders as one timeline; not a
+#                                 critical-path carve segment (the inner
+#                                 request's spans account the time).
 RUN = "run"                     # recovery.run_chunks whole-run root.
 CHUNK = "chunk"                 # one recovery chunk (compile+execute).
 SNAPSHOT = "snapshot"           # boundary snapshot publish.

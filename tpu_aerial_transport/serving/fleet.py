@@ -63,7 +63,18 @@ QUARANTINED = "quarantined"  # poison replica: K restart cycles burned.
 ROUTABLE_STATES = frozenset({STARTING, UP, SUSPECT})
 
 FLEET_FAULTS_ENV = "TAT_FLEET_FAULTS"
-FAULT_ACTIONS = ("sigkill", "sigterm", "wedge", "error")
+# Replica-side actions hit a replica process; CLIENT-side actions (the
+# ISSUE-19 session storms — examples/serve_sessions.py) hit a session
+# client instead: ``silent`` stops its heartbeats/steps (lease-eviction
+# path), ``slow`` delays its next steps by ARG seconds (deadline-
+# degradation path), ``duplicate`` re-sends its last step_seq
+# (stale_step path), ``zombie`` keeps using its pre-eviction lease after
+# the session was reclaimed (fence path). Same grammar; ``rR`` indexes
+# the client for client actions.
+FAULT_ACTIONS = ("sigkill", "sigterm", "wedge", "error",
+                 "silent", "slow", "duplicate", "zombie")
+CLIENT_FAULT_ACTIONS = frozenset({"silent", "slow", "duplicate",
+                                  "zombie"})
 
 
 def _emit_fn(sink):
@@ -141,7 +152,8 @@ class FaultAction:
     ``replica`` with ``action`` (sigkill/sigterm = signal the process
     group; wedge = stop the replica loop AND its heartbeats for ``arg``
     seconds; error = the replica reports a classified BackendError
-    ``arg`` upward)."""
+    ``arg`` upward). For :data:`CLIENT_FAULT_ACTIONS` the ``replica``
+    field indexes the session CLIENT the fault hits."""
 
     t_s: float
     replica: int
@@ -203,7 +215,7 @@ class FleetFaultPlan:
         for _ in range(n_faults):
             act = kinds[rng.randrange(len(kinds))]
             arg = None
-            if act == "wedge":
+            if act in ("wedge", "slow"):
                 arg = f"{rng.uniform(1.0, 3.0):.2f}"
             elif act == "error":
                 infra = sorted(backend_mod.BREAKER_KINDS)
@@ -218,6 +230,85 @@ class FleetFaultPlan:
         """Actions scheduled in ``[t_from, t_to)`` (storm-relative
         seconds) — the harness polls this each round."""
         return [a for a in self.actions if t_from <= a.t_s < t_to]
+
+
+# ----------------------------------------------------------------------
+# Autoscaling signal (ISSUE-19 satellite: the last PR-16 sliver).
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Thresholds + hysteresis for :class:`AutoscaleSignal`.
+
+    The up/down thresholds deliberately leave a dead band (up at depth
+    >= 16 or occupancy >= 0.85, down only at depth <= 0 AND occupancy
+    <= 0.25 AND no live sessions) and a switch needs ``confirm``
+    CONSECUTIVE raw observations agreeing — an input oscillating around
+    one threshold can never flap the confirmed hint
+    (tests/test_sessions.py pins it)."""
+
+    up_queue_depth: int = 16
+    up_occupancy: float = 0.85
+    down_queue_depth: int = 0
+    down_occupancy: float = 0.25
+    down_sessions: int = 0
+    confirm: int = 3
+
+
+class AutoscaleSignal:
+    """Hysteresis'd scale-up/down hint from the telemetry the SLO
+    accountant already emits: queue depth (front admission), batch
+    occupancy (the serving ``batch_boundary`` rows), and the live
+    closed-loop session count (a session is standing capacity demand
+    even when momentarily idle). Pure host logic on explicit inputs —
+    no clock, no device — so it unit-tests with bare numbers. The
+    confirmed ``hint`` is one of ``scale_up``/``steady``/``scale_down``
+    and an ``autoscale`` fleet event lands ONLY when it changes."""
+
+    HINTS = ("scale_up", "steady", "scale_down")
+
+    def __init__(self, policy: AutoscalePolicy | None = None, emit=None):
+        # `is None`, not truthiness (the HL010 rule): a falsy-but-real
+        # policy/sink must still be used.
+        self.policy = AutoscalePolicy() if policy is None else policy
+        self.emit = _emit_fn(emit)
+        self.hint = "steady"
+        self.last: dict = {}
+        self._candidate = "steady"
+        self._streak = 0
+
+    def _raw(self, queue_depth: int, occupancy, sessions: int) -> str:
+        p = self.policy
+        if (queue_depth >= p.up_queue_depth
+                or (occupancy is not None
+                    and occupancy >= p.up_occupancy)):
+            return "scale_up"
+        if (queue_depth <= p.down_queue_depth
+                and sessions <= p.down_sessions
+                and (occupancy is None or occupancy <= p.down_occupancy)):
+            return "scale_down"
+        return "steady"
+
+    def observe(self, *, queue_depth: int = 0, occupancy=None,
+                sessions: int = 0) -> str:
+        """Feed one telemetry observation; returns the CONFIRMED hint
+        (which moves only after ``policy.confirm`` consecutive raw
+        observations agree on a different value)."""
+        raw = self._raw(int(queue_depth), occupancy, int(sessions))
+        if raw != self._candidate:
+            self._candidate = raw
+            self._streak = 1
+        else:
+            self._streak += 1
+        self.last = {"queue_depth": int(queue_depth),
+                     "occupancy": occupancy, "sessions": int(sessions),
+                     "raw": raw}
+        if raw != self.hint and self._streak >= self.policy.confirm:
+            self.hint = raw
+            self.emit(kind="autoscale", hint=raw,
+                      queue_depth=int(queue_depth), occupancy=occupancy,
+                      sessions=int(sessions))
+        return self.hint
 
 
 # ----------------------------------------------------------------------
@@ -432,7 +523,8 @@ class FleetFront:
                  buckets=(8, 16, 32), capacity: int = 1024,
                  tenants: dict | None = None,
                  supervisor: ReplicaSupervisor | None = None,
-                 clock=time.monotonic, metrics=None, tracer=None):
+                 clock=time.monotonic, metrics=None, tracer=None,
+                 autoscale_policy: AutoscalePolicy | None = None):
         self.replica_ids = list(replica_ids)
         self.send = send
         self.buckets = tuple(sorted(buckets))
@@ -453,6 +545,13 @@ class FleetFront:
         self.duplicates: list[dict] = []
         self.failovers = 0
         self._failover_spans: dict[str, object] = {}
+        # Closed-loop sessions homed through this front:
+        # session_id -> {"replica", "family", "trace_id"} (replica None
+        # while orphaned by a full-fleet outage — pump() re-homes).
+        self.sessions: dict[str, dict] = {}
+        self._rehome_spans: dict[str, object] = {}
+        self.autoscale = AutoscaleSignal(policy=autoscale_policy,
+                                         emit=metrics)
 
     # --------------------------------------------------------- events --
     def _emit_serving(self, **fields) -> None:
@@ -495,8 +594,16 @@ class FleetFront:
         for t in self.queue.expire_deadlines():
             self.requests.pop(t.request.request_id, None)
         alive = set(self.routable())
+        self.autoscale.observe(queue_depth=self.queue.depth(),
+                               sessions=len(self.sessions))
         if not alive:
             return 0
+        # Sessions orphaned by a full-fleet outage re-home as soon as a
+        # replica is routable again (same hold-at-the-front rule as
+        # requests).
+        for sid, rec in sorted(self.sessions.items()):
+            if rec["replica"] is None:
+                self._rehome_session(sid, rec, None, alive)
         sent = 0
         for family in self.queue.families_pending():
             group = self.queue.take(family, self.queue.depth(family))
@@ -513,6 +620,67 @@ class FleetFront:
     def _dispatch(self, request, replica) -> None:
         self.inflight[request.request_id] = replica
         self.send(replica, {"op": "submit", "request": request.to_json()})
+
+    # ------------------------------------------------------- sessions --
+    def open_session(self, session_id: str, family: str,
+                     trace_id: str | None = None):
+        """Home a closed-loop session: sessions route by ``session_id``
+        (NOT family:bucket — a session must stay on one replica so its
+        lease/watermark table is local) and the binding persists until
+        close or re-home. Returns the owning replica, or None when no
+        replica is routable (the caller retries after the fleet heals)."""
+        alive = set(self.routable())
+        if not alive:
+            return None
+        sid = str(session_id)
+        target = self.ring.route(f"session:{sid}", alive)
+        self.sessions[sid] = {"replica": target, "family": family,
+                              "trace_id": trace_id}
+        self.send(target, {
+            "op": "session_open", "session_id": sid, "family": family,
+            **({"trace_id": trace_id} if trace_id else {}),
+        })
+        return target
+
+    def session_replica(self, session_id):
+        rec = self.sessions.get(str(session_id))
+        return None if rec is None else rec["replica"]
+
+    def close_session(self, session_id: str) -> None:
+        rec = self.sessions.pop(str(session_id), None)
+        if rec is not None and rec["replica"] is not None:
+            self.send(rec["replica"], {"op": "session_close",
+                                       "session_id": str(session_id)})
+
+    def _rehome_session(self, sid: str, rec: dict, from_replica,
+                        alive: set) -> None:
+        """Move one session to a live replica on the SAME trace_id, the
+        failover span held open until the session's next result arrives
+        (the PR-16 pattern — the re-serve shows up as an explicit retry
+        segment on the session's trace)."""
+        target = (self.ring.route(f"session:{sid}", alive)
+                  if alive else None)
+        if (self.tracer is not None and rec.get("trace_id") is not None
+                and sid not in self._rehome_spans):
+            self._rehome_spans[sid] = self.tracer.begin(
+                trace_mod.GUARD_FALLBACK, parent=None,
+                trace_id=rec["trace_id"], members=[rec["trace_id"]],
+                session_id=sid, failover=True,
+                from_replica=str(from_replica), to_replica=str(target),
+            )
+        rec["replica"] = target  # None = orphaned; pump() retries.
+        if target is not None:
+            self.send(target, {
+                "op": "session_rehome", "session_id": sid,
+                "family": rec["family"],
+                **({"trace_id": rec["trace_id"]}
+                   if rec.get("trace_id") else {}),
+            })
+        if self.metrics is not None:
+            self.metrics.emit("session_event", kind="rehomed",
+                              session_id=sid,
+                              from_replica=str(from_replica),
+                              to_replica=str(target))
 
     # ------------------------------------------------------- failover --
     def failover(self, dead_replica) -> list[str]:
@@ -565,6 +733,12 @@ class FleetFront:
                 trace_id=request.trace_id, latency_s=round(latency, 6),
             )
             moved.append(rid)
+        # Re-home the dead replica's closed-loop sessions too (their
+        # lease/watermark tables restore replica-side from the journal;
+        # the front only moves the binding).
+        for sid, rec in sorted(self.sessions.items()):
+            if rec["replica"] == dead_replica:
+                self._rehome_session(sid, rec, dead_replica, alive)
         return moved
 
     # ------------------------------------------------------ completion --
@@ -585,6 +759,15 @@ class FleetFront:
         span = self._failover_spans.pop(rid, None)
         if span is not None:
             self.tracer.end(span, status=status)
+        # A session-step result closes the session's held-open re-home
+        # span: the new owner is provably serving it again.
+        sid = row.get("session")
+        if sid is None and rid is not None and ".s" in rid:
+            sid = rid.partition(".s")[0]
+        if sid is not None:
+            rspan = self._rehome_spans.pop(sid, None)
+            if rspan is not None:
+                self.tracer.end(rspan, status=status)
         if ticket is None or ticket.done:
             return False
         ticket.slo.t_complete = self.clock()
@@ -629,6 +812,9 @@ class FleetFront:
             "failovers": self.failovers,
             "duplicates_dropped": len(self.duplicates),
             "tenants": by_tenant,
+            "sessions": len(self.sessions),
+            "autoscale": {"hint": self.autoscale.hint,
+                          **self.autoscale.last},
         }
 
 

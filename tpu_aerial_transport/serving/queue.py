@@ -45,6 +45,12 @@ REASON_NO_COVERAGE = "no_bucket_coverage"
 REASON_BAD_HORIZON = "horizon_not_chunk_aligned"
 REASON_DEADLINE_SPENT = "deadline_already_passed"
 REASON_TENANT_RATE = "tenant_rate_limited"
+# Closed-loop session tier (serving/sessions.py) — same structured
+# reject-with-reason discipline, resolved BEFORE any server interaction:
+# a zombie client presenting a fenced (stale) lease token, and an
+# out-of-order / replayed step_seq.
+REASON_LEASE_FENCED = "lease_fenced"
+REASON_STALE_STEP = "stale_step"
 
 DEFAULT_TENANT = "default"
 
@@ -83,6 +89,16 @@ class ScenarioRequest:
     # server runs a tracer. Journaled with the request so a resumed
     # run's spans land on the SAME trace as the preempted run's.
     trace_id: str | None = None
+    # Closed-loop session tier (serving/sessions.py): the owning
+    # session_id when this request is one delta-state step of a live
+    # session, None for one-shot requests. Session steps are NEVER
+    # served from (or written into) the content-addressed result cache
+    # — the cache key is the full (family, x0/v0, horizon) content
+    # address, but a step's identity includes its session and step_seq,
+    # and serving it from cache would skip the lane write the session's
+    # state stream is defined by. Journaled so resume keeps the step's
+    # session binding.
+    session: str | None = None
 
     def to_json(self) -> dict:
         return {
@@ -96,6 +112,7 @@ class ScenarioRequest:
             **({"trace_id": self.trace_id} if self.trace_id else {}),
             **({"tenant": self.tenant}
                if self.tenant != DEFAULT_TENANT else {}),
+            **({"session": self.session} if self.session else {}),
         }
 
     @classmethod
@@ -107,6 +124,7 @@ class ScenarioRequest:
             request_id=obj["request_id"],
             trace_id=obj.get("trace_id"),
             tenant=obj.get("tenant", DEFAULT_TENANT),
+            session=obj.get("session"),
         )
 
 

@@ -234,8 +234,12 @@ class ScenarioServer:
         """Admit or reject one request (never raises out of admission —
         rejection is a resolved ticket with a structured reason). With a
         result cache configured, a content-address hit resolves the
-        ticket right here — no queue, no lane, no device dispatch."""
-        if self.cache is not None:
+        ticket right here — no queue, no lane, no device dispatch.
+        Session steps (``request.session`` set) NEVER consult the cache:
+        the content address omits the session/step_seq identity, and a
+        cache-resolved step would skip the lane write the session's
+        state stream is defined by (tests/test_sessions.py pins this)."""
+        if self.cache is not None and request.session is None:
             fam = self.families.get(request.family)
             if fam is not None:
                 hit = self.cache.get(
@@ -299,11 +303,14 @@ class ScenarioServer:
         """Populate the result cache from a boundary's resolved tickets —
         COMPLETED only (a deadline-missed result is real data but its
         status is an SLO verdict that must not replay onto a fresh
-        request)."""
+        request), and never session steps (their content address ignores
+        the session identity — a later one-shot request with the same
+        x0/v0 would replay a mid-session lane state as its own)."""
         if self.cache is None:
             return
         for t in finished:
-            if t.status == queue_mod.COMPLETED:
+            if (t.status == queue_mod.COMPLETED
+                    and t.request.session is None):
                 self.cache.put(
                     cache_mod.request_key(fam.config_hash(), t.request),
                     t.result, t.steps_served,
