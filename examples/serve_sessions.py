@@ -330,6 +330,16 @@ def main(argv=None) -> int:
         if args.offline_check and not state["preempted"]:
             import numpy as np
 
+            # Group the SERVED step rids by (session, seq): every
+            # incarnation replays the same plan from its x0 (open()
+            # resets state), so each epoch's step s has the same
+            # post-delta state — and each served rid gets checked.
+            served_rids: dict[tuple[str, int], list[str]] = {}
+            for rid in digests:
+                parsed = sessions_mod.parse_step_rid(rid)
+                if parsed is not None:
+                    sid, _epoch, seq = parsed
+                    served_rids.setdefault((sid, seq), []).append(rid)
             checks = {}
             for sid, (x0, v0, deltas) in plans.items():
                 x = np.asarray(x0, dtype=np.float64)
@@ -337,14 +347,13 @@ def main(argv=None) -> int:
                 for s, (dx, dv) in enumerate(deltas, start=1):
                     x = x + np.asarray(dx, dtype=np.float64)
                     v = v + np.asarray(dv, dtype=np.float64)
-                    rid = f"{sid}.s{s:06d}"
-                    if rid not in digests:
-                        continue  # degraded/rejected/unserved steps.
-                    checks[rid] = server.submit(queue_mod.ScenarioRequest(
-                        family=args.family, horizon=chunk_len,
-                        x0=tuple(float(val) for val in x),
-                        v0=tuple(float(val) for val in v),
-                        request_id=f"off.{rid}"))
+                    for rid in served_rids.get((sid, s), ()):
+                        checks[rid] = server.submit(
+                            queue_mod.ScenarioRequest(
+                                family=args.family, horizon=chunk_len,
+                                x0=tuple(float(val) for val in x),
+                                v0=tuple(float(val) for val in v),
+                                request_id=f"off.{rid}"))
             pump_until(host,
                        lambda: all(t.done for t in checks.values()))
             for rid, t in checks.items():
@@ -401,6 +410,13 @@ def main(argv=None) -> int:
     if args.offline_check and offline["mismatches"]:
         print(f"serve_sessions: served stream NOT bitwise equal to "
               f"offline rollout: {offline['mismatches']}",
+              file=sys.stderr)
+        return 5
+    if (args.offline_check and not state["preempted"] and digests
+            and offline["checked"] == 0):
+        # A check that silently covered nothing is a failed check, not
+        # a pass (e.g. the served rid shape drifted from the replay's).
+        print("serve_sessions: offline check matched ZERO served steps",
               file=sys.stderr)
         return 5
     return 0

@@ -232,6 +232,94 @@ def test_zombie_fence_never_writes_into_reclaimed_lane(
     _assert_tree_equal(b2.result, ref.result)
 
 
+def test_step_rid_roundtrip_and_lookalike_rejection():
+    """The canonical step rid is epoch-qualified and strictly parseable;
+    caller-chosen one-shot rids that merely contain '.s' do not parse
+    (the guard behind the fleet's rid->session fallback)."""
+    rid = sessions_mod._step_rid("c0", 3, 41)
+    assert rid == "c0.e3.s000041"
+    assert sessions_mod.parse_step_rid(rid) == ("c0", 3, 41)
+    # Session ids containing dots still round-trip (longest prefix).
+    assert sessions_mod.parse_step_rid("a.b.e0.s000001") == ("a.b", 0, 1)
+    for bad in ("req.solver1", "c0.s000001", "c0.e1.s1", "c0.e.s000001",
+                "c0.e1.s0000010x", "warmup"):
+        assert sessions_mod.parse_step_rid(bad) is None
+
+
+def test_admission_reject_consumes_nothing(cadmm_family, tmp_path):
+    """Regression (REVIEW): a step rejected at ADMISSION (queue full)
+    must not consume the seq or bake its delta into the state stream —
+    nothing is journaled, and the client retries the SAME seq and gets
+    the control the offline rollout serves for that state."""
+    run_dir = str(tmp_path / "run")
+    srv = _mk_server(cadmm_family, tmp_path, capacity=2,
+                     run_dir=run_dir)
+    host = sessions_mod.SessionHost(srv, lease_s=1e9)
+    lease = host.open("s", "cadmm4", (0.4, 0.1, 1.0))["lease"]
+
+    # Fill the admission queue to capacity with one-shots.
+    for i in range(2):
+        srv.submit(ScenarioRequest(
+            family="cadmm4", horizon=cadmm_family.chunk_len,
+            x0=(0.1 * (i + 1), 0.0, 1.0), request_id=f"fill{i}"))
+    t = host.step("s", lease, 1, (0.05, 0.0, 0.0))
+    assert (t.status, t.reason) == (
+        queue_mod.REJECTED, queue_mod.REASON_QUEUE_FULL)
+    # Rolled back: watermark unmoved, delta NOT applied, no journal row.
+    assert host.sessions["s"].step_seq == 0
+    np.testing.assert_array_equal(
+        host.sessions["s"].x, np.asarray((0.4, 0.1, 1.0), np.float64))
+    assert host.stats()["steps_accepted"] == 0
+    journal = [json.loads(line) for line in
+               open(os.path.join(run_dir, "serving_journal.jsonl"))]
+    assert not any(e.get("event") == "session_step" for e in journal)
+
+    _drain(host)  # the queue drains; the SAME seq now serves.
+    retry = host.step("s", lease, 1, (0.05, 0.0, 0.0))
+    _drain(host)
+    assert retry.rung == sessions_mod.RUNG_SERVED
+    ref = _offline_digileaves(
+        cadmm_family, (0.4, 0.1, 1.0), (0.0, 0.0, 0.0),
+        [((0.05, 0.0, 0.0), (0.0, 0.0, 0.0))])
+    _assert_tree_equal(retry.result, ref[1])
+
+
+def test_fenced_inflight_result_never_writes_new_incarnation(
+        cadmm_family, tmp_path):
+    """Regression (REVIEW): a step submitted by a superseded incarnation
+    that resolves AFTER the reconnect resolves its own ticket but never
+    writes hold-last/lane state onto the new incarnation — and a
+    deadline miss before the new incarnation was ever served resolves
+    at the honest ``no_control`` rung (None is not a control)."""
+    now = [0.0]
+    srv = _mk_server(cadmm_family, tmp_path, clock=lambda: now[0])
+    host = sessions_mod.SessionHost(srv, lease_s=1e9)
+    l0 = host.open("s", "cadmm4", (0.3, 0.1, 1.0))["lease"]
+    old = host.step("s", l0, 1, (0.01, 0.0, 0.0))  # in flight...
+    assert not old.done
+
+    l1 = host.open("s", "cadmm4", (0.6, 0.2, 1.0))["lease"]  # reconnect
+    assert l1 != l0
+    _drain(host)  # the fenced incarnation's step resolves as an orphan.
+    assert old.rung == sessions_mod.RUNG_SERVED
+    assert old.result is not None
+    sess = host.sessions["s"]
+    assert sess.epoch == 1
+    assert sess.last_result is None  # the new incarnation saw NOTHING.
+    assert sess.lane is None and sess.batch_id is None
+
+    # First step of the new incarnation misses in queue: there is no
+    # last control to hold — the rung says so instead of dressing None
+    # up as a served control.
+    t1 = host.step("s", l1, 1, (0.01, 0.0, 0.0), deadline_s=5.0)
+    now[0] = 20.0
+    _drain(host)
+    assert (t1.status, t1.rung, t1.missed) == (
+        queue_mod.COMPLETED, sessions_mod.RUNG_NO_CONTROL,
+        queue_mod.MISSED_IN_QUEUE)
+    assert t1.result is None
+
+
 # ----------------------------------------------------------------------
 # Per-step deadline SLOs: degrade, never raise.
 # ----------------------------------------------------------------------
@@ -419,6 +507,52 @@ def test_session_sigterm_resume_bitwise_acceptance(
         _assert_tree_equal(served[s], ref[s])
 
 
+@pytest.mark.slow
+def test_reconnect_crash_resume_epochs_never_alias(
+        cadmm_family, tmp_path):
+    """Regression (REVIEW): step identities carry the lease epoch, so a
+    reconnect incarnation's in-flight step whose seq matches a COMPLETED
+    old-epoch step is not swallowed by resume's done-request dedup — it
+    reattaches and serves, bitwise the offline rollout of the new
+    incarnation's state stream."""
+    run_dir = str(tmp_path / "run")
+    fi = FakeInterrupt()
+    srv1 = _mk_server(cadmm_family, run_dir=run_dir, interrupt=fi)
+    host1 = sessions_mod.SessionHost(srv1, lease_s=1e9)
+
+    l0 = host1.open("s", "cadmm4", (0.25, 0.1, 1.0))["lease"]
+    t_old = host1.step("s", l0, 1, (0.01, 0.0, 0.0))
+    _drain(host1)
+    assert t_old.rung == sessions_mod.RUNG_SERVED  # epoch-0 step 1 DONE.
+
+    x0b, db = (0.55, 0.2, 1.0), ((0.02, -0.01, 0.0), (0.0, 0.001, 0.0))
+    l1 = host1.open("s", "cadmm4", x0b)["lease"]  # reconnect: epoch 1.
+    t_new = host1.step("s", l1, 1, *db)           # same SEQ, in flight.
+    assert t_new.request_id != t_old.request_id   # epoch-qualified rid.
+    fi.triggered = "SIGTERM"
+    host1.pump()
+    assert srv1.preempted and not t_new.done
+
+    srv2 = server_mod.ScenarioServer.resume(
+        run_dir, families=[cadmm_family], buckets=(4, 8))
+    assert t_old.request_id in srv2.done_requests  # the alias hazard...
+    host2 = sessions_mod.SessionHost.resume(srv2, lease_s=1e9)
+    sess = host2.sessions["s"]
+    assert (sess.lease, sess.epoch, sess.step_seq) == (l1, 1, 1)
+    # ...and the new incarnation's step was NOT treated as done: it is
+    # reattached and completes.
+    r1 = host2._steps[t_new.request_id]
+    _drain(host2)
+    assert r1.rung == sessions_mod.RUNG_SERVED
+    t2 = host2.step("s", l1, 2, *db)  # the stream continues post-resume.
+    _drain(host2)
+    assert t2.rung == sessions_mod.RUNG_SERVED
+    ref = _offline_digileaves(cadmm_family, x0b, (0.0, 0.0, 0.0),
+                              [db, db])
+    _assert_tree_equal(r1.result, ref[1])
+    _assert_tree_equal(t2.result, ref[2])
+
+
 # ----------------------------------------------------------------------
 # Result cache x sessions: delta-state steps are NEVER cache-served.
 # ----------------------------------------------------------------------
@@ -543,6 +677,43 @@ def test_fleet_rehomes_sessions_on_same_trace_id():
     assert len(spans) == 1 and spans[0]["trace_id"] == "T1"
     assert spans[0]["t1_mono"] - spans[0]["t0_mono"] == \
         pytest.approx(2.0)
+
+
+def test_fleet_rid_fallback_requires_exact_session_step_shape():
+    """Regression (REVIEW): the request_id -> session fallback in
+    deliver_result fires ONLY on the session-step rid shape for a
+    session this front routes — a caller-chosen one-shot rid containing
+    '.s' (or an unknown session prefix) must never end another
+    session's held-open re-home span."""
+    rows = []
+
+    class Sink:
+        def emit(self, event, **kw):
+            rows.append({"event": event, **kw})
+
+    clock, sent = FakeClock(), []
+    sink = Sink()
+    tracer = trace_mod.Tracer(sink, track="front",
+                              clock_mono=lambda: clock.t)
+    front, sup = _front(clock, sent, tracer=tracer, sink=sink)
+    owner = front.open_session("s1", "f", trace_id="T1")
+    sup.notify_exit(owner, returncode=-9)
+    front.failover(owner)
+    assert "s1" in front._rehome_spans
+
+    other = str(1 - owner)
+    # A one-shot whose caller-chosen rid contains '.s': NO match.
+    front.deliver_result({"request_id": "s1.speed",
+                          "status": "completed", "replica": other})
+    assert "s1" in front._rehome_spans
+    # Valid step suffix but an unknown session prefix: NO match.
+    front.deliver_result({"request_id": "s9.e0.s000001",
+                          "status": "completed", "replica": other})
+    assert "s1" in front._rehome_spans
+    # The exact epoch-qualified session-step shape closes the span.
+    front.deliver_result({"request_id": "s1.e0.s000001",
+                          "status": "completed", "replica": other})
+    assert "s1" not in front._rehome_spans
 
 
 def test_fleet_session_orphaned_then_rehomed_when_fleet_heals():

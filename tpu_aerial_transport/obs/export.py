@@ -144,7 +144,8 @@ SESSION_EVENT_KINDS: dict[str, tuple[str, ...]] = {
     # step_seq rejects structurally (stale_step); an accepted step
     # submits one internal chunk request and resolves step_done
     # (rung=served) or step_degraded (per-step deadline missed —
-    # rung=hold_last, missed classified in_queue/in_flight).
+    # rung=hold_last, or no_control when nothing was ever served to
+    # hold; missed classified in_queue/in_flight).
     "stale_step": ("session_id", "step_seq"),
     "step_submitted": ("session_id", "step_seq", "request_id"),
     "step_done": ("session_id", "step_seq", "rung"),

@@ -45,6 +45,7 @@ import dataclasses
 import hashlib
 import os
 import random
+import re
 import time
 
 from tpu_aerial_transport.obs import trace as trace_mod
@@ -760,10 +761,19 @@ class FleetFront:
         if span is not None:
             self.tracer.end(span, status=status)
         # A session-step result closes the session's held-open re-home
-        # span: the new owner is provably serving it again.
+        # span: the new owner is provably serving it again. Rows SHOULD
+        # carry their session id; the request_id fallback only fires on
+        # the exact session-step rid shape minted by SessionHost
+        # ({sid}.e{epoch}.s{seq:06d}, legacy pre-epoch form tolerated)
+        # AND a prefix that names a session this front actually routes
+        # — a caller-chosen one-shot rid that happens to contain '.s'
+        # must never end another session's re-home span.
         sid = row.get("session")
-        if sid is None and rid is not None and ".s" in rid:
-            sid = rid.partition(".s")[0]
+        if sid is None and rid is not None:
+            m = (re.match(r"^(.+)\.e\d+\.s\d{6}$", rid)
+                 or re.match(r"^(.+)\.s\d{6}$", rid))
+            if m is not None and m.group(1) in self.sessions:
+                sid = m.group(1)
         if sid is not None:
             rspan = self._rehome_spans.pop(sid, None)
             if rspan is not None:

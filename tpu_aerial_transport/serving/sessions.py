@@ -33,19 +33,28 @@ Every check a zombie could race happens HERE, before any server
 interaction: a stale token is rejected without touching the admission
 queue, the batch, or the journal — so a reclaimed lane can never see a
 write from a fenced client (tests/test_sessions.py pins the absence of
-even a journaled ``serving_request``). Eviction itself needs no device
-action in this model: the session's lane claim ends at its in-flight
-step's chunk boundary, where the standard boundary machinery
-(``serving/lanes.py`` surgery) reclaims the lane as pristine filler or
-hands it to a late joiner.
+even a journaled ``serving_request``). Fencing cuts BOTH directions:
+step identities carry the lease epoch (``{sid}.e{epoch}.s{seq}``), and
+a step submitted by a superseded incarnation that resolves after a
+reconnect is dropped on the floor session-side — it can never refresh
+the new incarnation's hold-last control or lane bookkeeping. Eviction
+itself needs no device action in this model: the session's lane claim
+ends at its in-flight step's chunk boundary, where the standard
+boundary machinery (``serving/lanes.py`` surgery) reclaims the lane as
+pristine filler or hands it to a late joiner.
 
 Per-step SLOs degrade, never raise: a step whose inner request misses
 its deadline resolves ``completed`` with rung ``hold_last`` (the
 serving-layer mirror of PR 1's fallback ladder — the client keeps
-applying the last control it was served), the miss classified
-``in_queue``/``in_flight`` by the batch SLO machinery and journaled.
-The session's state stream is UNAFFECTED: state advances by client
-deltas only, so a degraded step does not fork the bitwise contract.
+applying the last control it was served; before the FIRST served
+control the rung is ``no_control``: there is nothing honest to hold),
+the miss classified ``in_queue``/``in_flight`` by the batch SLO
+machinery and journaled. The session's state stream is UNAFFECTED:
+state advances by client deltas only, so a degraded step does not fork
+the bitwise contract. An ADMISSION rejection (queue full, tenant
+throttled) consumes nothing: watermark and state roll back, nothing is
+journaled, and the client retries the same seq — the two sides' views
+of the state stream cannot diverge on a transient reject.
 
 Crash safety rides the server's fsync'd ``serving_journal.jsonl``:
 ``session_open``/``session_step``/``session_evict``/``session_close``
@@ -54,8 +63,13 @@ exact float64 state — json round-trips doubles exactly), so
 :meth:`SessionHost.resume` on top of ``ScenarioServer.resume`` restores
 live sessions bit-identically. Leases RE-ARM on resume (the monotonic
 clock domain dies with the process — same rule as the server's deadline
-re-arm); an accepted step whose inner request never reached the server
-journal is resubmitted from its journaled post-delta state.
+re-arm). The ``session_step`` append lands AFTER admission accepts (the
+commit is conditional), so the only crash gap is an admitted inner
+request whose session_step never journaled: the client's retry of the
+unacked seq reattaches to the restored inner ticket by request_id
+instead of double-submitting; the defensive reverse path (journaled
+session_step, no server record) still resubmits from the journaled
+post-delta state.
 
 Host-synchronous and lock-free by design (the server-loop discipline):
 one thread drives ``open``/``heartbeat``/``step``/``pump``; the async
@@ -65,6 +79,7 @@ surface is the :class:`StepTicket`.
 from __future__ import annotations
 
 import os
+import re
 
 import numpy as np
 
@@ -79,8 +94,37 @@ CLOSED = "closed"
 # Per-step serving rungs (honest labels on every resolved step).
 RUNG_SERVED = "served"
 RUNG_HOLD_LAST = "hold_last"
+# A deadline miss before the session was EVER served: there is no last
+# control to hold, and a ``hold_last`` carrying None would read as a
+# served control. The step still resolves timely — the client's cue to
+# keep its own local fallback engaged.
+RUNG_NO_CONTROL = "no_control"
 
 DEFAULT_LEASE_S = 30.0
+
+
+def _step_rid(session_id: str, epoch: int, step_seq: int) -> str:
+    """The inner request_id of one session step. The lease EPOCH is
+    part of the identity: open() on reconnect resets the step_seq
+    watermark, so without it epoch N+1's step k would alias epoch N's —
+    and resume's done-request dedup would silently swallow a new
+    incarnation's in-flight step whose seq matched a completed old one.
+    ``FleetFront.deliver_result`` parses this shape (and only this
+    shape) when a replica row omits its session id."""
+    return f"{session_id}.e{epoch}.s{step_seq:06d}"
+
+
+_STEP_RID_RE = re.compile(r"^(?P<sid>.+)\.e(?P<epoch>\d+)\.s(?P<seq>\d{6})$")
+
+
+def parse_step_rid(request_id) -> tuple[str, int, int] | None:
+    """``(session_id, epoch, step_seq)`` when ``request_id`` is a
+    canonical session-step id (the :func:`_step_rid` shape), else None —
+    the strict inverse, for offline replays and row->session routing."""
+    m = _STEP_RID_RE.match(str(request_id))
+    if m is None:
+        return None
+    return m.group("sid"), int(m.group("epoch")), int(m.group("seq"))
 
 
 def resolve_lease_s(configured=None) -> float:
@@ -141,15 +185,20 @@ class Session:
 
 class StepTicket:
     """The client's handle for one control step: resolves ``rejected``
-    (structured reason — fenced lease / stale seq / admission reject) or
-    ``completed`` with an honest ``rung``: ``served`` (fresh result,
-    deadline met) or ``hold_last`` (deadline missed — ``result`` is the
-    last served control, ``missed`` classifies in_queue/in_flight)."""
+    (structured reason — fenced lease / stale seq / admission reject;
+    an admission reject consumes NOTHING, the client retries the same
+    seq) or ``completed`` with an honest ``rung``: ``served`` (fresh
+    result, deadline met), ``hold_last`` (deadline missed — ``result``
+    is the last served control, ``missed`` classifies
+    in_queue/in_flight), or ``no_control`` (deadline missed before any
+    control was ever served — ``result`` is None, NOT a control)."""
 
-    def __init__(self, session_id: str, step_seq: int, request_id: str):
+    def __init__(self, session_id: str, step_seq: int, request_id: str,
+                 epoch: int = 0):
         self.session_id = session_id
         self.step_seq = step_seq
         self.request_id = request_id
+        self.epoch = epoch              # incarnation that submitted it.
         self.status = queue_mod.PENDING
         self.reason: str | None = None
         self.rung: str | None = None
@@ -353,21 +402,32 @@ class SessionHost:
            out-of-order; the watermark does not move)
 
         An accepted step advances the watermark, applies the delta to
-        the session's float64 state, journals the post-delta state, and
-        submits one chunk-length internal request whose result is this
-        step's control."""
+        the session's float64 state, submits one chunk-length internal
+        request whose result is this step's control, and journals the
+        post-delta state. The watermark/delta commit is conditional on
+        ADMISSION accepting the inner request: a step rejected at
+        admission (queue full, tenant throttled) rolls back and
+        journals nothing — the seq is NOT consumed and the client
+        retries the same step, so the client's and server's views of
+        the state stream cannot diverge on a transient rejection. The
+        request_id carries the lease epoch (``{sid}.e{epoch}.s{seq}``)
+        so step identities are unique across reconnect incarnations —
+        resume's done-request dedup and in-flight reattachment can
+        never confuse epoch N's step k with epoch N+1's."""
         self.sweep()
         sid = str(session_id)
         seq = int(step_seq)
-        step = StepTicket(sid, seq, f"{sid}.s{seq:06d}")
         if not self._lease_ok(sid, lease):
             self.fence_rejections += 1
+            step = StepTicket(sid, seq, f"{sid}.s{seq:06d}")
             step.status = queue_mod.REJECTED
             step.reason = queue_mod.REASON_LEASE_FENCED
             self._emit_session(kind="fenced", session_id=sid, op="step",
                                step_seq=seq, lease=str(lease))
             return step
         sess = self.sessions[sid]
+        step = StepTicket(sid, seq, _step_rid(sid, sess.epoch, seq),
+                          epoch=sess.epoch)
         if seq != sess.step_seq + 1:
             self.stale_rejections += 1
             step.status = queue_mod.REJECTED
@@ -378,22 +438,31 @@ class SessionHost:
             return step
 
         now = self.clock()
-        sess.step_seq = seq
-        sess.x = sess.x + np.asarray(dx, dtype=np.float64).reshape(-1)
-        sess.v = sess.v + np.asarray(dv, dtype=np.float64).reshape(-1)
         self._renew(sess, now)  # a stepping client is a live client.
         eff_deadline = (deadline_s if deadline_s is not None
                         else sess.deadline_s if sess.deadline_s is not None
                         else self.step_deadline_s)
+        # Tentative commit: the delta/watermark become durable only if
+        # admission accepts the inner request.
+        prev = (sess.step_seq, sess.x, sess.v)
+        sess.step_seq = seq
+        sess.x = sess.x + np.asarray(dx, dtype=np.float64).reshape(-1)
+        sess.v = sess.v + np.asarray(dv, dtype=np.float64).reshape(-1)
+        self._submit_step(sess, step, eff_deadline)
+        if step.status == queue_mod.REJECTED:
+            # Admission rejected: roll back so the seq is retryable and
+            # the unserved delta never enters the state stream (or the
+            # journal — nothing was written for this step).
+            sess.step_seq, sess.x, sess.v = prev
+            return step
         self._journal({
             "event": "session_step", "session_id": sid, "step_seq": seq,
-            "request_id": step.request_id,
+            "epoch": sess.epoch, "request_id": step.request_id,
             "x": [float(val) for val in sess.x],
             "v": [float(val) for val in sess.v],
             "deadline_s": (None if eff_deadline is None
                            else float(eff_deadline)),
         })
-        self._submit_step(sess, step, eff_deadline)
         return step
 
     def _submit_step(self, sess: Session, step: StepTicket,
@@ -408,33 +477,53 @@ class SessionHost:
                 trace_id=sess.trace_id, session_id=sess.session_id,
                 step_seq=step.step_seq, request_id=step.request_id,
             )
-        req = queue_mod.ScenarioRequest(
-            family=sess.family, horizon=fam.chunk_len,
-            x0=tuple(float(val) for val in sess.x),
-            v0=tuple(float(val) for val in sess.v),
-            deadline_s=deadline_s, request_id=step.request_id,
-            trace_id=sess.trace_id, session=sess.session_id,
-        )
-        step.ticket = self.server.submit(req)
+        inner = self.server.tickets.get(step.request_id)
+        if inner is not None and not inner.done:
+            # The step's identity is already admitted: the crash landed
+            # between the server journal append and the session's, so
+            # resume restored the inner request with no session-step
+            # handle, and the client is retrying the unacked seq (a
+            # retry MUST carry the original delta — the request content
+            # is derived from the same journaled pre-step state).
+            # Reattach instead of double-submitting the same rid.
+            step.ticket = inner
+        else:
+            step.ticket = self.server.submit(queue_mod.ScenarioRequest(
+                family=sess.family, horizon=fam.chunk_len,
+                x0=tuple(float(val) for val in sess.x),
+                v0=tuple(float(val) for val in sess.v),
+                deadline_s=deadline_s, request_id=step.request_id,
+                trace_id=sess.trace_id, session=sess.session_id,
+            ))
+        if step.ticket.done:
+            # Admission rejected (queue full / tenant throttled /
+            # coverage lost) or an immediate deadline verdict: resolve
+            # the step in place so the caller never polls a dead inner
+            # ticket (and, on rejection, rolls back its tentative
+            # commit).
+            self._resolve_step(step)
+            return
         self.steps_accepted += 1
         self._emit_session(kind="step_submitted",
                            session_id=sess.session_id,
                            step_seq=step.step_seq,
                            request_id=step.request_id)
-        if step.ticket.done:
-            # Admission rejected (queue full / coverage lost) or an
-            # immediate deadline verdict: resolve the step in place so
-            # the caller never polls a dead inner ticket.
-            self._resolve_step(step)
-        else:
-            self._steps[step.request_id] = step
+        self._steps[step.request_id] = step
 
     def _resolve_step(self, step: StepTicket) -> None:
         ticket = step.ticket
-        sess = self.sessions.get(step.session_id)
+        cur = self.sessions.get(step.session_id)
+        # Fencing applies to RESULTS too: a step submitted by a
+        # superseded incarnation (the session re-opened while it was in
+        # flight) resolves its OWN ticket but must never write
+        # last_result / lane bookkeeping onto the new incarnation — a
+        # later hold_last would otherwise serve the fenced epoch's
+        # control.
+        sess = (cur if cur is not None and cur.epoch == step.epoch
+                else None)
         slo = ticket.slo.to_event()
         step.latency_s = slo.get("latency_s")
-        if sess is not None:
+        if sess is not None and ticket.lane is not None:
             sess.lane = ticket.lane
             sess.batch_id = ticket.batch_id
         if ticket.status == queue_mod.COMPLETED:
@@ -449,13 +538,17 @@ class SessionHost:
                                request_id=step.request_id, slo=slo)
         elif ticket.status == queue_mod.DEADLINE_MISSED:
             # Graceful degradation: the step RESOLVES (completed, honest
-            # rung) — the client applies the last served control. The
-            # late fresh result, when the miss was in_flight, still
-            # refreshes hold-last state for the NEXT degradation.
+            # rung) — the client applies the last served control, or is
+            # told there is none to apply (no_control) when the miss
+            # precedes the session's first served step. The late fresh
+            # result, when the miss was in_flight, still refreshes
+            # hold-last state for the NEXT degradation.
             self.steps_degraded += 1
             step.missed = ticket.slo.missed
-            step.rung = RUNG_HOLD_LAST
-            step.result = sess.last_result if sess is not None else None
+            held = sess.last_result if sess is not None else None
+            step.rung = (RUNG_HOLD_LAST if held is not None
+                         else RUNG_NO_CONTROL)
+            step.result = held
             step.status = queue_mod.COMPLETED
             if sess is not None and ticket.result is not None:
                 sess.last_result = ticket.result
@@ -508,13 +601,14 @@ class SessionHost:
         """Rebuild the session table from the (already-resumed) server's
         journal: lease epochs and the fence set replay from open/evict/
         close events, watermarks and the exact float64 state from the
-        last accepted step. Leases RE-ARM (fresh TTL from now — the
-        monotonic domain died with the process). Steps the journal
-        accepted but whose inner request is neither done nor restored
-        (the crash landed between the session journal append and the
-        server's) are resubmitted from their journaled post-delta
-        state; restored in-flight steps are reattached so ``pump``
-        resolves them normally."""
+        last accepted step (epoch-guarded: a superseded incarnation's
+        journal rows never advance, and are never reattached to, the
+        incarnation that replaced it). Leases RE-ARM (fresh TTL from
+        now — the monotonic domain died with the process). Restored
+        in-flight steps are reattached so ``pump`` resolves them
+        normally; a journaled step with no server record (defensive —
+        the live path journals only after admission accepts) is
+        resubmitted from its journaled post-delta state."""
         host = cls(server, lease_s=lease_s, clock=clock,
                    step_deadline_s=step_deadline_s)
         if server.journal is None:
@@ -533,11 +627,17 @@ class SessionHost:
                 host.sessions[sid] = sess
             elif ev == "session_step":
                 sess = host.sessions.get(e["session_id"])
-                if sess is not None:
+                # Epoch guard: a step journaled by a superseded
+                # incarnation must not advance the incarnation that
+                # replaced it (replay order already makes this hold for
+                # well-formed journals; the guard keeps a truncated or
+                # hand-edited journal from corrupting the watermark).
+                if (sess is not None
+                        and int(e.get("epoch", 0)) == sess.epoch):
                     sess.step_seq = int(e["step_seq"])
                     sess.x = np.asarray(e["x"], dtype=np.float64)
                     sess.v = np.asarray(e["v"], dtype=np.float64)
-                    step_events[e["request_id"]] = e
+                step_events[e["request_id"]] = e
             elif ev == "session_evict":
                 sess = host.sessions.get(e["session_id"])
                 if sess is not None and sess.status == LIVE:
@@ -559,7 +659,14 @@ class SessionHost:
             if rid in server.done_requests:
                 continue
             sess = host.sessions[e["session_id"]]
-            step = StepTicket(sess.session_id, int(e["step_seq"]), rid)
+            if int(e.get("epoch", 0)) != sess.epoch:
+                # A superseded incarnation's unfinished step: fenced.
+                # Its restored inner ticket (if any) resolves server-
+                # side as an orphan; the session tier never reattaches
+                # it, so it can never write onto the new incarnation.
+                continue
+            step = StepTicket(sess.session_id, int(e["step_seq"]), rid,
+                              epoch=sess.epoch)
             inner = server.tickets.get(rid)
             if inner is not None:
                 # Restored (or replayed) by ScenarioServer.resume: just
