@@ -88,6 +88,43 @@ def main(argv=None) -> int:
 
     summary, rc = fleet_local.run_fleet(args)
 
+    # Live-SLO pass (obs/live.py): replay every replica journal the run
+    # left in --out-dir through a MetricsHub + burn-rate engine; the
+    # final hub snapshot rides the summary and an alert still firing at
+    # end-of-run turns a passing run into exit 6 (rc != 0 keeps its own
+    # code — don't mask a harness failure with the SLO verdict).
+    from tpu_aerial_transport.obs import live as live_mod
+
+    hub = live_mod.MetricsHub()
+    # The demo's ``free`` tenant is rate-limited BY CONTRACT — its
+    # token-bucket rejections are the admission design working, not an
+    # SLO violation — so the rejection SLO is scoped to ``pro`` (the
+    # tenant that bought priority) while latency/miss stay fleet-wide.
+    engine = live_mod.SLOEngine((
+        live_mod.SLOSpec(name="step_p99", metric="step_latency",
+                         objective=0.99, threshold_s=30.0),
+        live_mod.SLOSpec(name="miss_rate", metric="deadline_miss",
+                         objective=0.99),
+        live_mod.SLOSpec(name="rejection", metric="rejection",
+                         objective=0.95, tenant="pro"),
+    ))
+    tailer = live_mod.FleetTailer([args.out_dir])
+    for replica, event in tailer.poll():
+        engine.ingest(replica, event)
+        etype = event.get("event")
+        if etype == "serving_event":
+            hub.ingest_serving(event)
+        elif etype == "session_event":
+            hub.ingest_session(event)
+        elif etype == "backend_event":
+            hub.ingest_backend(event)
+        elif etype == "aot_serve":
+            hub.ingest_aot(event)
+    engine.evaluate()
+    firing = sorted(f"{n}/{t}" for n, t in engine.firing)
+    summary["slo"] = {"firing": firing, "alerts": len(engine.alerts)}
+    summary["hub"] = hub.snapshot()
+
     # Narrate the interesting bits above the raw summary.
     notes = []
     tenants = summary.get("tenants", {})
@@ -104,8 +141,15 @@ def main(argv=None) -> int:
         )
     if summary.get("trace"):
         notes.append(f"perfetto trace: {summary['trace']['path']}")
+    if firing:
+        notes.append(f"SLO ALERTS FIRING at end of run: "
+                     f"{', '.join(firing)}")
     summary["notes"] = notes
     print(json.dumps(summary, indent=1))
+    if rc == 0 and firing:
+        print(f"serve_fleet: unresolved firing alerts: {firing}",
+              file=sys.stderr)
+        return 6
     return rc
 
 

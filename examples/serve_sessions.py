@@ -123,6 +123,7 @@ def main(argv=None) -> int:
 
     honor_jax_platforms_env()
 
+    from tpu_aerial_transport.obs import live as live_mod
     from tpu_aerial_transport.resilience.recovery import GracefulInterrupt
     from tpu_aerial_transport.serving import batcher
     from tpu_aerial_transport.serving import queue as queue_mod
@@ -140,13 +141,16 @@ def main(argv=None) -> int:
         sink = (export_mod.MetricsWriter(args.metrics)
                 if args.metrics else None)
         tracer = trace_lib.Tracer(sink, track="server")
+    # Live metrics hub: in-process counters/gauges/latency histograms
+    # over the whole storm — the final snapshot rides the summary JSON.
+    hub = live_mod.MetricsHub()
     kw = dict(
         families=[args.family], buckets=buckets,
         bundle=args.bundle or None, require_bundle=args.require_bundle,
         run_dir=args.run_dir or None,
         metrics=(tracer.sink if tracer is not None and tracer.sink
                  else args.metrics or None),
-        tracer=tracer,
+        tracer=tracer, hub=hub,
     )
 
     plans = {f"c{i}": client_plan(i, args.steps, args.seed)
@@ -362,6 +366,26 @@ def main(argv=None) -> int:
                         or result_digest(t.result) != digests[rid]):
                     offline["mismatches"].append(rid)
 
+    # SLO pass (obs/live.py): replay this run's journal through the
+    # burn-rate engine and journal fire/resolve transitions back into
+    # the SAME metrics file (additive v9 ``alert`` events) so post-hoc
+    # readers (run_health) see the alert trail. An alert still firing
+    # at end-of-run exits 6 — the nominal ci smoke must stay silent.
+    slo_summary = {}
+    if args.metrics and os.path.exists(args.metrics):
+        from tpu_aerial_transport.obs import export as export_mod
+
+        engine = live_mod.SLOEngine(
+            metrics=export_mod.MetricsWriter(args.metrics))
+        replica = live_mod.FleetTailer.replica_of(args.metrics)
+        for event in export_mod.read_events(args.metrics):
+            engine.ingest(replica, event)
+        engine.evaluate()
+        slo_summary = {
+            "slo_firing": sorted(f"{n}/{t}" for n, t in engine.firing),
+            "slo_alerts": len(engine.alerts),
+        }
+
     wall_s = time.perf_counter() - t0
     if args.results:
         with open(args.results, "w") as fh:
@@ -388,6 +412,8 @@ def main(argv=None) -> int:
         **({"zombie": zombie_log} if zombie_log else {}),
         **({"offline_check": offline} if args.offline_check else {}),
         **trace_summary,
+        **slo_summary,
+        "hub": hub.snapshot(),
         **counts,
     }
     print(json.dumps(summary), flush=True)
@@ -419,6 +445,10 @@ def main(argv=None) -> int:
         print("serve_sessions: offline check matched ZERO served steps",
               file=sys.stderr)
         return 5
+    if slo_summary.get("slo_firing"):
+        print(f"serve_sessions: SLO alerts still firing at end of run: "
+              f"{slo_summary['slo_firing']}", file=sys.stderr)
+        return 6
     return 0
 
 

@@ -1,0 +1,472 @@
+"""obs/live.py: the live SLO engine — mergeable log-histogram laws,
+in-process metrics hub (zero-cost when off), torn-tail/rotation/resume
+jsonl tailing, rolling windows, deterministic burn-rate alerting with a
+schema-valid journaled trail, the fleet console's exact consistency with
+a post-hoc recompute, and the autoscale burn-rate gate."""
+
+import gc
+import json
+import math
+import os
+import random
+import subprocess
+import sys
+
+from tpu_aerial_transport.obs import export as export_mod
+from tpu_aerial_transport.obs import live as live_mod
+from tpu_aerial_transport.serving import fleet as fleet_mod
+from tpu_aerial_transport.serving import queue as queue_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONSOLE = os.path.join(REPO, "tools", "fleet_console.py")
+RUN_HEALTH = os.path.join(REPO, "tools", "run_health.py")
+
+BASE = 1_700_000_000.0  # deterministic wall-epoch base for journals.
+
+
+# ------------------------------------------------------ log histogram --
+
+def _hist(values):
+    h = live_mod.LogHistogram()
+    for v in values:
+        h.add(v)
+    return h
+
+
+def test_histogram_merge_is_associative_and_order_independent():
+    """Merging is per-bucket integer addition, so any merge tree over
+    any partition order yields the SAME buckets — and therefore the
+    same quantiles/count_above (the cross-replica consistency law)."""
+    rng = random.Random(7)
+    values = [rng.lognormvariate(0.0, 2.0) for _ in range(300)]
+    values += [0.0, -1.0, 1e-9, 1e9]
+    a, b, c = values[:100], values[100:180], values[180:]
+    whole = _hist(values)
+
+    left = _hist(a).merge(_hist(b)).merge(_hist(c))       # (a+b)+c
+    right = _hist(a).merge(_hist(b).merge(_hist(c)))      # a+(b+c)
+    shuffled = _hist(c).merge(_hist(a)).merge(_hist(b))   # c+a+b
+
+    want = whole.to_dict()
+    for m in (left, right, shuffled):
+        got = m.to_dict()
+        # Buckets/counts are integer math: EXACTLY merge-invariant.
+        assert {k: got[k] for k in ("counts", "n", "zero")} \
+            == {k: want[k] for k in ("counts", "n", "zero")}
+        # The float running total is the one order-sensitive field
+        # (summation order); everything derived for SLOs is bucketed.
+        assert math.isclose(got["total"], want["total"], rel_tol=1e-12)
+        for q in (0.5, 0.9, 0.99):
+            assert m.quantile(q) == whole.quantile(q)
+        assert m.count_above(1.0) == whole.count_above(1.0)
+
+
+def test_histogram_quantiles_and_zero_bucket():
+    h = _hist([0.0, -3.0])
+    assert h.quantile(0.5) == 0.0     # zero bucket sorts first.
+    assert h.count_above(0.5) == 0    # zeros are never "slow".
+    h.add(100.0)
+    assert h.quantile(0.99) >= 100.0  # upper bucket edge covers it.
+    assert h.count_above(0.5) == 1
+    assert live_mod.LogHistogram().quantile(0.5) is None  # empty.
+    # Round-trip through the snapshot form.
+    assert live_mod.LogHistogram.from_dict(h.to_dict()).to_dict() \
+        == h.to_dict()
+
+
+# --------------------------------------------------------- metrics hub --
+
+def test_hub_primitives_and_ingest_mappers():
+    hub = live_mod.MetricsHub()
+    hub.inc("x")
+    hub.inc("x", n=2)
+    hub.gauge("g", 0.5, key="f")
+    hub.ingest_serving({"kind": "completed", "tenant": "pro",
+                        "request_id": "r1", "slo": {"latency_s": 0.25}})
+    hub.ingest_serving({"kind": "rejected", "request_id": "r2",
+                        "reason": "queue_full", "depth": 3})
+    hub.ingest_session({"kind": "step_done", "session_id": "c0",
+                        "step_seq": 1, "rung": "served",
+                        "slo": {"latency_s": 0.1}})
+    hub.ingest_backend({"kind": "circuit_open"})
+    hub.ingest_aot({"rung": "bundle_exec", "wall_s": 0.02})
+    snap = hub.snapshot()
+    assert snap["counters"]["x"] == 3
+    assert snap["counters"]["serving.events{completed}"] == 1
+    assert snap["counters"]["serving.rejected{queue_full}"] == 1
+    assert snap["counters"]["backend.events{circuit_open}"] == 1
+    assert snap["counters"]["aot.serves{bundle_exec}"] == 1
+    assert snap["gauges"]["g{f}"] == 0.5
+    assert snap["gauges"]["queue.depth"] == 3
+    assert snap["histograms"]["serving.latency_s{pro}"]["count"] == 1
+    assert snap["histograms"]["session.step_latency_s{served}"][
+        "count"] == 1
+
+
+def test_admission_queue_hub_counters():
+    """The queue's hub instrumentation counts submits/rejections/
+    dequeues/deadline misses without touching the emit contract."""
+    hub = live_mod.MetricsHub()
+    q = queue_mod.AdmissionQueue(lambda fam: 4, capacity=1, hub=hub)
+    t1 = q.submit(queue_mod.ScenarioRequest(family="f", horizon=4))
+    t2 = q.submit(queue_mod.ScenarioRequest(family="f", horizon=4))
+    assert t1.status == queue_mod.PENDING
+    assert t2.status == queue_mod.REJECTED
+    taken = q.take("f", 4)
+    assert len(taken) == 1
+    snap = hub.snapshot()
+    assert snap["counters"]["queue.submitted{default}"] == 1
+    assert snap["counters"][
+        f"queue.rejected{{{queue_mod.REASON_QUEUE_FULL}}}"] == 1
+    assert snap["counters"]["queue.dequeued{f}"] == 1
+
+
+def test_hub_none_is_zero_cost():
+    """The zero-cost contract: with ``hub=None`` the instrumented queue
+    path allocates NO obs.live objects at all (checked against the gc
+    heap), and the hub attribute stays None end to end."""
+    q = queue_mod.AdmissionQueue(lambda fam: 4, capacity=8, hub=None)
+    gc.collect()
+    live_types = (live_mod.MetricsHub, live_mod.LogHistogram)
+    before = sum(isinstance(o, live_types) for o in gc.get_objects())
+    for i in range(16):
+        q.submit(queue_mod.ScenarioRequest(family="f", horizon=4))
+    q.take("f", 16)
+    q.expire_deadlines()
+    gc.collect()
+    after = sum(isinstance(o, live_types) for o in gc.get_objects())
+    assert q.hub is None
+    assert after == before
+
+
+# -------------------------------------------------------- jsonl tailer --
+
+def test_tailer_holds_back_torn_tail(tmp_path):
+    """A concurrent writer mid-line never yields a phantom event: the
+    unterminated tail stays buffered until its newline lands."""
+    path = str(tmp_path / "r0.metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"event": "a"}) + "\n")
+        fh.write('{"event": "b", "x"')  # writer caught mid-line.
+    t = live_mod.JsonlTailer(path)
+    assert [e["event"] for e in t.poll()] == ["a"]
+    assert t.poll() == []  # still torn: nothing new, no phantom.
+    with open(path, "a") as fh:
+        fh.write(': 1}\n')
+    assert [e["event"] for e in t.poll()] == ["b"]
+
+
+def test_tailer_rotation_and_truncation_reopen_from_top(tmp_path):
+    path = str(tmp_path / "r0.metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"event": "old1"}) + "\n")
+        fh.write(json.dumps({"event": "old2"}) + "\n")
+    t = live_mod.JsonlTailer(path)
+    assert len(t.poll()) == 2
+    # Rotation: a NEW file (new inode) appears at the path.
+    side = str(tmp_path / "new.jsonl")
+    with open(side, "w") as fh:
+        fh.write(json.dumps({"event": "fresh"}) + "\n")
+    os.replace(side, path)
+    assert [e["event"] for e in t.poll()] == ["fresh"]
+    # Truncation below the offset also restarts from byte 0.
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"event": "tiny"}) + "\n")
+    assert [e["event"] for e in t.poll()] == ["tiny"]
+
+
+def test_tailer_resume_from_offset_equals_posthoc_read(tmp_path):
+    """Stop a console mid-stream, resume a NEW one from the saved byte
+    offsets: the union of both consoles' events equals the post-hoc
+    ``jsonl_read`` of the finished file (no loss, no duplication)."""
+    path = str(tmp_path / "r0.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    for i in range(5):
+        w.emit("serving_event", kind="submitted", request_id=f"a{i}",
+               ts=BASE + i)
+    first = live_mod.FleetTailer([str(tmp_path)])
+    got1 = [e for _r, e in first.poll()]
+    offsets = first.offsets()
+    for i in range(5):
+        w.emit("serving_event", kind="completed", request_id=f"a{i}",
+               ts=BASE + 10 + i)
+    resumed = live_mod.FleetTailer([str(tmp_path)], offsets=offsets)
+    got2 = [e for _r, e in resumed.poll()]
+    assert len(got1) == 5 and len(got2) == 5
+    assert got1 + got2 == export_mod.read_events(path)
+    # Replica label comes from the file stem.
+    assert live_mod.FleetTailer.replica_of(path) == "r0"
+
+
+# ------------------------------------------------------ rolling windows --
+
+def _sev(kind, rid, ts, tenant="pro", family="f", **extra):
+    return {"event": "serving_event", "schema": 9, "ts": ts,
+            "kind": kind, "request_id": rid, "tenant": tenant,
+            "family": family, **extra}
+
+
+def test_rolling_windows_rates_and_trailing_sum():
+    w = live_mod.RollingWindows()
+    w.ingest("r0", _sev("submitted", "r1", BASE))
+    w.ingest("r0", _sev("completed", "r1", BASE + 1,
+                        slo={"latency_s": 0.5}))
+    w.ingest("r1", _sev("submitted", "r2", BASE + 2))
+    w.ingest("r1", _sev("rejected", "r3", BASE + 2,
+                        reason="queue_full"))
+    w.ingest("r1", _sev("deadline_missed", "r2", BASE + 30))
+    w.ingest("r0", _sev("submitted", "c1", BASE + 30, tenant="free"))
+    w.ingest("r0", _sev("completed", "c1", BASE + 31, tenant="free",
+                        slo={"latency_s": 0.1}, cached=True))
+    w.ingest("r0", _sev("cache_hit", "c1", BASE + 30, tenant="free"))
+    rates = w.rates(60)
+    pro = rates["pro"]
+    # rejected submits count as attempts: 2 clean + 1 rejected.
+    assert pro["submitted"] == 3 and pro["rejected"] == 1
+    assert pro["completed"] == 1 and pro["missed"] == 1
+    assert pro["miss_rate"] == 0.5          # missed / (completed+missed)
+    assert pro["rejection_rate"] == 1 / 3
+    free = rates["free"]
+    assert free["cache_hit_rate"] == 1.0
+    # A 1s window ending at the newest ts sees only that second.
+    counts, _ = w.window(1)
+    assert counts == {"completed": 1}
+    counts10, _ = w.window(10)  # trailing 10 s spans BASE+22..BASE+31.
+    assert counts10 == {"submitted": 1, "completed": 1,
+                        "cache_hit": 1, "missed": 1}
+    # Groups carry (tenant, family, replica) identity.
+    assert ("pro", "f", "r1") in w.groups()
+
+
+# ------------------------------------------- burn-rate alerting (SLOs) --
+
+def _write_storm(w, base, n_good, n_miss, tenant="pro"):
+    """One deterministic traffic minute at ``base``: latencies tiny,
+    timestamps spread over 60 s so every window sees the same totals."""
+    for i in range(n_good):
+        w.emit("serving_event", kind="completed", request_id=f"g{i}",
+               tenant=tenant, family="f", slo={"latency_s": 0.01},
+               ts=base + (i % 60))
+    for i in range(n_miss):
+        w.emit("serving_event", kind="deadline_missed",
+               request_id=f"m{i}", tenant=tenant, family="f",
+               ts=base + (i % 60))
+
+
+def test_miss_storm_fires_fast_burn_then_resolves(tmp_path):
+    """The alerting proof: a seeded deadline-miss storm deterministically
+    fires the fast-burn page for exactly (miss_rate, pro), journals a
+    schema-valid ``alert`` trail into the metrics file, and a clean
+    fast window later resolves it."""
+    path = str(tmp_path / "storm.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    _write_storm(w, BASE, n_good=30, n_miss=30)  # 50% misses.
+
+    engine = live_mod.SLOEngine(metrics=export_mod.MetricsWriter(path))
+    for e in export_mod.read_events(path):
+        engine.ingest("r0", e)
+    fired = engine.evaluate()
+    assert [(a["kind"], a["slo"], a["tenant"]) for a in fired] == [
+        ("fire", "miss_rate", "pro")]
+    # Deterministic diagnosis: bad/total = 30/60, budget 0.01 → burn 50.
+    assert fired[0]["burn_rate"] == 50.0
+    assert fired[0]["severity"] == "fast"
+    assert math.isclose(engine.max_burn(), 50.0, rel_tol=1e-9)
+    assert sorted(engine.firing) == [("miss_rate", "pro")]
+
+    # Recovery: a clean trailing fast-window (300 s) of good traffic.
+    _write_storm(w, BASE + 400, n_good=60, n_miss=0)
+    for e in export_mod.read_events(path)[60:]:
+        if e.get("event") == "serving_event":
+            engine.ingest("r0", e)
+    resolved = engine.evaluate()
+    assert [(a["kind"], a["slo"]) for a in resolved] == [
+        ("resolve", "miss_rate")]
+    assert resolved[0]["fired_ts"] == fired[0]["ts"]
+    assert engine.firing == {}
+
+    # The journaled trail is schema-valid v9 alongside the traffic.
+    assert export_mod.validate_file(path) == []
+    alerts = [e for e in export_mod.read_events(path)
+              if e["event"] == "alert"]
+    assert [a["kind"] for a in alerts] == ["fire", "resolve"]
+
+    # run_health renders the trail: fired 1, resolved 1, none open.
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import run_health
+    al = run_health.summarize(export_mod.read_events(path))["alerts"]
+    assert al["fired"] == 1 and al["resolved"] == 1
+    assert al["unresolved"] == []
+
+
+def test_nominal_traffic_fires_nothing(tmp_path):
+    path = str(tmp_path / "calm.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    _write_storm(w, BASE, n_good=200, n_miss=0)
+    engine = live_mod.SLOEngine(metrics=export_mod.MetricsWriter(path))
+    for e in export_mod.read_events(path):
+        engine.ingest("r0", e)
+    assert engine.evaluate() == []
+    assert engine.firing == {} and engine.alerts == []
+    # And the console's CI mode agrees: exit 0, no firing alerts.
+    out = subprocess.run(
+        [sys.executable, CONSOLE, path, "--once", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)["slo"]["firing"] == []
+
+
+def test_slo_spec_grammar_and_validation(tmp_path):
+    spec = live_mod.parse_slo_spec(
+        "p99:step_latency:0.99:threshold_s=0.5:tenant=pro:fast_burn=10")
+    assert spec.name == "p99" and spec.threshold_s == 0.5
+    assert spec.tenant == "pro" and spec.fast_burn == 10.0
+    for bad in ("p99:step_latency",            # too few parts.
+                "x:unknown_metric:0.9",        # unknown metric.
+                "x:rejection:1.5",             # objective out of range.
+                "x:step_latency:0.99",         # missing threshold_s.
+                "x:rejection:0.9:bogus=1"):    # unknown key.
+        try:
+            live_mod.parse_slo_spec(bad)
+        except ValueError:
+            continue
+        raise AssertionError(f"spec {bad!r} should have been rejected")
+
+
+def test_burn_rate_knob_resolvers(monkeypatch):
+    monkeypatch.delenv("TAT_SLO_BURN_RATES", raising=False)
+    monkeypatch.delenv("TAT_CONSOLE_REFRESH_S", raising=False)
+    assert live_mod.resolve_burn_rates() == live_mod.DEFAULT_BURN_RATES
+    assert live_mod.resolve_burn_rates((10, 5)) == (10.0, 5.0)
+    monkeypatch.setenv("TAT_SLO_BURN_RATES", "8:2")
+    assert live_mod.resolve_burn_rates((10, 5)) == (8.0, 2.0)  # env wins.
+    monkeypatch.setenv("TAT_SLO_BURN_RATES", "bogus")
+    try:
+        live_mod.resolve_burn_rates()
+        raise AssertionError("bad TAT_SLO_BURN_RATES should raise")
+    except ValueError:
+        pass
+    monkeypatch.setenv("TAT_CONSOLE_REFRESH_S", "0.25")
+    assert live_mod.resolve_refresh_s(5.0) == 0.25  # env wins.
+
+
+# ----------------------------------------------------- fleet console --
+
+def test_fleet_console_once_matches_posthoc_recompute(tmp_path):
+    """The consistency proof: ``fleet_console --once --json`` numbers
+    equal an independent post-hoc recompute from ``jsonl_read`` exactly
+    — same windows, same rates, same burn rates, float-for-float."""
+    path = str(tmp_path / "fleet.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    rng = random.Random(3)
+    for i in range(40):
+        tenant = ("pro", "free", "batch")[i % 3]
+        ts = BASE + rng.uniform(0, 45)
+        w.emit("serving_event", kind="submitted", request_id=f"r{i}",
+               tenant=tenant, family="f", ts=ts)
+        w.emit("serving_event", kind="completed", request_id=f"r{i}",
+               tenant=tenant, family="f", ts=ts + rng.uniform(0, 5),
+               slo={"latency_s": rng.lognormvariate(-2, 1)})
+    out = subprocess.run(
+        [sys.executable, CONSOLE, path, "--once", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    frame = json.loads(out.stdout)
+
+    windows = live_mod.RollingWindows()
+    replica = live_mod.FleetTailer.replica_of(path)
+    for e in export_mod.read_events(path):
+        windows.ingest(replica, e)
+    engine = live_mod.SLOEngine(windows=windows)
+    engine.evaluate()
+    expect = json.loads(json.dumps({
+        "now": windows.latest_ts,
+        "groups": [list(g) for g in windows.groups()],
+        "windows": {str(win): windows.rates(win)
+                    for win in live_mod.CONSOLE_WINDOWS},
+        "slo": engine.snapshot(),
+    }))
+    assert frame == expect
+
+
+def test_run_health_follow_renders_live_rates(tmp_path):
+    """The --follow satellite: one bounded round over a directory of
+    replica journals prints the trailing-window vitals as JSON."""
+    w0 = export_mod.MetricsWriter(str(tmp_path / "r0.metrics.jsonl"))
+    w1 = export_mod.MetricsWriter(str(tmp_path / "r1.metrics.jsonl"))
+    for i in range(4):
+        w0.emit("serving_event", kind="submitted", request_id=f"a{i}",
+                tenant="pro", family="f", ts=BASE + i)
+        w1.emit("serving_event", kind="completed", request_id=f"a{i}",
+                tenant="pro", family="f", ts=BASE + i + 1,
+                slo={"latency_s": 0.2})
+    out = subprocess.run(
+        [sys.executable, RUN_HEALTH, str(tmp_path), "--follow",
+         "--window", "60", "--rounds", "1", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["window_s"] == 60
+    assert row["tenants"]["pro"]["submitted"] == 4
+    assert row["tenants"]["pro"]["completed"] == 4
+    assert row["tenants"]["pro"]["latency"]["count"] == 4
+
+
+# -------------------------------------------- autoscale burn-rate gate --
+
+def test_autoscale_burn_rate_gates_up_and_down():
+    events = []
+    sig = fleet_mod.AutoscaleSignal(
+        policy=fleet_mod.AutoscalePolicy(confirm=1),
+        emit=lambda **kw: events.append(kw))
+    # Budget burning at the paging rate scales up even on an idle queue.
+    assert sig.observe(queue_depth=0, sessions=0,
+                       burn_rate=20.0) == "scale_up"
+    assert events[-1]["burn_rate"] == 20.0
+    # An elevated (but sub-page) burn BLOCKS scale_down: not up, and
+    # the down gate needs burn <= sustainable.
+    sig2 = fleet_mod.AutoscaleSignal(
+        policy=fleet_mod.AutoscalePolicy(confirm=1))
+    assert sig2.observe(queue_depth=0, sessions=0,
+                        burn_rate=5.0) == "steady"
+    assert sig2.last["raw"] == "steady"
+    # Sustainable burn allows the idle scale_down again.
+    assert sig2.observe(queue_depth=0, sessions=0,
+                        burn_rate=0.5) == "scale_down"
+    # burn_rate=None (no engine / no traffic) leaves behavior unchanged.
+    sig3 = fleet_mod.AutoscaleSignal(
+        policy=fleet_mod.AutoscalePolicy(confirm=1))
+    assert sig3.observe(queue_depth=0, sessions=0) == "scale_down"
+
+
+def test_fleet_front_feeds_slo_burn_into_autoscale():
+    """FleetFront.pump() threads the engine's worst fast-window burn
+    into the autoscale observation (None before any traffic)."""
+
+    class FakeEngine:
+        def __init__(self):
+            self.burn = None
+
+        def max_burn(self):
+            return self.burn
+
+    engine = FakeEngine()
+    front = fleet_mod.FleetFront(
+        [0], lambda fam: 4, send=lambda r, op: None, slo=engine,
+        autoscale_policy=fleet_mod.AutoscalePolicy(confirm=1))
+    front.pump()
+    assert front.autoscale.last["burn_rate"] is None
+    engine.burn = 30.0
+    front.pump()
+    assert front.autoscale.last["burn_rate"] == 30.0
+    assert front.autoscale.hint == "scale_up"
+
+
+def test_fleet_front_hub_sees_admissions():
+    hub = live_mod.MetricsHub()
+    front = fleet_mod.FleetFront(
+        [0], lambda fam: 4, send=lambda r, op: None, hub=hub)
+    front.submit(queue_mod.ScenarioRequest(family="f", horizon=4))
+    snap = hub.snapshot()
+    assert snap["counters"]["queue.submitted{default}"] == 1
+    assert snap["counters"]["serving.events{submitted}"] == 1

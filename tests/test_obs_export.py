@@ -697,3 +697,76 @@ def test_run_health_sessions_section_dedups_appended_rerun(tmp_path):
     assert sx["heartbeat_gap_hist"][">=30.0"] == 1
     # Raw counts stay honest (dedup is aggregation-side).
     assert sx["kinds"]["opened"] == 2 and sx["kinds"]["step_done"] == 2
+
+
+# ------------------ schema v9: alert (live SLO engine) -----------------
+
+def test_alert_event_validates_at_schema_v9(tmp_path):
+    """The alert vocabulary (obs/live.py burn-rate engine): fire carries
+    the burn diagnosis, resolve points back at its fire."""
+    path = str(tmp_path / "alerts.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("alert", kind="fire", slo="miss_rate", tenant="pro",
+           severity="fast", burn_rate=28.7, window_s=300,
+           objective=0.99, metric="deadline_miss")
+    w.emit("alert", kind="resolve", slo="miss_rate", tenant="pro",
+           fired_ts=123.0)
+    assert export_mod.validate_file(path) == []
+    events = export_mod.read_events(path)
+    assert all(e["schema"] == export_mod.SCHEMA_VERSION >= 9
+               for e in events)
+
+
+def test_alert_event_requires_kind_and_kind_keys(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("alert", slo="miss_rate")  # no kind.
+    w.emit("alert", kind="fire", slo="miss_rate")  # no severity/burn.
+    w.emit("alert", kind="resolve", slo="miss_rate")  # no fired_ts.
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 3
+    assert "missing fields ['kind']" in errs[0]
+    assert "missing keys" in errs[1] and "severity" in errs[1]
+    assert "missing keys" in errs[2] and "fired_ts" in errs[2]
+
+
+def test_v8_files_remain_valid_but_not_for_alert(tmp_path):
+    """Additive bump contract, v9 edition: a v8 file still validates; an
+    alert STAMPED v8 does not (the v8 reader contract never defined
+    it)."""
+    path = str(tmp_path / "old.metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "schema": 8, "event": "session_event", "ts": 0.0,
+            "kind": "renewed", "session_id": "c0", "gap_s": 0.1,
+        }) + "\n")
+    assert export_mod.validate_file(path) == []
+    with open(path, "a") as fh:
+        fh.write(json.dumps({
+            "schema": 8, "event": "alert", "ts": 0.0,
+            "kind": "fire", "slo": "miss_rate", "severity": "fast",
+            "burn_rate": 20.0, "window_s": 300,
+        }) + "\n")
+    errs = export_mod.validate_file(path)
+    assert len(errs) == 1 and "requires schema >= 9" in errs[0]
+
+
+def test_run_health_alerts_section_pairs_fire_resolve(tmp_path):
+    """The alerts section: fire/resolve pair per (slo, tenant) in journal
+    order; a fire with no later resolve is UNRESOLVED."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import run_health
+
+    path = str(tmp_path / "alerts.metrics.jsonl")
+    w = export_mod.MetricsWriter(path)
+    w.emit("alert", kind="fire", slo="miss_rate", tenant="pro",
+           severity="fast", burn_rate=30.0, window_s=300)
+    w.emit("alert", kind="resolve", slo="miss_rate", tenant="pro",
+           fired_ts=1.0)
+    w.emit("alert", kind="fire", slo="rejection", tenant="free",
+           severity="slow", burn_rate=7.0, window_s=300)
+    al = run_health.summarize(export_mod.read_events(path))["alerts"]
+    assert al["fired"] == 2 and al["resolved"] == 1
+    assert al["unresolved"] == ["rejection/free"]
+    assert [e["kind"] for e in al["trail"]] == [
+        "fire", "resolve", "fire"]

@@ -112,6 +112,15 @@ JAX_PLATFORMS=cpu python examples/serve_sessions.py \
 python tools/run_health.py --validate \
     artifacts/session-smoke/sessions.metrics.jsonl || fail=1
 
+echo "== fleet console one-shot (tools/fleet_console.py --once) =="
+# Live-SLO console over the session smoke's journal (obs/live.py): the
+# tailer must drain the file, the rolling windows must aggregate it,
+# and the burn-rate engine must evaluate CLEAN — the nominal smoke
+# fires no alerts, and --once exits nonzero when any alert is left
+# firing, so this line doubles as the nominal-alerting gate.
+python tools/fleet_console.py --once \
+    artifacts/session-smoke/sessions.metrics.jsonl || fail=1
+
 echo "== aot bundle coverage (tools/aot_bundle.py check) =="
 # Registry/bundle drift gate (PR 8): the in-tree manifest-only coverage
 # record must keep matching the live entrypoint registry — a new/changed

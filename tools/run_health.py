@@ -12,9 +12,15 @@ time going" without re-running the workload.
 Usage:
   python tools/run_health.py RUN.metrics.jsonl [--json]
   python tools/run_health.py --validate artifacts/*.metrics.jsonl
+  python tools/run_health.py artifacts/fleet/ --follow --window 60
 
 ``--validate`` only schema-checks the files (the ``tools/ci_check.sh``
-gate); exit 1 on any violation.
+gate); exit 1 on any violation. ``--follow`` switches to the live
+tailer (``obs.live``): paths may be directories scanned for
+``*.metrics.jsonl``, and one rolling per-tenant rate table over the
+trailing ``--window`` seconds redraws every refresh
+(TAT_CONSOLE_REFRESH_S) — ``tools/fleet_console.py`` is the full
+multi-window + SLO view; this is the single-window vitals line.
 """
 
 from __future__ import annotations
@@ -23,11 +29,13 @@ import argparse
 import json
 import os
 import sys
+import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 from tpu_aerial_transport.obs import export as export_mod  # noqa: E402
+from tpu_aerial_transport.obs import live as live_mod  # noqa: E402
 from tpu_aerial_transport.obs import trace as trace_lib  # noqa: E402
 
 RUNG_LABELS = ("0 clean", "1 retry", "2 hold", "3 equilibrium")
@@ -529,6 +537,38 @@ def summarize(events: list[dict]) -> dict:
         if "rung" in e:
             rungs.append((f"chunk {e['chunk']}", "", "", e["rung"], "",
                           "", ""))
+    # SLO alert trail (schema v9, obs.live.SLOEngine): fire/resolve
+    # transitions in journal order. An alert with no later resolve for
+    # its (slo, tenant) key is UNRESOLVED — the examples' nonzero-exit
+    # criterion and the headline render line.
+    aevents = [e for e in events if e.get("event") == "alert"]
+    if aevents:
+        akinds: dict[str, int] = {}
+        open_alerts: dict[tuple, dict] = {}
+        for e in aevents:
+            k = e.get("kind", "?")
+            akinds[k] = akinds.get(k, 0) + 1
+            key = (e.get("slo"), e.get("tenant"))
+            if k == "fire":
+                open_alerts[key] = e
+            elif k == "resolve":
+                open_alerts.pop(key, None)
+        out["alerts"] = {
+            "events": len(aevents),
+            "fired": akinds.get("fire", 0),
+            "resolved": akinds.get("resolve", 0),
+            "unresolved": sorted(
+                f"{s}/{t}" for s, t in open_alerts
+            ),
+            "trail": [
+                {k: e.get(k)
+                 for k in ("kind", "slo", "tenant", "severity",
+                           "burn_rate", "window_s", "ts", "fired_ts")
+                 if k in e}
+                for e in aevents
+            ],
+        }
+
     if bevents or rungs:
         kinds: dict[str, int] = {}
         for e in bevents:
@@ -798,6 +838,25 @@ def render(summary: dict) -> None:
             print(f"- autoscale: hint={au['hint'] or '—'} "
                   f"({au['transitions']} confirmed transitions)")
 
+    al = summary.get("alerts")
+    if al:
+        print("\n## slo alerts (obs.live burn-rate engine)")
+        print(f"- fired: {al['fired']}, resolved: {al['resolved']}, "
+              f"unresolved: {len(al['unresolved'])}"
+              + (f" ({', '.join(al['unresolved'])})"
+                 if al["unresolved"] else ""))
+        for e in al["trail"]:
+            if e["kind"] == "fire":
+                print(f"  - FIRE {e.get('slo')}/{e.get('tenant')} "
+                      f"severity={e.get('severity')} "
+                      f"burn={_fmt(e.get('burn_rate'))} "
+                      f"window={e.get('window_s')}s "
+                      f"ts={_fmt(e.get('ts'))}")
+            else:
+                print(f"  - resolve {e.get('slo')}/{e.get('tenant')} "
+                      f"ts={_fmt(e.get('ts'))} "
+                      f"(fired ts={_fmt(e.get('fired_ts'))})")
+
     cp = summary.get("critical_path")
     if cp:
         print("\n## critical path (distributed tracing, obs.trace)")
@@ -896,6 +955,45 @@ def _fmt(v) -> str:
     return f"{v:.4g}"
 
 
+def follow(args) -> None:
+    """Live vitals: tail the paths and redraw one rolling-window
+    per-tenant table each refresh (the fleet_console's single-window
+    little sibling; --rounds bounds the loop for tests)."""
+    tailer = live_mod.FleetTailer(args.paths)
+    windows = live_mod.RollingWindows(
+        horizon_s=max(3600, int(args.window))
+    )
+    refresh = live_mod.resolve_refresh_s(args.refresh)
+    rounds = 0
+    while True:
+        for replica, event in tailer.poll():
+            windows.ingest(replica, event)
+        rates = windows.rates(int(args.window))
+        if args.json:
+            print(json.dumps({"now": windows.latest_ts,
+                              "window_s": int(args.window),
+                              "tenants": rates}))
+        else:
+            print(f"-- trailing {int(args.window)}s @ "
+                  f"ts={_fmt(windows.latest_ts)} --")
+            if not rates:
+                print("  (no traffic)")
+            for tenant, row in sorted(rates.items()):
+                lat = row["latency"]
+                print(f"  {tenant}: submitted={row.get('submitted', 0)} "
+                      f"completed={row.get('completed', 0)} "
+                      f"rejected={row.get('rejected', 0)} "
+                      f"missed={row.get('missed', 0)} "
+                      f"steps={row.get('steps', 0)} "
+                      f"p99={_fmt(lat['p99'])}s "
+                      f"miss_rate={_fmt(row['miss_rate'])} "
+                      f"rejection_rate={_fmt(row['rejection_rate'])}")
+        rounds += 1
+        if args.rounds is not None and rounds >= args.rounds:
+            return
+        time.sleep(refresh)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("paths", nargs="+", metavar="METRICS_JSONL")
@@ -905,7 +1003,22 @@ def main() -> None:
     ap.add_argument("--validate", action="store_true",
                     help="schema-check only (ci gate); exit 1 on any "
                          "violation")
+    ap.add_argument("--follow", action="store_true",
+                    help="live mode: tail the paths (files or dirs of "
+                         "*.metrics.jsonl) and redraw rolling rates")
+    ap.add_argument("--window", type=int, default=60,
+                    help="trailing window in seconds for --follow "
+                         "(default 60)")
+    ap.add_argument("--refresh", type=float, default=None,
+                    help="--follow refresh period in seconds "
+                         "(TAT_CONSOLE_REFRESH_S overrides; default 1)")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="stop --follow after N refreshes (tests)")
     args = ap.parse_args()
+
+    if args.follow:
+        follow(args)
+        return
 
     failed = False
     for path in args.paths:

@@ -569,9 +569,11 @@ def load_event_vocab(start_path: str) -> dict | None:
     vocab = {
         "serving": _flow.module_dict_literal(tree, "SERVING_EVENT_KINDS"),
         "fleet": _flow.module_dict_literal(tree, "FLEET_EVENT_KINDS"),
-        # Session table is v8 vocabulary — tolerated missing (None) so
-        # the linter still runs against older export files.
+        # Session (v8) and alert (v9) tables are newer vocabulary —
+        # tolerated missing (None) so the linter still runs against
+        # older export files.
         "session": _flow.module_dict_literal(tree, "SESSION_EVENT_KINDS"),
+        "alert": _flow.module_dict_literal(tree, "ALERT_EVENT_KINDS"),
         "events": _flow.module_dict_literal(tree, "EVENT_FIELDS"),
     }
     if vocab["serving"] is None or vocab["fleet"] is None:
@@ -593,8 +595,9 @@ def rule_hl007_event_vocab(ctx: HostContext):
         return []
     serving, fleet = vocab["serving"], vocab["fleet"]
     session = vocab.get("session") or {}
+    alert = vocab.get("alert") or {}
     events = vocab["events"] or {}
-    known = {**serving, **fleet, **session}
+    known = {**serving, **fleet, **session, **alert}
     out = []
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
@@ -634,7 +637,10 @@ def rule_hl007_event_vocab(ctx: HostContext):
             continue
         kind = kind_node.value
         table = {"serving_event": serving, "fleet_event": fleet,
-                 "session_event": session}.get(event_type, known)
+                 "session_event": session,
+                 "alert": alert}.get(event_type, known)
+        if not table:
+            continue  # newer vocabulary absent from this export file.
         if kind not in table:
             f = ctx.finding(
                 "HL007", node,

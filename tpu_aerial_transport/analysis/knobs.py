@@ -141,6 +141,19 @@ KNOBS: dict[str, dict[str, str]] = {
                "evicted and its lease token fenced; tuning criterion "
                "in the resolver docstring.",
     },
+    "TAT_SLO_BURN_RATES": {
+        "resolver": "tpu_aerial_transport/obs/live.py",
+        "default": "14.4:6 (fast:slow page/warn thresholds)",
+        "doc": "Burn-rate alert thresholds for the live SLO engine, "
+               "as FAST:SLOW multiples of steady budget spend; tuning "
+               "criterion in the resolver docstring.",
+    },
+    "TAT_CONSOLE_REFRESH_S": {
+        "resolver": "tpu_aerial_transport/obs/live.py",
+        "default": "1.0 (seconds)",
+        "doc": "Poll interval for the live followers "
+               "(tools/fleet_console.py, run_health --follow).",
+    },
     "TAT_SWEEP_CELLS": {
         "resolver": "bench.py",
         "default": "empty (run every sweep cell)",

@@ -373,7 +373,8 @@ INTEGRITY_KINDS = frozenset({"corrupt", "unreadable", "schema"})
 
 def serve_entry(bundle: Bundle | None, name: str, args, *,
                 jit_fallback=None, metrics=None, journal=None,
-                label: str | None = None, block: bool = True):
+                label: str | None = None, block: bool = True,
+                hub=None):
     """Serve one entrypoint call through the fallback ladder and journal
     what this process paid. Returns ``(out, rung)``.
 
@@ -416,6 +417,11 @@ def serve_entry(bundle: Bundle | None, name: str, args, *,
             journal.append({"event": "aot_serve", **event})
         if metrics is not None:
             metrics.emit("aot_serve", **event)
+        if hub is not None:
+            # obs.live.MetricsHub (duck-typed): per-rung serve counters
+            # + wall-time histogram. None = zero-cost off (HL010
+            # identity guard; the event dict exists regardless).
+            hub.ingest_aot(event)
 
     if bundle is not None:
         try:

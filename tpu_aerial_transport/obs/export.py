@@ -55,10 +55,15 @@ from tpu_aerial_transport.obs import telemetry as telemetry_mod
 # ``autoscale`` fleet_event kind (the hysteresis'd scale-up/down hint
 # ``serving.fleet.AutoscaleSignal`` derives from queue-depth /
 # occupancy / live-session telemetry).
+# v9: adds the ``alert`` type (the live SLO engine, ``obs/live.py``:
+# error-budget burn-rate alert fire/resolve transitions — per-tenant
+# SLO name, severity fast/slow, the burn rate and window that tripped —
+# journaled by ``SLOEngine`` and rendered by ``tools/fleet_console.py``
+# and ``tools/run_health.py``'s alerts section).
 # Files written at older versions remain valid (see
 # :data:`SUPPORTED_SCHEMAS`) — each bump only ADDS vocabulary.
-SCHEMA_VERSION = 8
-SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4, 5, 6, 7, 8})
+SCHEMA_VERSION = 9
+SUPPORTED_SCHEMAS = frozenset({1, 2, 3, 4, 5, 6, 7, 8, 9})
 
 # Event vocabulary -> required fields (beyond schema/event/ts). The
 # validator rejects unknown event types and missing fields; extra fields
@@ -90,6 +95,10 @@ EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     # tier, serving/sessions.py; rendered by tools/run_health.py's
     # sessions section).
     "session_event": ("kind",),
+    # Per-kind minimums live in ALERT_EVENT_KINDS (the live SLO
+    # engine's burn-rate alert transitions, obs/live.py; rendered by
+    # tools/fleet_console.py and run_health's alerts section).
+    "alert": ("kind",),
 }
 
 # The serving/fleet KIND vocabularies: kind -> minimum extra keys beyond
@@ -158,6 +167,18 @@ SESSION_EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "sessions_resumed": ("live",),
     "rehomed": ("session_id", "to_replica"),
 }
+ALERT_EVENT_KINDS: dict[str, tuple[str, ...]] = {
+    # Burn-rate alert lifecycle (obs/live.py SLOEngine): ``fire`` lands
+    # when BOTH the fast and slow window burn rates clear a threshold
+    # (severity "fast" pages, "slow" warns); ``resolve`` lands when the
+    # firing pair's fast-window burn drops back under the slow
+    # threshold. ``burn_rate`` is the fast-window burn at fire time;
+    # ``window_s`` the fast window it was measured over; ``slo`` the
+    # SLOSpec name the alert belongs to (per-tenant via the extra
+    # ``tenant`` field).
+    "fire": ("slo", "severity", "burn_rate", "window_s"),
+    "resolve": ("slo", "fired_ts"),
+}
 
 # Which kind table governs each kinded event type (disjoint vocabularies
 # — a fleet kind on a serving_event is drift, not forward compat).
@@ -165,6 +186,7 @@ EVENT_KIND_TABLES: dict[str, dict[str, tuple[str, ...]]] = {
     "serving_event": SERVING_EVENT_KINDS,
     "fleet_event": FLEET_EVENT_KINDS,
     "session_event": SESSION_EVENT_KINDS,
+    "alert": ALERT_EVENT_KINDS,
 }
 
 # Events that did not exist before a given schema version: an event of
@@ -177,6 +199,7 @@ EVENT_MIN_SCHEMA: dict[str, int] = {
     "trace_event": 5,
     "fleet_event": 6,
     "session_event": 8,
+    "alert": 9,
 }
 
 

@@ -686,7 +686,8 @@ class BackendGuard:
                  journal=None,
                  tracer=None,
                  primary_rung: str | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 hub=None):
         self.deadline_s = (default_deadline_s() if deadline_s is None
                            else deadline_s)
         self.breaker = breaker or CircuitBreaker()
@@ -700,6 +701,10 @@ class BackendGuard:
         # any degradation in a "guard_fallback" span — rung + classified
         # BackendError kind as span attributes. None = zero-cost off.
         self.tracer = tracer
+        # Live metrics hub (obs.live.MetricsHub duck-typed: inc /
+        # ingest_backend). None = zero-cost off, guarded `is not None`
+        # at every touch — same contract as tracer.
+        self.hub = hub
         self._primary_rung = primary_rung
         self._clock = clock
         self.events: list[dict] = []
@@ -733,6 +738,8 @@ class BackendGuard:
             self.journal.append({"event": "backend_event", **event})
         if self.metrics is not None:
             self.metrics.emit("backend_event", **event)
+        if self.hub is not None:
+            self.hub.ingest_backend(event)
         return event
 
     def _emit_transitions(self, label: str) -> None:
@@ -769,6 +776,8 @@ class BackendGuard:
         ``chunk`` span."""
         deadline = self.deadline_s if deadline_s is None else deadline_s
         self.last_fell_back = False
+        if self.hub is not None:
+            self.hub.inc("guard.runs")
         allowed = self.breaker.allow()
         self._emit_transitions(label)
         if not allowed:

@@ -254,6 +254,16 @@ class AutoscalePolicy:
     down_occupancy: float = 0.25
     down_sessions: int = 0
     confirm: int = 3
+    # SLO budget-burn input (the obs/live.py SLOEngine's fast-window
+    # burn rate): a fleet burning its error budget at the paging rate
+    # is underprovisioned even when the queue looks shallow — the
+    # default up threshold matches the engine's fast-burn page
+    # (obs.live.DEFAULT_BURN_RATES[0]); scale_down additionally
+    # requires the burn at/below sustainable (<= 1.0 = burning no
+    # faster than the budget accrues). burn_rate=None (no engine, or
+    # no traffic) leaves both gates unchanged.
+    up_burn_rate: float = 14.4
+    down_burn_rate: float = 1.0
 
 
 class AutoscaleSignal:
@@ -278,24 +288,34 @@ class AutoscaleSignal:
         self._candidate = "steady"
         self._streak = 0
 
-    def _raw(self, queue_depth: int, occupancy, sessions: int) -> str:
+    def _raw(self, queue_depth: int, occupancy, sessions: int,
+             burn_rate) -> str:
         p = self.policy
         if (queue_depth >= p.up_queue_depth
                 or (occupancy is not None
-                    and occupancy >= p.up_occupancy)):
+                    and occupancy >= p.up_occupancy)
+                or (burn_rate is not None
+                    and burn_rate >= p.up_burn_rate)):
             return "scale_up"
         if (queue_depth <= p.down_queue_depth
                 and sessions <= p.down_sessions
-                and (occupancy is None or occupancy <= p.down_occupancy)):
+                and (occupancy is None or occupancy <= p.down_occupancy)
+                and (burn_rate is None
+                     or burn_rate <= p.down_burn_rate)):
             return "scale_down"
         return "steady"
 
     def observe(self, *, queue_depth: int = 0, occupancy=None,
-                sessions: int = 0) -> str:
+                sessions: int = 0, burn_rate=None) -> str:
         """Feed one telemetry observation; returns the CONFIRMED hint
         (which moves only after ``policy.confirm`` consecutive raw
-        observations agree on a different value)."""
-        raw = self._raw(int(queue_depth), occupancy, int(sessions))
+        observations agree on a different value). ``burn_rate`` is the
+        SLO engine's fast-window error-budget burn (obs/live.py;
+        None when no engine is wired or no traffic is in the window) —
+        it rides the SAME confirm-N hysteresis as every other input,
+        so a burn spike flaps nothing."""
+        raw = self._raw(int(queue_depth), occupancy, int(sessions),
+                        burn_rate)
         if raw != self._candidate:
             self._candidate = raw
             self._streak = 1
@@ -303,12 +323,12 @@ class AutoscaleSignal:
             self._streak += 1
         self.last = {"queue_depth": int(queue_depth),
                      "occupancy": occupancy, "sessions": int(sessions),
-                     "raw": raw}
+                     "burn_rate": burn_rate, "raw": raw}
         if raw != self.hint and self._streak >= self.policy.confirm:
             self.hint = raw
             self.emit(kind="autoscale", hint=raw,
                       queue_depth=int(queue_depth), occupancy=occupancy,
-                      sessions=int(sessions))
+                      sessions=int(sessions), burn_rate=burn_rate)
         return self.hint
 
 
@@ -525,7 +545,8 @@ class FleetFront:
                  tenants: dict | None = None,
                  supervisor: ReplicaSupervisor | None = None,
                  clock=time.monotonic, metrics=None, tracer=None,
-                 autoscale_policy: AutoscalePolicy | None = None):
+                 autoscale_policy: AutoscalePolicy | None = None,
+                 hub=None, slo=None):
         self.replica_ids = list(replica_ids)
         self.send = send
         self.buckets = tuple(sorted(buckets))
@@ -533,11 +554,18 @@ class FleetFront:
         self.clock = clock
         self.metrics = metrics
         self.tracer = tracer
+        # hub/slo=None is the default and costs nothing per request
+        # (every touch point is behind an ``is not None`` guard).
+        # ``slo`` duck-types obs/live.py's SLOEngine: ``max_burn()``
+        # feeds the autoscale burn-rate gate each pump round.
+        self.hub = hub
+        self.slo = slo
         self.emit_fleet = _emit_fn(metrics)
         self.ring = HashRing(self.replica_ids)
         self.queue = queue_mod.AdmissionQueue(
             coverage, capacity=capacity, clock=clock,
             emit=self._emit_serving, tracer=tracer, tenants=tenants,
+            hub=hub,
         )
         self.tickets: dict[str, queue_mod.Ticket] = {}
         self.requests: dict[str, queue_mod.ScenarioRequest] = {}
@@ -558,6 +586,10 @@ class FleetFront:
     def _emit_serving(self, **fields) -> None:
         if self.metrics is not None:
             self.metrics.emit("serving_event", **fields)
+        if self.hub is not None:
+            # The fields dict already exists for the journal emit — the
+            # hub consumes it in place (zero marginal allocation).
+            self.hub.ingest_serving(fields)
         if (fields.get("kind") == "rejected"
                 and fields.get("reason") == queue_mod.REASON_TENANT_RATE):
             # The throttle ALSO lands in the fleet vocabulary: the
@@ -595,8 +627,10 @@ class FleetFront:
         for t in self.queue.expire_deadlines():
             self.requests.pop(t.request.request_id, None)
         alive = set(self.routable())
+        burn = self.slo.max_burn() if self.slo is not None else None
         self.autoscale.observe(queue_depth=self.queue.depth(),
-                               sessions=len(self.sessions))
+                               sessions=len(self.sessions),
+                               burn_rate=burn)
         if not alive:
             return 0
         # Sessions orphaned by a full-fleet outage re-home as soon as a

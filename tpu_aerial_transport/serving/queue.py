@@ -247,7 +247,8 @@ class AdmissionQueue:
 
     def __init__(self, coverage, capacity: int = 256,
                  clock=time.monotonic, emit=None, tracer=None,
-                 tenants: dict[str, TenantPolicy] | None = None):
+                 tenants: dict[str, TenantPolicy] | None = None,
+                 hub=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.coverage = coverage
@@ -257,6 +258,10 @@ class AdmissionQueue:
         # a partial with no __bool__ guarantee) must still be used.
         self.emit = (lambda **kw: None) if emit is None else emit
         self.tracer = tracer  # obs.trace.Tracer | None (zero-cost off).
+        # obs.live.MetricsHub | None: live counters/gauges for the
+        # console. None is the zero-cost path — every touch is guarded
+        # `is not None` and allocates nothing.
+        self.hub = hub
         self.tenants = dict(tenants or {})
         self._default_policy = TenantPolicy()
         self._buckets: dict[str, _TokenBucket] = {}
@@ -322,6 +327,13 @@ class AdmissionQueue:
             self.emit(kind="rejected", request_id=request.request_id,
                       family=request.family, reason=reason,
                       tenant=request.tenant, depth=depth)
+            if self.hub is not None:
+                # Hub updates AFTER the lock too: the hub's own leaf
+                # lock is lock-free dict math, but keeping every
+                # observability side effect on one side of the
+                # admission lock keeps the HL003/HL004 reasoning local.
+                self.hub.inc("queue.rejected", key=reason)
+                self.hub.gauge("queue.depth", depth)
             if ticket.trace is not None:
                 # Terminal span: the rejection IS the request's trace.
                 ticket.trace.resolve(REJECTED, reason=reason)
@@ -329,6 +341,9 @@ class AdmissionQueue:
         self.emit(kind="submitted", request_id=request.request_id,
                   family=request.family, horizon=request.horizon,
                   tenant=request.tenant, depth=depth)
+        if self.hub is not None:
+            self.hub.inc("queue.submitted", key=request.tenant)
+            self.hub.gauge("queue.depth", depth)
         return ticket
 
     def _admission_reason(self, request: ScenarioRequest,
@@ -398,7 +413,10 @@ class AdmissionQueue:
                     self._served.get((family, tenant), 0.0)
                     + 1.0 / max(self.policy(tenant).weight, 1e-9)
                 )
-            return taken
+        # Hub bump after the lock (same side as emit — HL003 locality).
+        if self.hub is not None and taken:
+            self.hub.inc("queue.dequeued", key=family, n=len(taken))
+        return taken
 
     def expire_deadlines(self) -> list[Ticket]:
         """Resolve queued tickets whose deadline passed before admission:
@@ -426,6 +444,8 @@ class AdmissionQueue:
                       family=family, tenant=tenant,
                       missed=MISSED_IN_QUEUE,
                       slo=t.slo.to_event())
+            if self.hub is not None:
+                self.hub.inc("queue.deadline_missed", key=tenant)
             if t.trace is not None:
                 t.trace.resolve(DEADLINE_MISSED, missed=MISSED_IN_QUEUE)
         return [t for t, _, _ in missed]

@@ -77,7 +77,7 @@ class ScenarioServer:
                  metrics=None, guard=None, interrupt=None, mesh=None,
                  tracer=None, clock=time.monotonic,
                  surgery: str | None = None, dispatch: str | None = None,
-                 cache=None):
+                 cache=None, hub=None):
         from tpu_aerial_transport.obs import export as export_mod
         from tpu_aerial_transport.resilience import backend as backend_mod
         from tpu_aerial_transport.resilience.recovery import RunJournal
@@ -130,12 +130,19 @@ class ScenarioServer:
         self.tracer = tracer
         self._server_trace = (None if tracer is None
                               else trace_mod.new_trace_id())
+        # Live metrics hub (obs.live.MetricsHub | None). None is the
+        # zero-cost path: every touch below is guarded `is not None`
+        # (HL010) and the serving loop allocates nothing extra per
+        # request — the same contract tracer=None keeps.
+        self.hub = hub
         # `is None`, not truthiness (the PR-15 tracer=False bug class):
         # a caller-built guard must be used even if it tests falsy.
-        self.guard = (backend_mod.BackendGuard(metrics=metrics)
+        self.guard = (backend_mod.BackendGuard(metrics=metrics, hub=hub)
                       if guard is None else guard)
         if self.guard.tracer is None:
             self.guard.tracer = tracer
+        if self.guard.hub is None:
+            self.guard.hub = hub
         self.interrupt = interrupt
         self.preempted = False
         self.run_dir = run_dir
@@ -151,7 +158,7 @@ class ScenarioServer:
 
         self.queue = queue_mod.AdmissionQueue(
             self._coverage, capacity=capacity, clock=clock,
-            emit=self._emit, tracer=tracer,
+            emit=self._emit, tracer=tracer, hub=hub,
         )
         self.tickets: dict[str, queue_mod.Ticket] = {}
         self.done_requests: set[str] = set()  # filled by resume().
@@ -220,6 +227,11 @@ class ScenarioServer:
     def _emit(self, **fields) -> None:
         if self.metrics is not None:
             self.metrics.emit("serving_event", **fields)
+        if self.hub is not None:
+            # The fields dict already exists (this funnel's kwargs), so
+            # the hub fold adds no marginal allocation; hub=None skips
+            # entirely — the zero-cost contract.
+            self.hub.ingest_serving(fields)
         if self.journal is not None and fields.get("kind") in (
             "completed", "deadline_missed",
         ):
@@ -659,6 +671,7 @@ class ScenarioServer:
             return loader_mod.serve_entry(
                 self.bundle, entry, args, jit_fallback=jit_fb,
                 metrics=self.metrics, label=label, block=block,
+                hub=self.hub,
             )
 
         fallback = None
@@ -666,6 +679,7 @@ class ScenarioServer:
             fallback = backend_mod.run_on_cpu(lambda: loader_mod.serve_entry(
                 None, entry, args, jit_fallback=jit_fallback,
                 metrics=self.metrics, label=label + ":cpu", block=block,
+                hub=self.hub,
             ))
         return self.guard.run(label, primary, fallback_fn=fallback,
                               trace_parent=trace_parent)

@@ -251,6 +251,11 @@ class SessionHost:
     def _emit_session(self, **fields) -> None:
         if self.server.metrics is not None:
             self.server.metrics.emit("session_event", **fields)
+        if self.server.hub is not None:
+            # Live hub fold (obs.live.MetricsHub): the fields dict is
+            # this funnel's kwargs, so hub=None adds no per-step
+            # allocation — the standing zero-cost contract.
+            self.server.hub.ingest_session(fields)
 
     def _journal(self, obj: dict) -> None:
         if self.server.journal is not None:
